@@ -1,0 +1,25 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the capability
+surface of Apache MXNet 0.10 (reference: daiab/mxnet @ v0.10.1), built on
+JAX/XLA/Pallas/pjit.
+
+Import convention mirrors the reference's ``import mxnet as mx``::
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import base
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd
+from . import ops
+
+# generate mx.nd.<op> functions from the registry (reference:
+# python/mxnet/ndarray.py:2281-2423 codegen over the C op registry)
+ndarray._register_op_functions(ops.generate_nd_functions())

@@ -1,0 +1,191 @@
+"""Imperative autograd: a small python tape over pure op calls.
+
+Reference: ``src/ndarray/autograd.{h,cc}`` (AutogradRuntime tape of AGNodes,
+replayed through a throwaway GraphExecutor) and the python surface
+``python/mxnet/contrib/autograd.py``.  TPU-native design (SURVEY §7.8): the
+tape records (op, attrs, input arrays, output ids); ``backward`` re-executes
+the tape as a pure function of the marked variables and calls ``jax.vjp`` —
+JAX's trace-level machinery replaces the C++ AGNode graph.  Stochastic ops
+record their PRNG key so replay is bit-identical.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from .base import MXNetError
+
+__all__ = ["is_training", "is_recording", "set_is_training", "record",
+           "train_section", "test_section", "pause", "mark_variables",
+           "backward", "grad_and_loss"]
+
+_state = threading.local()
+
+
+def _get(attr, default=False):
+    return getattr(_state, attr, default)
+
+
+def is_training():
+    return _get("train")
+
+
+def is_recording():
+    return _get("record")
+
+
+def set_is_training(train_mode):
+    prev = _get("train")
+    _state.train = bool(train_mode)
+    return prev
+
+
+class _Tape:
+    def __init__(self):
+        self.entries = []          # (op, attrs, in_ids, const_arrays, out_ids, key)
+        self.grad_map = {}         # id(NDArray) -> (grad NDArray, req)
+        self.marked = {}           # id(NDArray) -> NDArray (variables)
+        self.live = {}             # id(NDArray) -> NDArray (any tape array)
+
+
+def _tape() -> _Tape:
+    if not hasattr(_state, "tape") or _state.tape is None:
+        _state.tape = _Tape()
+    return _state.tape
+
+
+@contextmanager
+def record(train_mode=True):
+    """Record imperative ops (reference train_section / MXAutograd*)."""
+    prev_r, prev_t = _get("record"), _get("train")
+    _state.record, _state.train = True, train_mode
+    try:
+        yield
+    finally:
+        _state.record, _state.train = prev_r, prev_t
+
+
+train_section = record
+
+
+@contextmanager
+def test_section():
+    with record(train_mode=False):
+        yield
+
+
+@contextmanager
+def pause():
+    prev_r, prev_t = _get("record"), _get("train")
+    _state.record, _state.train = False, prev_t
+    try:
+        yield
+    finally:
+        _state.record, _state.train = prev_r, prev_t
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference AutogradRuntime::MarkVariables)."""
+    t = _tape()
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        t.grad_map[id(v)] = (g, r)
+        t.marked[id(v)] = v
+        t.live[id(v)] = v
+
+
+def _record_op(op, attrs, inputs, outputs, key):
+    """Called by the imperative invoke path when recording."""
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+    t = _tape()
+    in_ids = []
+    consts = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            in_ids.append(id(x))
+            t.live[id(x)] = x
+            consts.append(x.data)
+        else:  # scalar / numpy constant: participates as a pure constant
+            in_ids.append(None)
+            consts.append(jnp.asarray(x))
+    out_ids = []
+    for o in outputs:
+        out_ids.append(id(o))
+        t.live[id(o)] = o
+    t.entries.append((op, dict(attrs), in_ids, consts, out_ids, key))
+
+
+def _get_grad(arr):
+    entry = _tape().grad_map.get(id(arr))
+    return entry[0] if entry is not None else None
+
+
+def backward(outputs, out_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of outputs w.r.t. marked variables.
+
+    Re-executes the tape as a pure function of the marked variables and runs
+    ``jax.vjp`` (reference ComputeGradient builds a Symbol + GraphExecutor,
+    autograd.cc:149-240).
+    """
+    import jax
+    import jax.numpy as jnp
+    from .ndarray import NDArray
+    from .ops.registry import OpContext, apply_op
+
+    t = _tape()
+    if not t.entries:
+        raise MXNetError("no operations recorded for backward")
+    var_ids = list(t.marked.keys())
+    var_vals = [t.marked[i].data for i in var_ids]
+    entries = list(t.entries)
+
+    def replay(vals):
+        env = dict(zip(var_ids, vals))
+        for op, attrs, in_ids, consts, out_ids, key in entries:
+            ins = [consts[k] if iid is None else env.get(iid, consts[k])
+                   for k, iid in enumerate(in_ids)]
+            ctx = OpContext(is_train=train_mode, key=key)
+            outs = apply_op(op, attrs, ctx, *ins)
+            for oid, val in zip(out_ids, outs):
+                env[oid] = val
+        return [env.get(id(o), o.data) for o in outputs]
+
+    primal, vjp_fn = jax.vjp(lambda *v: replay(list(v)), *var_vals)
+    if out_grads is None:
+        cts = [jnp.ones_like(p) for p in primal]
+    else:
+        cts = [g.data if isinstance(g, NDArray) else jnp.asarray(g)
+               for g in out_grads]
+    grads = vjp_fn(list(cts))
+    for vid, g in zip(var_ids, grads):
+        buf, req = t.grad_map[vid]
+        if req == "null":
+            continue
+        if req == "add":
+            buf._set_data(buf.data + g.astype(buf.dtype))
+        else:
+            buf._set_data(g.astype(buf.dtype))
+    if not retain_graph:
+        t.entries.clear()
+        # drop refs to intermediates so device buffers free (keep marked vars)
+        t.live = dict(t.marked)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorate func to return (gradients, loss) (reference contrib/autograd.py)."""
+    import jax
+
+    def wrapped(*args):
+        from .ndarray import NDArray, zeros_like
+        variables = list(args) if argnum is None else \
+            [args[i] for i in (argnum if isinstance(argnum, (list, tuple)) else [argnum])]
+        grads = [zeros_like(v) for v in variables]
+        mark_variables(variables, grads)
+        with record():
+            outputs = func(*args)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        backward(outs)
+        return grads, outputs
+    return wrapped

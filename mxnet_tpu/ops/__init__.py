@@ -1,0 +1,146 @@
+"""Operator package: registry + corpus + imperative dispatch.
+
+Importing this package registers the op corpus and generates the
+``mx.nd.<op>`` functions (reference codegen: python/mxnet/ndarray.py:2281-2423
+over the C registry).  Symbolic wrappers are generated in
+:mod:`mxnet_tpu.symbol` from the same table.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError
+from . import registry
+from .registry import (OpContext, Operator, apply_op, get_op, has_op,
+                       list_ops, register)
+
+# register the corpus (import order matters only for aliases)
+from . import tensor as _tensor      # noqa: F401
+from . import nn as _nn              # noqa: F401
+from . import optimizer_ops as _opt  # noqa: F401
+from . import rnn as _rnn            # noqa: F401
+from . import contrib as _contrib    # noqa: F401
+
+__all__ = ["OpContext", "Operator", "register", "get_op", "has_op",
+           "list_ops", "imperative_invoke"]
+
+
+@functools.lru_cache(maxsize=4096)
+def _jitted(op_name, attr_items, n_inputs, is_train, has_key):
+    """One compiled XLA executable per (op, attrs, train) — the imperative
+    fast path (reference: per-op engine push; here: cached jit)."""
+    import jax
+    op = get_op(op_name)
+    attrs = dict(attr_items)
+
+    def fn(key, *inputs):
+        ctx = OpContext(is_train=is_train, key=key)
+        return apply_op(op, attrs, ctx, *inputs)
+
+    return jax.jit(fn)
+
+
+def _hashable_attrs(attrs):
+    items = []
+    for k in sorted(attrs):
+        v = attrs[k]
+        if isinstance(v, list):
+            v = tuple(v)
+        items.append((k, v))
+    return tuple(items)
+
+
+def imperative_invoke(op_name, *args, out=None, name=None, **kwargs):
+    """Eager op call on NDArrays (reference: MXImperativeInvoke,
+    c_api_ndarray.cc:315-397)."""
+    import jax.numpy as jnp
+    from .. import autograd
+    from .. import random as _random
+    from ..ndarray import NDArray
+
+    op = get_op(op_name)
+
+    # split kwargs into tensor inputs vs attrs
+    tensor_kwargs, attr_kwargs = {}, {}
+    for k, v in kwargs.items():
+        if isinstance(v, NDArray):
+            tensor_kwargs[k] = v
+        else:
+            attr_kwargs[k] = v
+    if op.key_var_num_args and op.key_var_num_args not in attr_kwargs:
+        attr_kwargs[op.key_var_num_args] = len(args) + len(tensor_kwargs)
+    attrs = op.parse_attrs(attr_kwargs)
+
+    arg_names = op.get_arg_names(attrs)
+    aux_names = op.get_aux_names(attrs)
+    all_names = arg_names + aux_names
+
+    slots = {}
+    for i, a in enumerate(args):
+        if i >= len(all_names):
+            raise MXNetError(f"{op_name}: too many positional inputs")
+        slots[all_names[i]] = a
+    slots.update(tensor_kwargs)
+    missing = [n for n in all_names if n not in slots]
+    if missing:
+        raise MXNetError(f"{op_name}: missing inputs {missing}")
+
+    handles = [slots[n] for n in all_names]
+    raw = [h.data if isinstance(h, NDArray) else jnp.asarray(h)
+           for h in handles]
+
+    is_train = autograd.is_training()
+    stochastic = op.stochastic(attrs) if callable(op.stochastic) else op.stochastic
+    key = _random.take_key() if stochastic else None
+
+    fn = _jitted(op.name, _hashable_attrs(attrs), len(raw), is_train,
+                 key is not None)
+    outs = fn(key, *raw)
+
+    n_vis = op.get_num_outputs(attrs)
+    n_aux = len(aux_names)
+    vis = outs[:n_vis]
+    aux_updates = outs[n_vis:n_vis + n_aux]
+    mutate_updates = outs[n_vis + n_aux:]
+
+    # write aux/mutate updates back through the passed handles (reference
+    # FMutateInputs semantics: BatchNorm moving stats, optimizer state)
+    aux_handles = handles[len(arg_names):]
+    for h, upd in zip(aux_handles, aux_updates):
+        if isinstance(h, NDArray):
+            h._set_data(upd)
+    for mname, upd in zip(op.mutate, mutate_updates):
+        h = slots.get(mname)
+        if isinstance(h, NDArray):
+            h._set_data(upd)
+
+    out_arrays = [NDArray(o) for o in vis]
+    if out is not None:
+        targets = out if isinstance(out, (tuple, list)) else [out]
+        for t, o in zip(targets, out_arrays):
+            t._set_data(o.data.astype(t.dtype))
+        out_arrays = list(targets)
+
+    if autograd.is_recording():
+        autograd._record_op(op, attrs, handles, out_arrays, key)
+
+    return out_arrays[0] if len(out_arrays) == 1 else out_arrays
+
+
+def _make_nd_function(op: Operator):
+    def fn(*args, **kwargs):
+        return imperative_invoke(op.name, *args, **kwargs)
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def generate_nd_functions():
+    """Build {name: callable} for every registered op + alias."""
+    fns = {}
+    for name in list_ops():
+        op = get_op(name)
+        fns[name] = _make_nd_function(op)
+    for alias, target in registry._ALIASES.items():
+        fns[alias] = fns[target]
+    return fns

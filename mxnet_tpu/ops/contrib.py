@@ -1,0 +1,293 @@
+"""Contrib ops: SSD MultiBox family, CTC, quantization, FFT.
+
+Reference: ``src/operator/contrib/`` — MultiBoxPrior/Target/Detection
+(`contrib/multibox_prior.cc:78` etc., the SSD ops), CTCLoss, quantize ops.
+The MultiBox ops are the reference's most data-dependent kernels (box
+matching, NMS); here they are expressed with masked dense jnp ops so they
+compile under jit with static shapes — Pallas variants can replace the hot
+paths later without API change.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, dtype_np
+from .registry import register
+
+
+@register("_contrib_MultiBoxPrior",
+          params={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                  "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)},
+          aliases=("MultiBoxPrior",))
+def multibox_prior(attrs, ctx, data):
+    """Anchor box generation.  Reference: src/operator/contrib/multibox_prior.cc.
+
+    data: [N, C, H, W] feature map; returns [1, H*W*num_anchors, 4] corners.
+    """
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(attrs["sizes"]) if isinstance(attrs["sizes"], (tuple, list)) \
+        else (attrs["sizes"],)
+    ratios = tuple(attrs["ratios"]) if isinstance(attrs["ratios"], (tuple, list)) \
+        else (attrs["ratios"],)
+    steps = attrs["steps"]
+    offs = attrs["offsets"]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offs[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offs[1]) * step_x
+    # anchor set: (s_i, r_0) for all sizes + (s_0, r_j) for ratios[1:]
+    whs = [(s * (h / float(w)) ** 0 * jnp.sqrt(ratios[0]),
+            s / jnp.sqrt(ratios[0])) for s in sizes]
+    whs += [(sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r))
+            for r in ratios[1:]]
+    ws = jnp.asarray([p[0] for p in whs], jnp.float32)
+    hs = jnp.asarray([p[1] for p in whs], jnp.float32)
+    CY, CX = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([CX.ravel(), CY.ravel()], axis=-1)  # [HW, 2]
+    half = jnp.stack([ws, hs], axis=-1) / 2.0               # [A, 2]
+    mins = centers[:, None, :] - half[None, :, :]
+    maxs = centers[:, None, :] + half[None, :, :]
+    boxes = jnp.concatenate([mins, maxs], axis=-1).reshape((-1, 4))
+    if attrs["clip"]:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes[None]
+
+
+def _iou(boxes_a, boxes_b):
+    """Pairwise IoU of corner boxes [A,4] x [B,4] -> [A,B]."""
+    tl = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    br = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((boxes_a[:, 2] - boxes_a[:, 0])
+                         * (boxes_a[:, 3] - boxes_a[:, 1]), 0.0)
+    area_b = jnp.maximum((boxes_b[:, 2] - boxes_b[:, 0])
+                         * (boxes_b[:, 3] - boxes_b[:, 1]), 0.0)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-12)
+
+
+@register("_contrib_MultiBoxTarget",
+          arg_names=("anchor", "label", "cls_pred"),
+          num_outputs=3,
+          params={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                  "negative_mining_ratio": -1.0, "negative_mining_thresh": 0.5,
+                  "minimum_negative_samples": 0, "variances": (0.1, 0.1, 0.2, 0.2)},
+          aliases=("MultiBoxTarget",))
+def multibox_target(attrs, ctx, anchor, label, cls_pred):
+    """Anchor matching + target encoding.
+
+    Reference: src/operator/contrib/multibox_target.cc.  Dense-masked
+    formulation: per-batch [A] anchors matched against [M] padded GT boxes
+    (label rows with id < 0 are padding), vmapped over the batch.
+    Returns (loc_target [N, A*4], loc_mask [N, A*4], cls_target [N, A]).
+    """
+    variances = jnp.asarray(attrs["variances"], jnp.float32)
+    thresh = float(attrs["overlap_threshold"])
+    anchors = anchor.reshape((-1, 4))
+
+    def one(lab, pred):
+        ids = lab[:, 0]
+        valid = ids >= 0
+        gt = lab[:, 1:5]
+        iou = _iou(anchors, gt)                        # [A, M]
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)              # per anchor
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)          # [M]
+        forced = jnp.zeros(anchors.shape[0], bool)
+        forced = forced.at[best_anchor].set(valid)
+        claimed_gt = jnp.zeros(anchors.shape[0], jnp.int32)
+        claimed_gt = claimed_gt.at[best_anchor].set(
+            jnp.where(valid, jnp.arange(lab.shape[0]), 0).astype(jnp.int32))
+        pos = forced | (best_iou >= thresh)
+        match = jnp.where(forced, claimed_gt, best_gt)
+        g = gt[match]
+        # encode offsets (corner->center form), as the reference does
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        loc = jnp.stack([(gcx - acx) / (aw * variances[0]),
+                         (gcy - acy) / (ah * variances[1]),
+                         jnp.log(gw / aw) / variances[2],
+                         jnp.log(gh / ah) / variances[3]], axis=-1)
+        loc = jnp.where(pos[:, None], loc, 0.0)
+        mask = jnp.where(pos[:, None], 1.0, 0.0)
+        mask = jnp.broadcast_to(mask, loc.shape)
+        cls_t = jnp.where(pos, ids[match] + 1.0, 0.0)
+        return loc.reshape(-1), mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label.astype(jnp.float32),
+                                        cls_pred.astype(jnp.float32))
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection",
+          arg_names=("cls_prob", "loc_pred", "anchor"),
+          params={"clip": True, "threshold": 0.01, "background_id": 0,
+                  "nms_threshold": 0.5, "force_suppress": False,
+                  "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1},
+          aliases=("MultiBoxDetection",))
+def multibox_detection(attrs, ctx, cls_prob, loc_pred, anchor):
+    """Decode + class-wise NMS, static-shape (masked) formulation.
+
+    Reference: src/operator/contrib/multibox_detection.cc.  Returns
+    [N, A, 6] rows (class_id, score, xmin, ymin, xmax, ymax); suppressed
+    rows have class_id -1 (reference convention).
+    """
+    variances = jnp.asarray(attrs["variances"], jnp.float32)
+    anchors = anchor.reshape((-1, 4))
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    bg = int(attrs["background_id"])
+    thr = float(attrs["threshold"])
+    nms_thr = float(attrs["nms_threshold"])
+    force = bool(attrs["force_suppress"])
+
+    def one(probs, loc):
+        loc = loc.reshape((-1, 4))
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if attrs["clip"]:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        cls = jnp.argmax(probs, axis=0)
+        # mask out background / low scores
+        score_nobg = jnp.where(cls == bg, 0.0, jnp.max(probs, axis=0))
+        keep = score_nobg > thr
+        order = jnp.argsort(-score_nobg)
+        boxes_o = boxes[order]
+        cls_o = cls[order]
+        score_o = score_nobg[order]
+        keep_o = keep[order]
+        iou = _iou(boxes_o, boxes_o)
+        same_class = (cls_o[:, None] == cls_o[None, :]) | force
+        # greedy NMS as a scan over score-sorted boxes
+        def body(alive, i):
+            sup = (iou[i] > nms_thr) & same_class[i] & (jnp.arange(iou.shape[0]) > i)
+            alive = jnp.where(alive[i], alive & ~sup, alive)
+            return alive, None
+        alive, _ = lax.scan(body, keep_o, jnp.arange(boxes_o.shape[0]))
+        # reference convention: class ids exclude background (shift down when
+        # background_id == 0); suppressed rows get -1
+        shift = 1.0 if bg == 0 else 0.0
+        out_cls = jnp.where(alive, cls_o.astype(jnp.float32) - shift, -1.0)
+        out = jnp.concatenate([out_cls[:, None], score_o[:, None], boxes_o],
+                              axis=-1)
+        return out
+
+    return jax.vmap(one)(cls_prob.astype(jnp.float32),
+                         loc_pred.astype(jnp.float32))
+
+
+@register("_contrib_CTCLoss", arg_names=("data", "label"),
+          num_outputs=1, params={"use_data_lengths": False,
+                                 "use_label_lengths": False, "blank_label": "first"},
+          aliases=("CTCLoss", "ctc_loss"), is_loss=True)
+def ctc_loss(attrs, ctx, data, label):
+    """CTC loss (reference: src/operator/contrib/ctc_loss.cc via warpctc).
+
+    data: [T, B, V] unnormalized activations; label: [B, L] padded with 0
+    (blank is class 0, 'first').  Dense log-alpha forward recursion under scan.
+    """
+    T, B, V = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    labels = label.astype(jnp.int32)
+    L = labels.shape[1]
+    blank = 0 if attrs["blank_label"] == "first" else V - 1
+    if blank != 0:
+        raise MXNetError("only blank_label='first' supported")
+    # label lengths: count of entries > 0 (reference padding convention)
+    lab_len = jnp.sum((labels > 0).astype(jnp.int32), axis=1)
+    # extended label sequence with interleaved blanks: length 2L+1
+    S = 2 * L + 1
+    ext = jnp.zeros((B, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = -1e30
+
+    def forward_b(logp_b, ext_b, lab_len_b):
+        s_len = 2 * lab_len_b + 1
+        alpha0 = jnp.full((S,), neg_inf)
+        alpha0 = alpha0.at[0].set(logp_b[0, blank])
+        alpha0 = alpha0.at[1].set(jnp.where(lab_len_b > 0,
+                                            logp_b[0, ext_b[1]], neg_inf))
+
+        def step(alpha, logp_t):
+            prev1 = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
+            prev2 = jnp.concatenate([jnp.array([neg_inf, neg_inf]), alpha[:-2]])
+            idx = jnp.arange(S)
+            can_skip = (idx % 2 == 1) & (idx >= 2)
+            same = jnp.where(idx >= 2, ext_b == jnp.roll(ext_b, 2), True)
+            allow2 = can_skip & ~same
+            a = jnp.logaddexp(alpha, prev1)
+            a = jnp.where(allow2, jnp.logaddexp(a, prev2), a)
+            a = a + logp_t[ext_b]
+            a = jnp.where(idx < s_len, a, neg_inf)
+            return a, None
+
+        alphaT, _ = lax.scan(step, alpha0, logp_b[1:])
+        last = alphaT[jnp.maximum(s_len - 1, 0)]
+        last2 = jnp.where(s_len >= 2, alphaT[jnp.maximum(s_len - 2, 0)], neg_inf)
+        return -jnp.logaddexp(last, last2)
+
+    return jax.vmap(forward_b)(jnp.swapaxes(logp, 0, 1), ext, lab_len)
+
+
+@register("_contrib_quantize", arg_names=("data", "min_range", "max_range"),
+          num_outputs=3, params={"out_type": "uint8"})
+def quantize(attrs, ctx, data, min_range, max_range):
+    """Reference: src/operator/contrib/quantize.cc."""
+    out_dt = dtype_np(attrs["out_type"])
+    qmin = float(jnp.iinfo(out_dt).min)
+    qmax = float(jnp.iinfo(out_dt).max)
+    scale = (qmax - qmin) / (max_range - min_range)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(out_dt), min_range, max_range
+
+
+@register("_contrib_dequantize", arg_names=("data", "min_range", "max_range"),
+          params={"out_type": "float32"})
+def dequantize(attrs, ctx, data, min_range, max_range):
+    info = jnp.iinfo(data.dtype)
+    scale = (max_range - min_range) / (float(info.max) - float(info.min))
+    return ((data.astype(jnp.float32) - float(info.min)) * scale
+            + min_range).astype(dtype_np(attrs["out_type"]))
+
+
+@register("_contrib_fft", params={"compute_size": 128})
+def fft(attrs, ctx, data):
+    """Reference: src/operator/contrib/fft.cc — rfft packed as interleaved re/im."""
+    out = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],)).astype(jnp.float32)
+
+
+@register("_contrib_ifft", params={"compute_size": 128})
+def ifft(attrs, ctx, data):
+    re = data[..., 0::2]
+    im = data[..., 1::2]
+    out = jnp.fft.ifft(re + 1j * im, axis=-1)
+    return out.real.astype(jnp.float32)
+
+
+@register("_contrib_count_sketch", arg_names=("data", "h", "s"),
+          params={"out_dim": 0, "processing_batch_size": 32})
+def count_sketch(attrs, ctx, data, h, s):
+    """Reference: src/operator/contrib/count_sketch.cc."""
+    out_dim = int(attrs["out_dim"])
+    idx = h.astype(jnp.int32).reshape(-1)
+    sign = s.astype(data.dtype).reshape(-1)
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., idx].add(data * sign)

@@ -1,0 +1,642 @@
+"""Neural-network layer ops (the reference's `src/operator/*-inl.h` corpus).
+
+Each op is a pure JAX function over jnp/lax; layout is NCHW to match the
+reference default.  Convs and matmuls are expressed with
+``lax.conv_general_dilated`` / ``jnp.dot`` so XLA tiles them onto the MXU;
+elementwise pieces are left for XLA to fuse.
+
+Reference citations per op are in each docstring.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        t = tuple(int(x) for x in v)
+        return t if len(t) == n else t * n if len(t) == 1 else t
+    return (int(v),) * n
+
+
+# --------------------------------------------------------------------- dense
+@register("FullyConnected", arg_names=lambda a: ("data", "weight") if a["no_bias"]
+          else ("data", "weight", "bias"),
+          params={"num_hidden": 0, "no_bias": False, "flatten": True},
+          aliases=("fully_connected",))
+def fully_connected(attrs, ctx, data, weight, bias=None):
+    """Y = X W^T + b.  Reference: src/operator/fully_connected-inl.h:48-145."""
+    if attrs["flatten"]:
+        x = data.reshape((data.shape[0], -1))
+    else:
+        x = data
+    # accumulate in f32 on the MXU regardless of input dtype
+    y = jnp.dot(x, weight.T, preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias
+    return y.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------- conv
+@register("Convolution", arg_names=lambda a: ("data", "weight") if a["no_bias"]
+          else ("data", "weight", "bias"),
+          params={"kernel": (1, 1), "stride": (), "dilate": (), "pad": (),
+                  "num_filter": 0, "num_group": 1, "no_bias": False,
+                  "workspace": 1024, "cudnn_tune": None, "cudnn_off": False,
+                  "layout": None},
+          aliases=("convolution", "Convolution_v1"))
+def convolution(attrs, ctx, data, weight, bias=None):
+    """N-d convolution, NCHW/NCW/NCDHW.  Reference: src/operator/convolution-inl.h:103-325.
+
+    Weight layout (num_filter, C/group, *kernel) as in the reference; lowered
+    to one lax.conv_general_dilated so XLA maps it onto the MXU.
+    """
+    kernel = tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = tuple(attrs["stride"]) or (1,) * nd
+    dilate = tuple(attrs["dilate"]) or (1,) * nd
+    pad = tuple(attrs["pad"]) or (0,) * nd
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else
+        ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
+    y = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=int(attrs["num_group"]),
+        preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y.astype(data.dtype)
+
+
+@register("Deconvolution", arg_names=lambda a: ("data", "weight") if a["no_bias"]
+          else ("data", "weight", "bias"),
+          params={"kernel": (1, 1), "stride": (), "dilate": (), "pad": (),
+                  "adj": (), "target_shape": (), "num_filter": 0,
+                  "num_group": 1, "no_bias": True, "workspace": 512,
+                  "cudnn_tune": None, "cudnn_off": False, "layout": None})
+def deconvolution(attrs, ctx, data, weight, bias=None):
+    """Transposed convolution.  Reference: src/operator/deconvolution-inl.h.
+
+    Implemented as conv_general_dilated with lhs_dilation (the XLA-native
+    formulation of conv-transpose).  Weight layout (C_in, C_out/group, *k).
+    """
+    kernel = tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = tuple(attrs["stride"]) or (1,) * nd
+    pad = tuple(attrs["pad"]) or (0,) * nd
+    adj = tuple(attrs["adj"]) or (0,) * nd
+    groups = int(attrs["num_group"])
+    # flip spatial dims and swap in/out channels -> direct conv on dilated input
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        ci, co = weight.shape[0], weight.shape[1]
+        w = w.reshape((groups, ci // groups, co) + kernel)
+        w = jnp.swapaxes(w, 1, 2).reshape((groups * co, ci // groups) + kernel)
+    dn = lax.conv_dimension_numbers(
+        data.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if nd == 2 else
+        ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
+    padding = [(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
+               for i in range(nd)]
+    y = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y.astype(data.dtype)
+
+
+# ------------------------------------------------------------------- pooling
+@register("Pooling",
+          params={"kernel": (1, 1), "pool_type": "max", "global_pool": False,
+                  "stride": (), "pad": (), "pooling_convention": "valid",
+                  "cudnn_off": False},
+          aliases=("pooling", "Pooling_v1"))
+def pooling(attrs, ctx, data):
+    """Max/avg/sum pooling via lax.reduce_window.
+
+    Reference: src/operator/pooling-inl.h (+pooling.cc registration).
+    """
+    nd = data.ndim - 2
+    if attrs["global_pool"]:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = _pair(attrs["kernel"], nd)
+        # reference defaults stride to 1 (pooling-inl.h), NOT to the kernel
+        stride = tuple(attrs["stride"]) or (1,) * nd
+        pad = tuple(attrs["pad"]) or (0,) * nd
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    conv = attrs.get("pooling_convention", "valid")
+    padding = [(0, 0), (0, 0)]
+    for i in range(nd):
+        lo = hi = pad[i]
+        if conv == "full":
+            # ceil division convention: pad extra on the high side as needed
+            in_sz = data.shape[2 + i] + 2 * pad[i]
+            rem = (in_sz - kernel[i]) % stride[i]
+            if rem:
+                hi += stride[i] - rem
+        padding.append((lo, hi))
+    ptype = attrs["pool_type"]
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, padding)
+    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                               window, strides, padding)
+    if ptype == "sum":
+        return summed
+    if ptype == "avg":
+        # reference divides by full window size (count_include_pad)
+        wsize = 1
+        for k in kernel:
+            wsize *= k
+        return (summed / wsize).astype(data.dtype)
+    raise MXNetError(f"unknown pool_type {ptype}")
+
+
+# ---------------------------------------------------------------- batch norm
+@register("BatchNorm",
+          arg_names=("data", "gamma", "beta"),
+          aux_names=("moving_mean", "moving_var"),
+          num_outputs=lambda a: 3 if a.get("output_mean_var") else 1,
+          params={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                  "use_global_stats": False, "output_mean_var": False,
+                  "axis": 1, "cudnn_off": False},
+          aliases=("batch_norm", "BatchNorm_v1"))
+def batch_norm(attrs, ctx, data, gamma, beta, moving_mean, moving_var):
+    """Batch normalization with functional aux-state threading.
+
+    Reference: src/operator/batch_norm-inl.h / batch_norm.cc.  The reference
+    mutates moving_{mean,var} aux states in forward during training; here the
+    updated stats are returned as trailing outputs and threaded by the
+    executor (SURVEY §7 'hard parts': aux state).
+    Returns (out[, mean, var], new_moving_mean, new_moving_var).
+    """
+    axis = int(attrs["axis"])
+    eps = float(attrs["eps"])
+    momentum = float(attrs["momentum"])
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(1 if i != axis else data.shape[axis]
+                   for i in range(data.ndim))
+    if attrs["fix_gamma"]:
+        gamma = jnp.ones_like(gamma)
+    xf = data.astype(jnp.float32)
+    if ctx.is_train and not attrs["use_global_stats"]:
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = out.astype(data.dtype)
+    if attrs.get("output_mean_var"):
+        return (out, mean, var,
+                new_mean.astype(moving_mean.dtype), new_var.astype(moving_var.dtype))
+    return (out, new_mean.astype(moving_mean.dtype), new_var.astype(moving_var.dtype))
+
+
+@register("InstanceNorm", arg_names=("data", "gamma", "beta"),
+          params={"eps": 1e-3})
+def instance_norm(attrs, ctx, data, gamma, beta):
+    """Reference: src/operator/instance_norm-inl.h."""
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * lax.rsqrt(var + attrs["eps"])
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization", params={"eps": 1e-10, "mode": "instance"})
+def l2_normalization(attrs, ctx, data):
+    """Reference: src/operator/l2_normalization-inl.h."""
+    mode = attrs["mode"]
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        keep = True
+    elif mode == "channel":
+        red, keep = (1,), True
+    elif mode == "spatial":
+        red, keep = tuple(range(2, data.ndim)), True
+    else:
+        raise MXNetError(f"unknown mode {mode}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=keep)
+                    + attrs["eps"])
+    return data / norm
+
+
+@register("LRN", params={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5})
+def lrn(attrs, ctx, data):
+    """Local response norm across channels.  Reference: src/operator/lrn-inl.h."""
+    nsize = int(attrs["nsize"])
+    sq = jnp.square(data.astype(jnp.float32))
+    pre = nsize // 2
+    post = nsize - pre - 1
+    padded = jnp.pad(sq, [(0, 0), (pre, post)] + [(0, 0)] * (data.ndim - 2))
+    acc = sum(lax.slice_in_dim(padded, i, i + data.shape[1], axis=1)
+              for i in range(nsize))
+    scale = attrs["knorm"] + (attrs["alpha"] / nsize) * acc
+    return (data * scale ** (-attrs["beta"])).astype(data.dtype)
+
+
+# ------------------------------------------------------------- activations
+@register("Activation", params={"act_type": "relu"}, aliases=("activation",))
+def activation(attrs, ctx, data):
+    """Reference: src/operator/activation-inl.h; functors mshadow_op.h."""
+    t = attrs["act_type"]
+    if t == "relu":
+        return jax.nn.relu(data)
+    if t == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if t == "tanh":
+        return jnp.tanh(data)
+    if t == "softrelu":
+        return jax.nn.softplus(data)
+    if t == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise MXNetError(f"unknown act_type {t}")
+
+
+@register("LeakyReLU", arg_names=lambda a: ("data", "gamma")
+          if a["act_type"] == "prelu" else ("data",),
+          params={"act_type": "leaky", "slope": 0.25,
+                  "lower_bound": 0.125, "upper_bound": 0.334},
+          stochastic=lambda a: a["act_type"] == "rrelu")
+def leaky_relu(attrs, ctx, data, gamma=None):
+    """Reference: src/operator/leaky_relu-inl.h."""
+    t = attrs["act_type"]
+    if t == "leaky":
+        return jnp.where(data > 0, data, data * attrs["slope"])
+    if t == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, data * g)
+    if t == "elu":
+        return jnp.where(data > 0, data, attrs["slope"] * (jnp.exp(data) - 1))
+    if t == "rrelu":
+        if ctx.is_train:
+            lo, hi = attrs["lower_bound"], attrs["upper_bound"]
+            slope = jax.random.uniform(ctx.require_key(),
+                                       data.shape, data.dtype, lo, hi)
+        else:
+            slope = (attrs["lower_bound"] + attrs["upper_bound"]) / 2.0
+        return jnp.where(data > 0, data, data * slope)
+    raise MXNetError(f"unknown act_type {t}")
+
+
+@register("Dropout", params={"p": 0.5, "mode": "training"}, stochastic=True,
+          aliases=("dropout",))
+def dropout(attrs, ctx, data):
+    """Inverted dropout.  Reference: src/operator/dropout-inl.h."""
+    p = float(attrs["p"])
+    if not ctx.is_train or p <= 0.0:
+        return data
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(ctx.require_key(), keep, data.shape)
+    return jnp.where(mask, data / keep, 0).astype(data.dtype)
+
+
+# ------------------------------------------------------------------ softmax
+def _softmax(x, axis):
+    return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
+
+
+@register("softmax", params={"axis": -1, "temperature": None})
+def softmax_op(attrs, ctx, data):
+    """Reference: softmax in src/operator/nn-era tensor ops (softmax_output.cc kin)."""
+    x = data
+    if attrs.get("temperature"):
+        x = x / attrs["temperature"]
+    return _softmax(x, int(attrs["axis"]))
+
+
+@register("log_softmax", params={"axis": -1})
+def log_softmax_op(attrs, ctx, data):
+    return jax.nn.log_softmax(data.astype(jnp.float32),
+                              axis=int(attrs["axis"])).astype(data.dtype)
+
+
+@register("SoftmaxActivation", params={"mode": "instance"})
+def softmax_activation(attrs, ctx, data):
+    """Reference: src/operator/softmax_activation-inl.h."""
+    if attrs["mode"] == "channel":
+        return _softmax(data, 1)
+    return _softmax(data.reshape((data.shape[0], -1)), -1).reshape(data.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_output(data, label, grad_scale, multi_output, use_ignore,
+                    ignore_label, normalization):
+    axis = 1 if multi_output else -1
+    return _softmax(data, axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, multi_output, use_ignore,
+                        ignore_label, normalization):
+    out = _softmax_output(data, label, grad_scale, multi_output, use_ignore,
+                          ignore_label, normalization)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, multi_output, use_ignore, ignore_label,
+                        normalization, res, g):
+    # Reference backward (src/operator/softmax_output-inl.h): grad = p - onehot,
+    # ignoring the incoming head gradient (it is a terminal loss op).
+    out, label = res
+    axis = 1 if multi_output else -1
+    nclass = out.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, nclass, dtype=jnp.float32, axis=axis)
+    grad = out.astype(jnp.float32) - onehot
+    valid = None
+    if use_ignore:
+        keep = (lab != int(ignore_label))
+        keepb = jnp.expand_dims(keep, axis=axis)
+        grad = grad * keepb
+        valid = jnp.maximum(jnp.sum(keep), 1)
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid" and valid is not None:
+        scale = scale / valid
+    elif normalization == "valid":
+        scale = scale / lab.size
+    return (grad * scale).astype(out.dtype), jnp.zeros_like(label)
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", arg_names=("data", "label"),
+          params={"grad_scale": 1.0, "ignore_label": -1.0, "multi_output": False,
+                  "use_ignore": False, "preserve_shape": False,
+                  "normalization": "null", "out_grad": False,
+                  "smooth_alpha": 0.0},
+          is_loss=True, aliases=("Softmax",))
+def softmax_output(attrs, ctx, data, label):
+    """Softmax forward + cross-entropy-style custom backward.
+
+    Reference: src/operator/softmax_output.cc:32,114 (+`Softmax` deprecated
+    alias) — forward is softmax; backward is (p - onehot(label)) * grad_scale
+    regardless of head grad.
+    """
+    return _softmax_output(data, label, float(attrs["grad_scale"]),
+                           bool(attrs["multi_output"]), bool(attrs["use_ignore"]),
+                           float(attrs["ignore_label"]), attrs["normalization"])
+
+
+def _head_grad_op(fwd_fn, bwd_fn):
+    """Build a custom_vjp op whose backward ignores the head gradient."""
+    f = jax.custom_vjp(fwd_fn)
+    f.defvjp(lambda *args: (fwd_fn(*args), args), bwd_fn)
+    return f
+
+
+_linreg = _head_grad_op(
+    lambda data, label: data,
+    lambda res, g: ((res[0] - res[1].reshape(res[0].shape)).astype(res[0].dtype),
+                    jnp.zeros_like(res[1])))
+_maereg = _head_grad_op(
+    lambda data, label: data,
+    lambda res, g: (jnp.sign(res[0] - res[1].reshape(res[0].shape)).astype(res[0].dtype),
+                    jnp.zeros_like(res[1])))
+_logreg = _head_grad_op(
+    lambda data, label: jax.nn.sigmoid(data),
+    lambda res, g: ((jax.nn.sigmoid(res[0]) - res[1].reshape(res[0].shape)).astype(res[0].dtype),
+                    jnp.zeros_like(res[1])))
+
+
+@register("LinearRegressionOutput", arg_names=("data", "label"),
+          params={"grad_scale": 1.0}, is_loss=True)
+def linear_regression_output(attrs, ctx, data, label):
+    """Reference: src/operator/regression_output-inl.h (grad = pred - label)."""
+    return _linreg(data, label)
+
+
+@register("MAERegressionOutput", arg_names=("data", "label"),
+          params={"grad_scale": 1.0}, is_loss=True)
+def mae_regression_output(attrs, ctx, data, label):
+    return _maereg(data, label)
+
+
+@register("LogisticRegressionOutput", arg_names=("data", "label"),
+          params={"grad_scale": 1.0}, is_loss=True)
+def logistic_regression_output(attrs, ctx, data, label):
+    return _logreg(data, label)
+
+
+@register("SVMOutput", arg_names=("data", "label"),
+          params={"margin": 1.0, "regularization_coefficient": 1.0,
+                  "use_linear": False}, is_loss=True)
+def svm_output(attrs, ctx, data, label):
+    """Reference: src/operator/svm_output-inl.h."""
+    margin = float(attrs["margin"])
+    reg = float(attrs["regularization_coefficient"])
+    use_linear = bool(attrs["use_linear"])
+
+    def bwd(res, g):
+        x, lab = res
+        n = x.shape[-1]
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), n, dtype=x.dtype)
+        score_correct = jnp.sum(x * onehot, axis=-1, keepdims=True)
+        if use_linear:
+            viol = ((margin - (2 * onehot - 1) * x) > 0).astype(x.dtype)
+            grad = -(2 * onehot - 1) * viol * reg
+        else:
+            viol = ((x - score_correct + margin) > 0).astype(x.dtype) * (1 - onehot)
+            grad = viol - onehot * jnp.sum(viol, axis=-1, keepdims=True)
+            grad = grad * reg
+        return grad, jnp.zeros_like(lab)
+
+    f = _head_grad_op(lambda d, l: d, bwd)
+    return f(data, label)
+
+
+@register("MakeLoss", params={"grad_scale": 1.0, "valid_thresh": 0.0,
+                              "normalization": "null"}, is_loss=True)
+def make_loss(attrs, ctx, data):
+    """Forward identity; backward = grad_scale (loss source).
+
+    Reference: src/operator/make_loss-inl.h.
+    """
+    scale = float(attrs["grad_scale"])
+    norm = attrs["normalization"]
+
+    def bwd(res, g):
+        (x,) = res
+        s = scale / x.shape[0] if norm == "batch" else scale
+        return (jnp.full_like(x, s),)
+
+    f = _head_grad_op(lambda d: d, bwd)
+    return f(data)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(attrs, ctx, data):
+    """Reference: src/operator/slice_channel / blockgrad op — stops gradients."""
+    return lax.stop_gradient(data)
+
+
+# ----------------------------------------------------------------- shape ops
+@register("Flatten", aliases=("flatten",))
+def flatten_op(attrs, ctx, data):
+    """Reference: reshape family in src/operator/tensor/matrix_op.cc."""
+    return data.reshape((data.shape[0], -1))
+
+
+@register("Concat", arg_names=lambda a: tuple(f"arg{i}" for i in range(int(a["num_args"]))),
+          params={"num_args": 1, "dim": 1}, key_var_num_args="num_args",
+          aliases=("concat",))
+def concat(attrs, ctx, *args):
+    """Reference: src/operator/concat-inl.h."""
+    return jnp.concatenate(args, axis=int(attrs["dim"]))
+
+
+@register("SliceChannel",
+          num_outputs=lambda a: int(a["num_outputs"]),
+          params={"num_outputs": 1, "axis": 1, "squeeze_axis": False},
+          aliases=("split",))
+def slice_channel(attrs, ctx, data):
+    """Reference: src/operator/slice_channel-inl.h."""
+    parts = jnp.split(data, int(attrs["num_outputs"]), axis=int(attrs["axis"]))
+    if attrs["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=int(attrs["axis"])) for p in parts]
+    return tuple(parts)
+
+
+@register("Embedding", arg_names=("data", "weight"),
+          params={"input_dim": 0, "output_dim": 0, "dtype": "float32"})
+def embedding(attrs, ctx, data, weight):
+    """Reference: src/operator/tensor/indexing_op.cc Embedding."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("Pad", params={"mode": "constant", "pad_width": (), "constant_value": 0.0})
+def pad_op(attrs, ctx, data):
+    """Reference: src/operator/pad-inl.h."""
+    pw = tuple(attrs["pad_width"])
+    pairs = [(int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(len(pw) // 2)]
+    mode = attrs["mode"]
+    if mode == "constant":
+        return jnp.pad(data, pairs, constant_values=attrs["constant_value"])
+    if mode == "edge":
+        return jnp.pad(data, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pairs, mode="reflect")
+    raise MXNetError(f"unknown pad mode {mode}")
+
+
+@register("UpSampling",
+          arg_names=lambda a: tuple(f"arg{i}" for i in range(int(a["num_args"]))),
+          params={"scale": 1, "num_filter": 0, "sample_type": "nearest",
+                  "multi_input_mode": "concat", "num_args": 1, "workspace": 512},
+          key_var_num_args="num_args")
+def upsampling(attrs, ctx, *args):
+    """Nearest-neighbour upsampling.  Reference: src/operator/upsampling-inl.h."""
+    scale = int(attrs["scale"])
+    outs = []
+    target = args[0].shape[2] * scale
+    for a in args:
+        s = target // a.shape[2]
+        out = jnp.repeat(jnp.repeat(a, s, axis=2), s, axis=3)
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    if attrs["multi_input_mode"] == "sum":
+        return sum(outs)
+    return jnp.concatenate(outs, axis=1)
+
+
+@register("Crop", arg_names=lambda a: tuple(f"arg{i}" for i in range(int(a["num_args"]))),
+          params={"num_args": 1, "offset": (0, 0), "h_w": (0, 0),
+                  "center_crop": False},
+          key_var_num_args="num_args")
+def crop(attrs, ctx, *args):
+    """Reference: src/operator/crop-inl.h."""
+    data = args[0]
+    if len(args) == 2:
+        h, w = args[1].shape[2], args[1].shape[3]
+    else:
+        h, w = attrs["h_w"]
+    if attrs["center_crop"]:
+        oh = (data.shape[2] - h) // 2
+        ow = (data.shape[3] - w) // 2
+    else:
+        oh, ow = attrs["offset"]
+    return lax.dynamic_slice(data, (0, 0, oh, ow),
+                             (data.shape[0], data.shape[1], h, w))
+
+
+@register("SwapAxis", params={"dim1": 0, "dim2": 0}, aliases=("swapaxes",))
+def swapaxis(attrs, ctx, data):
+    """Reference: src/operator/swapaxis-inl.h."""
+    return jnp.swapaxes(data, int(attrs["dim1"]), int(attrs["dim2"]))
+
+
+# -------------------------------------------------------------- sequence ops
+def _seq_mask(data, length, batch_axis, time_axis):
+    steps = jnp.arange(data.shape[time_axis])
+    mshape = [1] * data.ndim
+    mshape[time_axis] = data.shape[time_axis]
+    mask = steps.reshape(mshape) < jnp.reshape(
+        length, [data.shape[batch_axis] if i == batch_axis else 1
+                 for i in range(data.ndim)])
+    return mask
+
+
+@register("SequenceMask", arg_names=lambda a: ("data", "sequence_length")
+          if a["use_sequence_length"] else ("data",),
+          params={"use_sequence_length": False, "value": 0.0, "axis": 0})
+def sequence_mask(attrs, ctx, data, sequence_length=None):
+    """Reference: src/operator/sequence_mask-inl.h (time-major [T,B,...])."""
+    if sequence_length is None:
+        return data
+    mask = _seq_mask(data, sequence_length, batch_axis=1, time_axis=0)
+    return jnp.where(mask, data, jnp.asarray(attrs["value"], data.dtype))
+
+
+@register("SequenceLast", arg_names=lambda a: ("data", "sequence_length")
+          if a["use_sequence_length"] else ("data",),
+          params={"use_sequence_length": False, "axis": 0})
+def sequence_last(attrs, ctx, data, sequence_length=None):
+    """Reference: src/operator/sequence_last-inl.h."""
+    if sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", arg_names=lambda a: ("data", "sequence_length")
+          if a["use_sequence_length"] else ("data",),
+          params={"use_sequence_length": False, "axis": 0})
+def sequence_reverse(attrs, ctx, data, sequence_length=None):
+    """Reference: src/operator/sequence_reverse-inl.h."""
+    if sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T).reshape((T, 1))
+    lens = sequence_length.astype(jnp.int32).reshape((1, -1))
+    src = jnp.where(steps < lens, lens - 1 - steps, steps)  # [T, B]
+    src = src.reshape((T, -1) + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, src, axis=0)

@@ -1,0 +1,87 @@
+"""Fused optimizer update ops.
+
+Reference: ``src/operator/optimizer_op.cc:18-161`` (sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, rmspropalex_update).  Each op is
+a single jitted elementwise fusion over (weight, grad, state...) returning the
+updated tensors; XLA fuses the whole update into one HBM pass.  The
+imperative wrappers write through ``out=`` handles, matching the reference's
+in-place update semantics used by `python/mxnet/optimizer.py:308-356`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0}
+
+
+def _prep_grad(grad, weight, attrs):
+    g = grad.astype(jnp.float32) * attrs["rescale_grad"]
+    if attrs["clip_gradient"] > 0:
+        g = jnp.clip(g, -attrs["clip_gradient"], attrs["clip_gradient"])
+    return g + attrs["wd"] * weight.astype(jnp.float32)
+
+
+@register("sgd_update", arg_names=("weight", "grad"), params=dict(_COMMON))
+def sgd_update(attrs, ctx, weight, grad):
+    g = _prep_grad(grad, weight, attrs)
+    return (weight.astype(jnp.float32) - attrs["lr"] * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", arg_names=("weight", "grad", "mom"),
+          params={**_COMMON, "momentum": 0.0}, mutate=("mom",))
+def sgd_mom_update(attrs, ctx, weight, grad, mom):
+    """Returns new_weight; mom is updated in place (reference FMutateInputs)."""
+    g = _prep_grad(grad, weight, attrs)
+    new_mom = attrs["momentum"] * mom.astype(jnp.float32) - attrs["lr"] * g
+    return ((weight.astype(jnp.float32) + new_mom).astype(weight.dtype),
+            new_mom.astype(mom.dtype))
+
+
+@register("adam_update", arg_names=("weight", "grad", "mean", "var"),
+          params={**_COMMON, "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+          mutate=("mean", "var"))
+def adam_update(attrs, ctx, weight, grad, mean, var):
+    """Returns new_weight; mean/var updated in place.
+
+    Matches the reference fused op: no bias correction inside the kernel —
+    the python Optimizer pre-scales lr (optimizer.py Adam.update).
+    """
+    g = _prep_grad(grad, weight, attrs)
+    b1, b2 = attrs["beta1"], attrs["beta2"]
+    m = b1 * mean.astype(jnp.float32) + (1 - b1) * g
+    v = b2 * var.astype(jnp.float32) + (1 - b2) * jnp.square(g)
+    w = weight.astype(jnp.float32) - attrs["lr"] * m / (jnp.sqrt(v) + attrs["epsilon"])
+    return w.astype(weight.dtype), m.astype(mean.dtype), v.astype(var.dtype)
+
+
+@register("rmsprop_update", arg_names=("weight", "grad", "n"),
+          params={**_COMMON, "gamma1": 0.95, "epsilon": 1e-8,
+                  "clip_weights": -1.0}, mutate=("n",))
+def rmsprop_update(attrs, ctx, weight, grad, n):
+    g = _prep_grad(grad, weight, attrs)
+    g1 = attrs["gamma1"]
+    new_n = (1 - g1) * jnp.square(g) + g1 * n.astype(jnp.float32)
+    w = weight.astype(jnp.float32) - attrs["lr"] * g / jnp.sqrt(new_n + attrs["epsilon"])
+    if attrs["clip_weights"] > 0:
+        w = jnp.clip(w, -attrs["clip_weights"], attrs["clip_weights"])
+    return w.astype(weight.dtype), new_n.astype(n.dtype)
+
+
+@register("rmspropalex_update", arg_names=("weight", "grad", "n", "g", "delta"),
+          params={**_COMMON, "gamma1": 0.95, "gamma2": 0.9, "epsilon": 1e-8,
+                  "clip_weights": -1.0}, mutate=("n", "g", "delta"))
+def rmspropalex_update(attrs, ctx, weight, grad, n, g, delta):
+    """RMSProp (Graves 2013 variant); n/g/delta updated in place."""
+    gr = _prep_grad(grad, weight, attrs)
+    g1, g2 = attrs["gamma1"], attrs["gamma2"]
+    new_n = (1 - g1) * jnp.square(gr) + g1 * n.astype(jnp.float32)
+    new_g = (1 - g1) * gr + g1 * g.astype(jnp.float32)
+    new_d = g2 * delta.astype(jnp.float32) - attrs["lr"] * gr / jnp.sqrt(
+        new_n - jnp.square(new_g) + attrs["epsilon"])
+    w = weight.astype(jnp.float32) + new_d
+    if attrs["clip_weights"] > 0:
+        w = jnp.clip(w, -attrs["clip_weights"], attrs["clip_weights"])
+    return (w.astype(weight.dtype), new_n.astype(n.dtype),
+            new_g.astype(g.dtype), new_d.astype(delta.dtype))

@@ -1,0 +1,171 @@
+"""Single-point operator registry.
+
+Reference: the two C++ registries (`include/mxnet/operator.h:566`
+MXNET_REGISTER_OP_PROPERTY and NNVM_REGISTER_OP + FCompute,
+`include/mxnet/op_attr_types.h:56-59`), bridged by
+`src/nnvm/legacy_op_util.cc`.  TPU-native design: ONE registration point per
+op name carrying
+
+* ``fcompute(attrs, op_ctx, *inputs) -> tuple(jnp outputs)`` — a pure JAX
+  function (jnp/lax/pallas).  Outputs include updated auxiliary states at the
+  tail when ``aux_names`` is non-empty (the functional replacement for the
+  reference's FMutateInputs aux mutation).
+* argument/aux name lists (reference OperatorProperty::ListArguments,
+  ListAuxiliaryStates) — may be callables on attrs (e.g. Concat's num_args).
+* typed attr parsing with defaults (reference dmlc::Parameter, SURVEY §5.6).
+
+Shape/type inference is ``jax.eval_shape`` over fcompute — no hand-written
+inference pass (reference FInferShape/FInferType are subsumed by tracing).
+Gradients come from ``jax.vjp`` over the composed graph; ops with
+reference-specified custom backward (SoftmaxOutput, MakeLoss, BlockGrad …)
+embed ``jax.custom_vjp`` in their fcompute.
+
+Imperative (`mx.nd.*`) and symbolic (`mx.sym.*`) functions are both generated
+from this table, mirroring `python/mxnet/ndarray.py:2281-2423` /
+`symbol.py`'s codegen over the C registry.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from ..base import MXNetError
+
+__all__ = ["Operator", "OpContext", "register", "get_op", "list_ops",
+           "parse_attrs"]
+
+_OP_REGISTRY: dict[str, "Operator"] = {}
+_ALIASES: dict[str, str] = {}
+
+
+@dataclass
+class OpContext:
+    """Per-invocation context handed to fcompute.
+
+    Reference ``OpContext`` (`include/mxnet/operator.h:61-78`): is_train +
+    RunContext stream + requested resources.  Here: train flag + a PRNG key
+    (the functional replacement for the kRandom resource,
+    `src/resource.cc:151-186`).
+    """
+    is_train: bool = False
+    key: Optional[object] = None  # jax PRNG key, set for stochastic ops
+
+    def require_key(self):
+        if self.key is None:
+            raise MXNetError("stochastic op invoked without a PRNG key; "
+                             "seed via mx.random.seed / pass key")
+        return self.key
+
+
+@dataclass
+class Operator:
+    name: str
+    fcompute: Callable
+    arg_names: object = ("data",)        # tuple or callable(attrs)->tuple
+    aux_names: object = ()               # tuple or callable(attrs)->tuple
+    num_outputs: object = 1              # int or callable(attrs)->int
+    params: dict = field(default_factory=dict)   # name -> default (typed)
+    stochastic: bool = False             # needs a PRNG key when is_train
+    key_var_num_args: Optional[str] = None  # e.g. 'num_args' for Concat
+    is_loss: bool = False                # output-op (grad source)
+    mutate: Sequence[str] = ()           # input names updated in place
+                                         # (reference FMutateInputs); their new
+                                         # values follow aux in fcompute's output
+    doc: str = ""
+
+    def get_arg_names(self, attrs):
+        a = self.arg_names
+        return list(a(attrs)) if callable(a) else list(a)
+
+    def get_aux_names(self, attrs):
+        a = self.aux_names
+        return list(a(attrs)) if callable(a) else list(a)
+
+    def get_num_outputs(self, attrs):
+        n = self.num_outputs
+        return n(attrs) if callable(n) else n
+
+    def parse_attrs(self, raw):
+        return parse_attrs(self.params, raw, self.name)
+
+
+def _coerce(value, default):
+    """Coerce a possibly-string attr value to the type of its default."""
+    if not isinstance(value, str):
+        return value
+    s = value.strip()
+    if isinstance(default, bool):
+        if s in ("True", "true", "1"):
+            return True
+        if s in ("False", "false", "0"):
+            return False
+    try:
+        v = ast.literal_eval(s)
+        if isinstance(default, tuple) and isinstance(v, (int, float)):
+            return (v,)
+        if isinstance(default, float) and isinstance(v, int):
+            return float(v)
+        return v
+    except (ValueError, SyntaxError):
+        return s  # plain string attr like act_type='relu'
+
+
+def parse_attrs(param_spec, raw, op_name="<op>"):
+    """Parse raw attr dict (values may be strings from JSON) against spec."""
+    out = dict(param_spec)
+    if not raw:
+        return out
+    for k, v in raw.items():
+        if k.startswith("__") and k.endswith("__"):
+            continue  # meta attrs (ctx_group, lr_mult, ...) ride along elsewhere
+        if k not in param_spec:
+            # tolerate unknown attrs (forward/backward compat like the
+            # reference's JSON upgrade pass, legacy_json_util.cc)
+            out[k] = _coerce(v, None)
+            continue
+        out[k] = _coerce(v, param_spec[k])
+    return out
+
+
+def register(name, arg_names=("data",), aux_names=(), num_outputs=1,
+             params=None, stochastic=False, key_var_num_args=None,
+             is_loss=False, mutate=(), aliases=(), doc=""):
+    """Decorator: register ``fcompute`` under ``name`` (+aliases)."""
+    def deco(fn):
+        op = Operator(name=name, fcompute=fn, arg_names=arg_names,
+                      aux_names=aux_names, num_outputs=num_outputs,
+                      params=dict(params or {}), stochastic=stochastic,
+                      key_var_num_args=key_var_num_args, is_loss=is_loss,
+                      mutate=tuple(mutate), doc=doc or fn.__doc__ or "")
+        if name in _OP_REGISTRY:
+            raise MXNetError(f"op {name} registered twice")
+        _OP_REGISTRY[name] = op
+        for a in aliases:
+            _ALIASES[a] = name
+        return fn
+    return deco
+
+
+def get_op(name) -> Operator:
+    if name in _OP_REGISTRY:
+        return _OP_REGISTRY[name]
+    if name in _ALIASES:
+        return _OP_REGISTRY[_ALIASES[name]]
+    raise MXNetError(f"unknown operator {name}")
+
+
+def has_op(name):
+    return name in _OP_REGISTRY or name in _ALIASES
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def apply_op(op: Operator, attrs, op_ctx: OpContext, *inputs):
+    """Run fcompute, normalizing the result to a flat tuple of outputs+aux."""
+    out = op.fcompute(attrs, op_ctx, *inputs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return tuple(out)

@@ -1,0 +1,170 @@
+"""Fused RNN op: multi-layer (bi)LSTM/GRU/vanilla-RNN via ``lax.scan``.
+
+Reference: ``src/operator/rnn-inl.h`` + ``src/operator/cudnn_rnn-inl.h`` (the
+cuDNN fused path used by FusedRNNCell, `python/mxnet/rnn/rnn_cell.py:521`).
+TPU-native design: one ``lax.scan`` per layer/direction — the scan body is a
+couple of MXU matmuls + elementwise gates which XLA fuses; time steps are
+compiler-unrolled pipeline, not a python loop.  The flat parameter vector
+keeps the cuDNN layout (per layer/direction: input weights then recurrent
+weights, gate-major; all biases after all weights) so the reference's
+param (de)fusion helpers port unchanged.
+
+Gate order matches cuDNN: LSTM [i, f, g, o]; GRU [r, z, n].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _layer_param_shapes(mode, input_size, state_size, num_layers, bidirectional):
+    """Yield (layer, direction, W_shape, R_shape) in cuDNN order."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        for d in range(dirs):
+            yield layer, d, (gates * state_size, in_size), \
+                (gates * state_size, state_size)
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for _, _, w, r in _layer_param_shapes(mode, input_size, state_size,
+                                          num_layers, bidirectional):
+        size += w[0] * w[1] + r[0] * r[1]
+    size += num_layers * dirs * 2 * gates * state_size  # biases (bw + br)
+    return size
+
+
+def _unpack_params(params, mode, input_size, state_size, num_layers,
+                   bidirectional):
+    """Split the flat vector into per-(layer,dir) (W, R, bW, bR)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    mats, off = [], 0
+    for layer, d, wsh, rsh in _layer_param_shapes(
+            mode, input_size, state_size, num_layers, bidirectional):
+        w = params[off:off + wsh[0] * wsh[1]].reshape(wsh)
+        off += wsh[0] * wsh[1]
+        r = params[off:off + rsh[0] * rsh[1]].reshape(rsh)
+        off += rsh[0] * rsh[1]
+        mats.append([w, r, None, None])
+    bsz = gates * state_size
+    for i in range(num_layers * dirs):
+        mats[i][2] = params[off:off + bsz]
+        off += bsz
+        mats[i][3] = params[off:off + bsz]
+        off += bsz
+    return mats
+
+
+def _cell_step(mode, state_size):
+    """Return a factory building the per-direction scan body."""
+    def make(W, R, bW, bR):
+        if mode == "lstm":
+            def step(carry, x_t):
+                h, c = carry
+                g = x_t @ W.T + bW + h @ R.T + bR
+                i, f, gg, o = jnp.split(g, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                c_new = f * c + i * jnp.tanh(gg)
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+            return step
+        if mode == "gru":
+            def step(carry, x_t):
+                (h,) = carry
+                gx = x_t @ W.T + bW
+                gh = h @ R.T + bR
+                rx, zx, nx = jnp.split(gx, 3, axis=-1)
+                rh, zh, nh = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(rx + rh)
+                z = jax.nn.sigmoid(zx + zh)
+                n = jnp.tanh(nx + r * nh)
+                h_new = (1 - z) * n + z * h
+                return (h_new,), h_new
+            return step
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, x_t):
+            (h,) = carry
+            h_new = act(x_t @ W.T + bW + h @ R.T + bR)
+            return (h_new,), h_new
+        return step
+    return make
+
+
+@register("RNN",
+          arg_names=lambda a: ("data", "parameters", "state", "state_cell")
+          if a["mode"] == "lstm" else ("data", "parameters", "state"),
+          num_outputs=lambda a: (1 + (2 if a["mode"] == "lstm" else 1)
+                                 if a["state_outputs"] else 1),
+          params={"state_size": 0, "num_layers": 1, "bidirectional": False,
+                  "mode": "lstm", "p": 0.0, "state_outputs": False,
+                  "lstm_state_clip_min": None, "lstm_state_clip_max": None},
+          stochastic=True)
+def rnn(attrs, ctx, data, parameters, state, state_cell=None):
+    """Fused stacked RNN.  data: [T, B, I] (TNC, reference layout).
+
+    Returns output [T, B, H*dirs] (+ final h [L*dirs, B, H] (+ final c) when
+    state_outputs).
+    """
+    mode = attrs["mode"]
+    if mode not in _GATES:
+        raise MXNetError(f"unknown RNN mode {mode}")
+    H = int(attrs["state_size"])
+    L = int(attrs["num_layers"])
+    bi = bool(attrs["bidirectional"])
+    dirs = 2 if bi else 1
+    p_drop = float(attrs["p"])
+    T, B, I = data.shape
+
+    mats = _unpack_params(parameters.astype(jnp.float32), mode, I, H, L, bi)
+    make = _cell_step(mode, H)
+
+    x = data
+    h0 = state.astype(jnp.float32)
+    c0 = state_cell.astype(jnp.float32) if state_cell is not None else None
+    h_finals, c_finals = [], []
+    key = ctx.key
+
+    for layer in range(L):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            W, R, bW, bR = mats[idx]
+            step = make(W, R, bW, bR)
+            h_init = h0[idx]
+            carry = (h_init, c0[idx]) if mode == "lstm" else (h_init,)
+            seq = jnp.flip(x, axis=0) if d == 1 else x
+            # lay the time loop down as lax.scan (compiler-friendly, SURVEY §7)
+            carry_out, ys = lax.scan(step, carry, seq.astype(jnp.float32))
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_finals.append(carry_out[0])
+            if mode == "lstm":
+                c_finals.append(carry_out[1])
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p_drop > 0 and ctx.is_train and layer < L - 1 and key is not None:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - p_drop, x.shape)
+            x = jnp.where(mask, x / (1 - p_drop), 0)
+
+    out = x.astype(data.dtype)
+    if not attrs["state_outputs"]:
+        return out
+    hy = jnp.stack(h_finals).astype(state.dtype)
+    if mode == "lstm":
+        cy = jnp.stack(c_finals).astype(state_cell.dtype)
+        return out, hy, cy
+    return out, hy

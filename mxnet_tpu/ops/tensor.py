@@ -1,0 +1,564 @@
+"""Tensor ops: the reference's ``src/operator/tensor/`` corpus.
+
+elemwise unary/binary (+broadcast, +logic), matrix_op (transpose/dot/reshape/
+slice), init_op (zeros/ones/arange), reduce ops, indexing_op (take/one_hot),
+sample_op (uniform/normal/...), ordering_op (topk/sort/argmax),
+control_flow_op (where).  All are thin jnp/lax lowering — XLA fuses the
+elementwise chains; reductions/sorts use XLA's native implementations
+(reference used cub, SURVEY §2.2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, dtype_np
+from .registry import register
+
+
+# ------------------------------------------------------------ unary elemwise
+def _unary(name, fn, aliases=()):
+    @register(name, aliases=aliases, doc=f"elemwise {name} "
+              "(reference: src/operator/tensor/elemwise_unary_op.cc)")
+    def op(attrs, ctx, data, _fn=fn):
+        return _fn(data)
+    return op
+
+
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lax.rsqrt)
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("square", jnp.square)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("negative", jnp.negative)
+_unary("reciprocal", jnp.reciprocal)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("erf", jax.scipy.special.erf)
+_unary("softsign", lambda x: x / (1 + jnp.abs(x)))
+_unary("identity", lambda x: x, aliases=("_copy",))
+
+
+@register("Cast", params={"dtype": "float32"}, aliases=("cast",))
+def cast(attrs, ctx, data):
+    return data.astype(dtype_np(attrs["dtype"]))
+
+
+@register("clip", params={"a_min": None, "a_max": None})
+def clip(attrs, ctx, data):
+    if attrs["a_min"] is None or attrs["a_max"] is None:
+        raise MXNetError("clip requires both a_min and a_max")
+    return jnp.clip(data, attrs["a_min"], attrs["a_max"])
+
+
+@register("smooth_l1", params={"scalar": 1.0})
+def smooth_l1(attrs, ctx, data):
+    """Reference: mshadow_op.h smooth_l1 functor (used by RCNN)."""
+    s2 = float(attrs["scalar"]) ** 2
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * jnp.square(data),
+                     absd - 0.5 / s2)
+
+
+# ----------------------------------------------------------- binary elemwise
+def _binary(name, fn, aliases=()):
+    @register(name, arg_names=("lhs", "rhs"), aliases=aliases,
+              doc=f"elemwise {name} (reference: elemwise_binary_op.cc / "
+              "elemwise_binary_broadcast_op.cc)")
+    def op(attrs, ctx, lhs, rhs, _fn=fn):
+        return _fn(lhs, rhs)
+    return op
+
+
+for _n, _f, _al in [
+        ("elemwise_add", jnp.add, ("_plus", "_add")),
+        ("elemwise_sub", jnp.subtract, ("_minus", "_sub")),
+        ("elemwise_mul", jnp.multiply, ("_mul",)),
+        ("elemwise_div", jnp.divide, ("_div",)),
+        ("_power", jnp.power, ("pow",)),
+        ("_maximum", jnp.maximum, ()),
+        ("_minimum", jnp.minimum, ()),
+        ("_hypot", jnp.hypot, ()),
+        ("_equal", lambda a, b: jnp.equal(a, b).astype(a.dtype), ()),
+        ("_not_equal", lambda a, b: jnp.not_equal(a, b).astype(a.dtype), ()),
+        ("_greater", lambda a, b: jnp.greater(a, b).astype(a.dtype), ()),
+        ("_greater_equal", lambda a, b: jnp.greater_equal(a, b).astype(a.dtype), ()),
+        ("_lesser", lambda a, b: jnp.less(a, b).astype(a.dtype), ()),
+        ("_lesser_equal", lambda a, b: jnp.less_equal(a, b).astype(a.dtype), ()),
+        ("broadcast_add", jnp.add, ("broadcast_plus",)),
+        ("broadcast_sub", jnp.subtract, ("broadcast_minus",)),
+        ("broadcast_mul", jnp.multiply, ()),
+        ("broadcast_div", jnp.divide, ()),
+        ("broadcast_mod", jnp.mod, ()),
+        ("broadcast_power", jnp.power, ()),
+        ("broadcast_maximum", jnp.maximum, ()),
+        ("broadcast_minimum", jnp.minimum, ()),
+        ("broadcast_hypot", jnp.hypot, ()),
+        ("broadcast_equal", lambda a, b: jnp.equal(a, b).astype(a.dtype), ()),
+        ("broadcast_not_equal", lambda a, b: jnp.not_equal(a, b).astype(a.dtype), ()),
+        ("broadcast_greater", lambda a, b: jnp.greater(a, b).astype(a.dtype), ()),
+        ("broadcast_greater_equal", lambda a, b: jnp.greater_equal(a, b).astype(a.dtype), ()),
+        ("broadcast_lesser", lambda a, b: jnp.less(a, b).astype(a.dtype), ()),
+        ("broadcast_lesser_equal", lambda a, b: jnp.less_equal(a, b).astype(a.dtype), ()),
+]:
+    _binary(_n, _f, _al)
+
+
+def _scalar(name, fn, aliases=()):
+    @register(name, params={"scalar": 0.0}, aliases=aliases,
+              doc="scalar op (reference: elemwise_binary_scalar_op.cc)")
+    def op(attrs, ctx, data, _fn=fn):
+        return _fn(data, attrs["scalar"])
+    return op
+
+
+for _n, _f in [
+        ("_plus_scalar", lambda x, s: x + s),
+        ("_minus_scalar", lambda x, s: x - s),
+        ("_rminus_scalar", lambda x, s: s - x),
+        ("_mul_scalar", lambda x, s: x * s),
+        ("_div_scalar", lambda x, s: x / s),
+        ("_rdiv_scalar", lambda x, s: s / x),
+        ("_power_scalar", lambda x, s: x ** s),
+        ("_rpower_scalar", lambda x, s: s ** x),
+        ("_maximum_scalar", lambda x, s: jnp.maximum(x, s)),
+        ("_minimum_scalar", lambda x, s: jnp.minimum(x, s)),
+        ("_mod_scalar", lambda x, s: jnp.mod(x, s)),
+        ("_equal_scalar", lambda x, s: jnp.equal(x, s).astype(x.dtype)),
+        ("_not_equal_scalar", lambda x, s: jnp.not_equal(x, s).astype(x.dtype)),
+        ("_greater_scalar", lambda x, s: jnp.greater(x, s).astype(x.dtype)),
+        ("_greater_equal_scalar", lambda x, s: jnp.greater_equal(x, s).astype(x.dtype)),
+        ("_lesser_scalar", lambda x, s: jnp.less(x, s).astype(x.dtype)),
+        ("_lesser_equal_scalar", lambda x, s: jnp.less_equal(x, s).astype(x.dtype)),
+]:
+    _scalar(_n, _f)
+
+
+@register("add_n", arg_names=lambda a: tuple(f"arg{i}" for i in range(int(a["num_args"]))),
+          params={"num_args": 1}, key_var_num_args="num_args",
+          aliases=("ElementWiseSum", "_sum"))
+def add_n(attrs, ctx, *args):
+    """Reference: src/ndarray/ndarray.cc ElementwiseSum + elemwise_sum.cc."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# -------------------------------------------------------------------- reduce
+def _reduce(name, fn, default_keepdims=False):
+    @register(name, params={"axis": None, "keepdims": False, "exclude": False},
+              doc=f"reduce {name} (reference: broadcast_reduce_op.h)")
+    def op(attrs, ctx, data, _fn=fn):
+        axis = attrs["axis"]
+        if axis is not None and not isinstance(axis, (tuple, list)):
+            axis = (int(axis),)
+        if axis is not None:
+            axis = tuple(int(a) for a in axis)
+            if attrs["exclude"]:
+                axis = tuple(i for i in range(data.ndim) if i not in axis)
+        return _fn(data, axis=axis, keepdims=bool(attrs["keepdims"]))
+    return op
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+
+
+@register("norm", params={"ord": 2, "axis": None, "keepdims": False})
+def norm(attrs, ctx, data):
+    axis = attrs["axis"]
+    if axis is not None and not isinstance(axis, tuple):
+        axis = (int(axis),)
+    keep = bool(attrs["keepdims"])
+    order = int(attrs["ord"])
+    if order == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keep)
+    if order != 2:
+        raise MXNetError(f"norm: only ord=1,2 supported, got {order}")
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keep))
+
+
+@register("argmax", params={"axis": None, "keepdims": False})
+def argmax(attrs, ctx, data):
+    axis = attrs["axis"]
+    out = jnp.argmax(data, axis=None if axis is None else int(axis))
+    if attrs["keepdims"] and axis is not None:
+        out = jnp.expand_dims(out, int(axis))
+    return out.astype(jnp.float32)
+
+
+@register("argmin", params={"axis": None, "keepdims": False})
+def argmin(attrs, ctx, data):
+    axis = attrs["axis"]
+    out = jnp.argmin(data, axis=None if axis is None else int(axis))
+    if attrs["keepdims"] and axis is not None:
+        out = jnp.expand_dims(out, int(axis))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(attrs, ctx, data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("broadcast_axis", params={"axis": (), "size": ()},
+          aliases=("broadcast_axes",))
+def broadcast_axis(attrs, ctx, data):
+    axes = attrs["axis"] if isinstance(attrs["axis"], (tuple, list)) else (attrs["axis"],)
+    sizes = attrs["size"] if isinstance(attrs["size"], (tuple, list)) else (attrs["size"],)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[int(a)] = int(s)
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("broadcast_to", params={"shape": ()})
+def broadcast_to_op(attrs, ctx, data):
+    target = tuple(attrs["shape"])
+    target = tuple(d if t == 0 else t for t, d in zip(target, data.shape))
+    return jnp.broadcast_to(data, target)
+
+
+# -------------------------------------------------------------------- matrix
+@register("dot", arg_names=("lhs", "rhs"),
+          params={"transpose_a": False, "transpose_b": False})
+def dot(attrs, ctx, lhs, rhs):
+    """Reference: src/operator/tensor/matrix_op.cc dot."""
+    a = lhs.T if attrs["transpose_a"] else lhs
+    b = rhs.T if attrs["transpose_b"] else rhs
+    return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(lhs.dtype)
+
+
+@register("batch_dot", arg_names=("lhs", "rhs"),
+          params={"transpose_a": False, "transpose_b": False})
+def batch_dot(attrs, ctx, lhs, rhs):
+    a = jnp.swapaxes(lhs, -1, -2) if attrs["transpose_a"] else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if attrs["transpose_b"] else rhs
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(lhs.dtype)
+
+
+@register("transpose", params={"axes": ()})
+def transpose(attrs, ctx, data):
+    axes = tuple(attrs["axes"]) or None
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims", params={"axis": 0})
+def expand_dims(attrs, ctx, data):
+    return jnp.expand_dims(data, int(attrs["axis"]))
+
+
+@register("Reshape", params={"shape": (), "reverse": False,
+                             "target_shape": (), "keep_highest": False},
+          aliases=("reshape",))
+def reshape(attrs, ctx, data):
+    """Reference shape specials 0,-1,-2,-3,-4 (matrix_op.cc Reshape)."""
+    spec = list(attrs["shape"]) or list(attrs["target_shape"])
+    if not spec:
+        return data
+    src = list(data.shape)
+    out, i = [], 0
+    it = iter(range(len(spec)))
+    for k in it:
+        s = spec[k]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = spec[k + 1], spec[k + 2]
+            next(it); next(it)
+            a = src[i] if a == -2 else a
+            b = src[i] if b == -2 else b
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1
+        else:
+            out.append(int(s)); i += 1
+    return jnp.reshape(data, tuple(out))
+
+
+@register("slice", params={"begin": (), "end": (), "step": ()},
+          aliases=("crop_like",))
+def slice_op(attrs, ctx, data):
+    begin, end = attrs["begin"], attrs["end"]
+    step = attrs["step"] or (1,) * len(begin)
+    idx = tuple(slice(None if b is None else int(b),
+                      None if e is None else int(e),
+                      int(s) if s else 1)
+                for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis", params={"axis": 0, "begin": 0, "end": None})
+def slice_axis(attrs, ctx, data):
+    ax = int(attrs["axis"])
+    begin = int(attrs["begin"])
+    end = attrs["end"]
+    end = data.shape[ax] if end is None else int(end)
+    if begin < 0:
+        begin += data.shape[ax]
+    if end < 0:
+        end += data.shape[ax]
+    return lax.slice_in_dim(data, begin, end, axis=ax)
+
+
+@register("flip", params={"axis": 0}, aliases=("reverse",))
+def flip(attrs, ctx, data):
+    ax = attrs["axis"]
+    ax = ax if isinstance(ax, (tuple, list)) else (ax,)
+    return jnp.flip(data, axis=tuple(int(a) for a in ax))
+
+
+@register("repeat", params={"repeats": 1, "axis": None})
+def repeat(attrs, ctx, data):
+    axis = attrs["axis"]
+    return jnp.repeat(data, int(attrs["repeats"]),
+                      axis=None if axis is None else int(axis))
+
+
+@register("tile", params={"reps": ()})
+def tile(attrs, ctx, data):
+    return jnp.tile(data, tuple(attrs["reps"]))
+
+
+@register("stack", arg_names=lambda a: tuple(f"arg{i}" for i in range(int(a["num_args"]))),
+          params={"axis": 0, "num_args": 1}, key_var_num_args="num_args")
+def stack(attrs, ctx, *args):
+    return jnp.stack(args, axis=int(attrs["axis"]))
+
+
+# ------------------------------------------------------------------ indexing
+@register("take", arg_names=("a", "indices"),
+          params={"axis": 0, "mode": "clip"})
+def take(attrs, ctx, a, indices):
+    """Reference: src/operator/tensor/indexing_op.cc take."""
+    idx = indices.astype(jnp.int32)
+    mode = attrs["mode"]
+    ax = int(attrs["axis"])
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[ax])
+    elif mode == "raise":
+        raise MXNetError("take: mode='raise' is unsupported under jit "
+                         "(data-dependent error); use 'clip' or 'wrap'")
+    return jnp.take(a, idx, axis=ax, mode="clip")
+
+
+@register("batch_take", arg_names=("a", "indices"))
+def batch_take(attrs, ctx, a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape((-1, 1)), axis=1)[:, 0]
+
+
+@register("one_hot", arg_names=("indices",),
+          params={"depth": 0, "on_value": 1.0, "off_value": 0.0,
+                  "dtype": "float32"})
+def one_hot(attrs, ctx, indices):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(attrs["depth"]),
+                        dtype=dtype_np(attrs["dtype"]))
+    on, off = attrs["on_value"], attrs["off_value"]
+    if on != 1.0 or off != 0.0:
+        oh = oh * (on - off) + off
+    return oh
+
+
+@register("gather_nd", arg_names=("data", "indices"))
+def gather_nd(attrs, ctx, data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd", arg_names=("data", "indices"), params={"shape": ()})
+def scatter_nd(attrs, ctx, data, indices):
+    out = jnp.zeros(tuple(attrs["shape"]), data.dtype)
+    return out.at[tuple(indices.astype(jnp.int32))].set(data)
+
+
+# ------------------------------------------------------------------ ordering
+@register("topk", params={"axis": -1, "k": 1, "ret_typ": "indices",
+                          "is_ascend": False},
+          num_outputs=lambda a: 2 if a.get("ret_typ") == "both" else 1)
+def topk(attrs, ctx, data):
+    """Reference: src/operator/tensor/ordering_op.cc (cub-based there)."""
+    ax = int(attrs["axis"])
+    k = int(attrs["k"])
+    x = jnp.moveaxis(data, ax, -1)
+    if attrs["is_ascend"]:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(x, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(jnp.float32)
+    rt = attrs["ret_typ"]
+    if rt == "value":
+        return vals
+    if rt == "both":
+        return vals, idx
+    if rt == "mask":
+        # per-row scatter of ones at the top-k positions
+        kidx = jnp.moveaxis(idx, ax, -1).astype(jnp.int32)
+        onehots = jax.nn.one_hot(kidx, x.shape[-1], dtype=jnp.float32)
+        mask = jnp.clip(onehots.sum(axis=-2), 0.0, 1.0)
+        return jnp.moveaxis(mask, -1, ax)
+    return idx
+
+
+@register("sort", params={"axis": -1, "is_ascend": True})
+def sort(attrs, ctx, data):
+    out = jnp.sort(data, axis=int(attrs["axis"]))
+    if not attrs["is_ascend"]:
+        out = jnp.flip(out, axis=int(attrs["axis"]))
+    return out
+
+
+@register("argsort", params={"axis": -1, "is_ascend": True})
+def argsort(attrs, ctx, data):
+    idx = jnp.argsort(data, axis=int(attrs["axis"]))
+    if not attrs["is_ascend"]:
+        idx = jnp.flip(idx, axis=int(attrs["axis"]))
+    return idx.astype(jnp.float32)
+
+
+# -------------------------------------------------------------- control flow
+@register("where", arg_names=("condition", "x", "y"))
+def where(attrs, ctx, condition, x, y):
+    """Reference: src/operator/tensor/control_flow_op.cc."""
+    cond = condition
+    if cond.ndim == 1 and x.ndim > 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)
+
+
+# ---------------------------------------------------------------- init ops
+@register("_zeros", arg_names=(), params={"shape": (), "dtype": "float32"},
+          aliases=("zeros_like_op",))
+def zeros_op(attrs, ctx):
+    """Reference: src/operator/tensor/init_op.cc."""
+    return jnp.zeros(tuple(attrs["shape"]), dtype_np(attrs["dtype"]))
+
+
+@register("_ones", arg_names=(), params={"shape": (), "dtype": "float32"})
+def ones_op(attrs, ctx):
+    return jnp.ones(tuple(attrs["shape"]), dtype_np(attrs["dtype"]))
+
+
+@register("_full", arg_names=(), params={"shape": (), "dtype": "float32",
+                                         "value": 0.0})
+def full_op(attrs, ctx):
+    return jnp.full(tuple(attrs["shape"]), attrs["value"], dtype_np(attrs["dtype"]))
+
+
+@register("_arange", arg_names=(),
+          params={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+                  "dtype": "float32"})
+def arange_op(attrs, ctx):
+    out = jnp.arange(attrs["start"], attrs["stop"], attrs["step"],
+                     dtype_np(attrs["dtype"]))
+    if int(attrs["repeat"]) > 1:
+        out = jnp.repeat(out, int(attrs["repeat"]))
+    return out
+
+
+@register("zeros_like")
+def zeros_like(attrs, ctx, data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(attrs, ctx, data):
+    return jnp.ones_like(data)
+
+
+# ---------------------------------------------------------------- sample ops
+def _sample(name, draw, params, aliases=()):
+    @register(name, arg_names=(), params={**params, "shape": (),
+                                          "dtype": "float32"},
+              stochastic=True, aliases=aliases,
+              doc="random sample (reference: src/operator/tensor/sample_op.cc; "
+                  "PRNG resource resource.cc:151-186 -> functional keys)")
+    def op(attrs, ctx, _draw=draw):
+        shape = tuple(attrs["shape"])
+        return _draw(ctx.require_key(), shape, dtype_np(attrs["dtype"]), attrs)
+    return op
+
+
+_sample("_random_uniform",
+        lambda k, s, d, a: jax.random.uniform(k, s, d, a["low"], a["high"]),
+        {"low": 0.0, "high": 1.0}, aliases=("uniform", "random_uniform"))
+_sample("_random_normal",
+        lambda k, s, d, a: a["loc"] + a["scale"] * jax.random.normal(k, s, d),
+        {"loc": 0.0, "scale": 1.0}, aliases=("normal", "random_normal"))
+_sample("_random_gamma",
+        lambda k, s, d, a: a["beta"] * jax.random.gamma(k, a["alpha"], s, d),
+        {"alpha": 1.0, "beta": 1.0}, aliases=("random_gamma",))
+_sample("_random_exponential",
+        lambda k, s, d, a: jax.random.exponential(k, s, d) / a["lam"],
+        {"lam": 1.0}, aliases=("random_exponential",))
+_sample("_random_poisson",
+        lambda k, s, d, a: jax.random.poisson(k, a["lam"], s).astype(d),
+        {"lam": 1.0}, aliases=("random_poisson",))
+_sample("_random_negative_binomial",
+        lambda k, s, d, a: _neg_binomial(k, a["k"], a["p"], s).astype(d),
+        {"k": 1, "p": 1.0}, aliases=("random_negative_binomial",))
+_sample("_random_generalized_negative_binomial",
+        lambda k, s, d, a: _gen_neg_binomial(k, a["mu"], a["alpha"], s).astype(d),
+        {"mu": 1.0, "alpha": 1.0},
+        aliases=("random_generalized_negative_binomial",))
+
+
+def _neg_binomial(key, r, p, shape):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape)
+
+
+def _gen_neg_binomial(key, mu, alpha, shape):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape)

@@ -1,0 +1,50 @@
+"""Global PRNG state: ``mx.random.seed`` and key threading.
+
+Reference: per-device stateful ``mshadow::Random<xpu>`` resource
+(`src/resource.cc:136-186`, seeded via `mx.random.seed`).  JAX is functional:
+we keep one root key per process, split on demand (SURVEY §7 'hard parts':
+RNG).  Symbolic executors draw a fresh subkey per forward; imperative
+stochastic ops draw via :func:`take_key`.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "take_key", "uniform", "normal"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _key():
+    import jax
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global generator (reference MXRandomSeed, c_api.cc)."""
+    import jax
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def take_key():
+    """Split off a fresh subkey (advances global state)."""
+    import jax
+    k, sub = jax.random.split(_key())
+    _state.key = k
+    return sub
+
+
+# Convenience samplers mirroring mx.random.* (reference python/mxnet/random.py)
+def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, dtype="float32", out=None):
+    from . import ndarray as nd
+    return getattr(nd, "_random_uniform")(low=low, high=high, shape=shape,
+                                          dtype=dtype, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, dtype="float32", out=None):
+    from . import ndarray as nd
+    return getattr(nd, "_random_normal")(loc=loc, scale=scale, shape=shape,
+                                         dtype=dtype, out=out)

@@ -1,0 +1,110 @@
+"""Shared test harness (reference: python/mxnet/test_utils.py, 1022 L).
+
+Provides the same surface the reference test-suite leans on:
+``assert_almost_equal``, ``check_numeric_gradient`` (backward vs central
+finite differences), ``check_consistency`` (cross-dtype/device comparison),
+``rand_ndarray``, ``default_context`` (env-switchable via MXNET_TEST_DEVICE).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import autograd
+from . import ndarray as nd
+from .context import Context, cpu
+
+
+def default_context():
+    """Reference: test_utils.default_context, switchable via env."""
+    dev = os.environ.get("MXNET_TEST_DEVICE", "cpu")
+    return Context.from_string(dev)
+
+
+def rand_ndarray(shape, dtype="float32", scale=1.0, ctx=None):
+    return nd.array((np.random.randn(*shape) * scale).astype(dtype), ctx=ctx)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, nd.NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, nd.NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} != {names[1]}")
+
+
+def numeric_grad(f, inputs, eps=1e-3):
+    """Central finite differences of scalar-valued f over list of np arrays."""
+    grads = []
+    for i, x in enumerate(inputs):
+        g = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = f(inputs)
+            flat[j] = orig - eps
+            fm = f(inputs)
+            flat[j] = orig
+            gflat[j] = (fp - fm) / (2 * eps)
+        grads.append(g)
+    return grads
+
+
+def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
+                           atol=1e-3, eps=1e-3, sum_output=True):
+    """Backward (autograd tape over the op) vs finite differences.
+
+    Reference: test_utils.check_numeric_gradient — the primary operator test
+    pattern of tests/python/unittest/test_operator.py.
+    """
+    from . import ops
+    attrs = attrs or {}
+    inputs = [np.asarray(a, np.float64) for a in input_arrays]
+
+    def f(xs):
+        arrs = [nd.array(x.astype("float32")) for x in xs]
+        with autograd.pause():
+            out = ops.imperative_invoke(op_name, *arrs, **attrs)
+        if isinstance(out, list):
+            out = out[0]
+        return float(out.asnumpy().astype(np.float64).sum())
+
+    expected = numeric_grad(f, inputs, eps)
+
+    arrs = [nd.array(x.astype("float32")) for x in inputs]
+    grads = [nd.zeros_like(a) for a in arrs]
+    autograd.mark_variables(arrs, grads)
+    with autograd.record():
+        out = ops.imperative_invoke(op_name, *arrs, **attrs)
+        if isinstance(out, list):
+            out = out[0]
+        loss = out.sum()
+    autograd.backward([loss])
+    for i, (g, e) in enumerate(zip(grads, expected)):
+        np.testing.assert_allclose(g.asnumpy(), e, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch on input {i} "
+                                           f"of {op_name}")
+
+
+def check_consistency(op_name, input_arrays, attrs=None, dtypes=("float32",),
+                      rtol=1e-4, atol=1e-5):
+    """Run the op across dtypes and compare (reference check_consistency's
+    cross-device role; devices are uniform under XLA so dtype is the axis)."""
+    from . import ops
+    attrs = attrs or {}
+    outs = []
+    for dt in dtypes:
+        arrs = [nd.array(np.asarray(a).astype(dt)) for a in input_arrays]
+        out = ops.imperative_invoke(op_name, *arrs, **attrs)
+        if isinstance(out, list):
+            out = out[0]
+        outs.append(out.asnumpy().astype("float32"))
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+    return outs

@@ -1,0 +1,115 @@
+"""NDArray unit tests (reference: tests/python/unittest/test_ndarray.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert a.asnumpy().sum() == 0
+    b = mx.nd.ones((2, 2), dtype="float16")
+    assert b.dtype == np.float16
+    c = mx.nd.full((2,), 7.0)
+    np.testing.assert_allclose(c.asnumpy(), [7, 7])
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2) and d.dtype == np.float32
+    e = mx.nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arith():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((b - a).asnumpy(), [3, 3, 3])
+    np.testing.assert_allclose((a * 2).asnumpy(), [2, 4, 6])
+    np.testing.assert_allclose((2 * a).asnumpy(), [2, 4, 6])
+    np.testing.assert_allclose((1 / a).asnumpy(), [1, 0.5, 1 / 3], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+    a += b
+    np.testing.assert_allclose(a.asnumpy(), [5, 7, 9])
+
+
+def test_inplace_and_views():
+    v = mx.nd.zeros((4, 4))
+    v[1] = 7
+    row = v[2]
+    row[:] = 3
+    out = v.asnumpy()
+    assert (out[1] == 7).all() and (out[2] == 3).all() and out[0].sum() == 0
+    # writes through slices visible to other views of same parent
+    r2 = v[1]
+    r2[:] = 1
+    assert (v.asnumpy()[1] == 1).all()
+
+
+def test_reshape_specials():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, 0, 4)).shape == (2, 3, 4)
+    assert mx.nd.reshape(a, shape=(-3, 4)).shape == (6, 4)
+
+
+def test_reduce_and_argmax():
+    a = mx.nd.array(np.arange(12, dtype="float32").reshape(3, 4))
+    assert a.sum().asscalar() == 66
+    np.testing.assert_allclose(a.sum(axis=1).asnumpy(), [6, 22, 38])
+    np.testing.assert_allclose(a.max(axis=0).asnumpy(), [8, 9, 10, 11])
+    assert a.argmax().asscalar() == 11
+
+
+def test_copyto_astype_context():
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.zeros((2, 2))
+    a.copyto(b)
+    assert b.asnumpy().sum() == 4
+    c = a.astype("float16")
+    assert c.dtype == np.float16
+    assert a.context.device_type in ("cpu", "tpu")
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context == mx.cpu(0)
+
+
+def test_save_load_roundtrip():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "x.params")
+        w = mx.nd.array(np.random.randn(3, 4).astype("float32"))
+        b = mx.nd.array(np.random.randn(4).astype("float16"))
+        mx.nd.save(path, {"arg:w": w, "aux:b": b})
+        d = mx.nd.load(path)
+        assert sorted(d) == ["arg:w", "aux:b"]
+        np.testing.assert_array_equal(d["arg:w"].asnumpy(), w.asnumpy())
+        np.testing.assert_array_equal(d["aux:b"].asnumpy(), b.asnumpy())
+        assert d["aux:b"].dtype == np.float16
+        # list form
+        mx.nd.save(path, [w, b])
+        lst = mx.nd.load(path)
+        assert isinstance(lst, list) and len(lst) == 2
+
+
+def test_dtype_bfloat16():
+    a = mx.nd.ones((2, 2), dtype="bfloat16")
+    assert a.dtype.name == "bfloat16"
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "bf.params")
+        mx.nd.save(path, {"x": a})
+        back = mx.nd.load(path)["x"]
+        assert back.dtype.name == "bfloat16"
+        np.testing.assert_array_equal(back.astype("float32").asnumpy(),
+                                      np.ones((2, 2), "float32"))
+
+
+def test_waitall_and_wait_to_read():
+    a = mx.nd.ones((8, 8))
+    b = a * 2
+    b.wait_to_read()
+    mx.nd.waitall()
+    assert b.asnumpy()[0, 0] == 2

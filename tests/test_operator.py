@@ -1,0 +1,247 @@
+"""Operator tests: forward vs numpy, backward vs finite differences.
+
+Reference: tests/python/unittest/test_operator.py (3119 L) pattern — every op
+numerically checked via the shared harness (SURVEY §4.1).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  rand_ndarray)
+
+
+def test_fully_connected_forward():
+    x = np.random.randn(4, 6).astype("float32")
+    w = np.random.randn(3, 6).astype("float32")
+    b = np.random.randn(3).astype("float32")
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                               num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4, atol=1e-5)
+
+
+def test_fully_connected_backward():
+    check_numeric_gradient("FullyConnected",
+                           [np.random.randn(3, 4), np.random.randn(2, 4),
+                            np.random.randn(2)],
+                           {"num_hidden": 2})
+
+
+def test_convolution_forward_matches_scipy():
+    # 1x1 conv == per-pixel matmul
+    x = np.random.randn(2, 3, 5, 5).astype("float32")
+    w = np.random.randn(4, 3, 1, 1).astype("float32")
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(1, 1),
+                            num_filter=4, no_bias=True)
+    expect = np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0])
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_backward():
+    check_numeric_gradient("Convolution",
+                           [np.random.randn(1, 2, 4, 4),
+                            np.random.randn(3, 2, 3, 3),
+                            np.random.randn(3)],
+                           {"kernel": (3, 3), "num_filter": 3, "pad": (1, 1)})
+
+
+def test_activation_ops():
+    x = np.array([[-2.0, -0.5, 0.0, 0.5, 2.0]], dtype="float32")
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.Activation(a, act_type="relu"), np.maximum(x, 0))
+    assert_almost_equal(mx.nd.Activation(a, act_type="sigmoid"),
+                        1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(mx.nd.Activation(a, act_type="tanh"), np.tanh(x),
+                        rtol=1e-5)
+    assert_almost_equal(mx.nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+
+
+def test_pooling():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    assert_almost_equal(out, [[[[5, 7], [13, 15]]]])
+    avg = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="avg")
+    assert_almost_equal(avg, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    gp = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="max")
+    assert gp.shape == (1, 1, 1, 1) and gp.asscalar() == 15
+
+
+def test_batchnorm_inference_and_train():
+    x = np.random.randn(4, 3, 2, 2).astype("float32")
+    gamma = np.ones(3, "float32")
+    beta = np.zeros(3, "float32")
+    mmean = np.zeros(3, "float32")
+    mvar = np.ones(3, "float32")
+    # inference: normalize by moving stats
+    out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma), mx.nd.array(beta),
+                          mx.nd.array(mmean), mx.nd.array(mvar), fix_gamma=False)
+    assert_almost_equal(out, x / np.sqrt(1 + 1e-3), rtol=1e-4, atol=1e-4)
+    # training: aux moving stats update in place
+    mm = mx.nd.array(mmean)
+    mv = mx.nd.array(mvar)
+    with mx.autograd.record():
+        out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta), mm, mv, fix_gamma=False,
+                              momentum=0.9)
+    batch_mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(mm.asnumpy(), 0.1 * batch_mean, rtol=1e-4,
+                               atol=1e-5)
+    out_np = out.asnumpy()
+    np.testing.assert_allclose(out_np.mean(axis=(0, 2, 3)), np.zeros(3),
+                               atol=1e-5)
+
+
+def test_softmax_output_backward_is_p_minus_onehot():
+    x = np.random.randn(4, 5).astype("float32")
+    label = np.array([0, 2, 4, 1], "float32")
+    data = mx.nd.array(x)
+    grad = mx.nd.zeros_like(data)
+    mx.autograd.mark_variables([data], [grad])
+    with mx.autograd.record():
+        out = mx.nd.SoftmaxOutput(data, mx.nd.array(label))
+    mx.autograd.backward([out])
+    p = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    onehot = np.eye(5, dtype="float32")[label.astype(int)]
+    np.testing.assert_allclose(grad.asnumpy(), p - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_elemwise_and_broadcast():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(3, 1).astype("float32")
+    assert_almost_equal(mx.nd.broadcast_add(mx.nd.array(a), mx.nd.array(b)),
+                        a + b, rtol=1e-6)
+    assert_almost_equal(mx.nd.broadcast_mul(mx.nd.array(a), mx.nd.array(b)),
+                        a * b, rtol=1e-6)
+    assert_almost_equal(mx.nd.exp(mx.nd.array(a)), np.exp(a), rtol=1e-5)
+    assert_almost_equal(mx.nd.log(mx.nd.abs(mx.nd.array(a))),
+                        np.log(np.abs(a)), rtol=1e-5)
+
+
+def test_dot_and_batch_dot():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(4, 5).astype("float32")
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)), a @ b,
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True), a @ b,
+        rtol=1e-4, atol=1e-5)
+    ba = np.random.randn(2, 3, 4).astype("float32")
+    bb = np.random.randn(2, 4, 5).astype("float32")
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(ba), mx.nd.array(bb)),
+                        ba @ bb, rtol=1e-4, atol=1e-5)
+
+
+def test_concat_split():
+    a = np.random.randn(2, 3).astype("float32")
+    b = np.random.randn(2, 3).astype("float32")
+    out = mx.nd.Concat(mx.nd.array(a), mx.nd.array(b), dim=1)
+    assert_almost_equal(out, np.concatenate([a, b], 1))
+    parts = mx.nd.SliceChannel(out, num_outputs=2, axis=1)
+    assert_almost_equal(parts[0], a)
+    assert_almost_equal(parts[1], b)
+
+
+def test_embedding_take_onehot():
+    w = np.random.randn(10, 4).astype("float32")
+    idx = np.array([1, 3, 5], "float32")
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10,
+                          output_dim=4)
+    assert_almost_equal(out, w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array(idx), depth=10)
+    assert oh.shape == (3, 10) and oh.asnumpy().sum() == 3
+    tk = mx.nd.take(mx.nd.array(w), mx.nd.array(idx))
+    assert_almost_equal(tk, w[[1, 3, 5]])
+
+
+def test_transpose_slice_ops():
+    a = np.random.randn(2, 3, 4).astype("float32")
+    assert_almost_equal(mx.nd.transpose(mx.nd.array(a), axes=(2, 0, 1)),
+                        a.transpose(2, 0, 1))
+    assert_almost_equal(
+        mx.nd.slice_axis(mx.nd.array(a), axis=1, begin=1, end=3),
+        a[:, 1:3])
+    assert_almost_equal(mx.nd.flip(mx.nd.array(a), axis=2), a[:, :, ::-1])
+
+
+def test_topk_sort():
+    a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], "float32")
+    v = mx.nd.topk(mx.nd.array(a), k=2, ret_typ="value")
+    assert_almost_equal(v, [[3, 2], [5, 4]])
+    s = mx.nd.sort(mx.nd.array(a))
+    assert_almost_equal(s, np.sort(a, -1))
+    idx = mx.nd.argsort(mx.nd.array(a))
+    assert_almost_equal(idx, np.argsort(a, -1).astype("float32"))
+
+
+def test_backward_various_ops():
+    check_numeric_gradient("tanh", [np.random.randn(3, 3) * 0.5])
+    check_numeric_gradient("square", [np.random.randn(3, 3)])
+    check_numeric_gradient("dot", [np.random.randn(3, 4), np.random.randn(4, 2)])
+    check_numeric_gradient("broadcast_mul",
+                           [np.random.randn(3, 4), np.random.randn(3, 1)])
+    check_numeric_gradient("Pooling", [np.random.randn(1, 1, 4, 4)],
+                           {"kernel": (2, 2), "stride": (2, 2),
+                            "pool_type": "avg"})
+
+
+def test_optimizer_update_ops():
+    w = np.random.randn(5).astype("float32")
+    g = np.random.randn(5).astype("float32")
+    weight = mx.nd.array(w)
+    out = mx.nd.sgd_update(weight, mx.nd.array(g), lr=0.1, wd=0.0,
+                           out=weight)
+    np.testing.assert_allclose(weight.asnumpy(), w - 0.1 * g, rtol=1e-5,
+                               atol=1e-6)
+    # momentum
+    w2 = np.zeros(3, "float32")
+    mom = np.zeros(3, "float32")
+    weight2, m2 = mx.nd.array(w2), mx.nd.array(mom)
+    g2 = mx.nd.array(np.ones(3, "float32"))
+    # reference calling convention: out=weight, state mutated in place
+    mx.nd.sgd_mom_update(weight2, g2, m2, lr=1.0, momentum=0.9, out=weight2)
+    np.testing.assert_allclose(weight2.asnumpy(), [-1, -1, -1], rtol=1e-6)
+    np.testing.assert_allclose(m2.asnumpy(), [-1, -1, -1], rtol=1e-6)
+    mx.nd.sgd_mom_update(weight2, g2, m2, lr=1.0, momentum=0.9, out=weight2)
+    np.testing.assert_allclose(weight2.asnumpy(), [-2.9, -2.9, -2.9],
+                               rtol=1e-5)
+
+
+def test_rnn_lstm_shapes_and_determinism():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, I, H, L = 4, 2, 3, 5, 2
+    n = rnn_param_size("lstm", I, H, L, True)
+    data = mx.nd.array(np.random.randn(T, B, I).astype("float32"))
+    par = mx.nd.array((np.random.randn(n) * 0.1).astype("float32"))
+    h0 = mx.nd.zeros((L * 2, B, H))
+    c0 = mx.nd.zeros((L * 2, B, H))
+    out, hy, cy = mx.nd.RNN(data, par, h0, c0, state_size=H, num_layers=L,
+                            mode="lstm", bidirectional=True,
+                            state_outputs=True)
+    assert out.shape == (T, B, 2 * H)
+    assert hy.shape == (L * 2, B, H) and cy.shape == (L * 2, B, H)
+    out2 = mx.nd.RNN(data, par, h0, c0, state_size=H, num_layers=L,
+                     mode="lstm", bidirectional=True)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+
+def test_sample_ops_moments():
+    mx.random.seed(7)
+    u = mx.nd.uniform(low=0, high=1, shape=(5000,))
+    assert abs(u.asnumpy().mean() - 0.5) < 0.03
+    n = mx.nd.normal(loc=1.0, scale=2.0, shape=(5000,))
+    assert abs(n.asnumpy().mean() - 1.0) < 0.1
+    assert abs(n.asnumpy().std() - 2.0) < 0.1
+
+
+def test_where_clip_cast():
+    cond = np.array([1, 0, 1], "float32")
+    x = np.array([1, 2, 3], "float32")
+    y = np.array([4, 5, 6], "float32")
+    out = mx.nd.where(mx.nd.array(cond), mx.nd.array(x), mx.nd.array(y))
+    assert_almost_equal(out, [1, 5, 3])
+    c = mx.nd.clip(mx.nd.array(x), a_min=1.5, a_max=2.5)
+    assert_almost_equal(c, [1.5, 2, 2.5])
+    assert mx.nd.Cast(mx.nd.array(x), dtype="int32").dtype == np.int32
