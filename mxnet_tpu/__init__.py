@@ -19,6 +19,11 @@ from . import ndarray as nd
 from . import random
 from . import autograd
 from . import ops
+from . import name
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .executor import Executor
 
 # generate mx.nd.<op> functions from the registry (reference:
 # python/mxnet/ndarray.py:2281-2423 codegen over the C op registry)

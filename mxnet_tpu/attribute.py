@@ -1,0 +1,54 @@
+"""Attribute scoping for symbol construction.
+
+Reference: ``python/mxnet/attribute.py`` — ``AttrScope`` attaches attributes
+(``ctx_group``, ``lr_mult``, ``wd_mult``, ``__force_mirroring__`` ...) to every
+symbol created inside the scope.  In the TPU build ``ctx_group`` is the handle
+model-parallel placement maps onto sharding annotations (SURVEY §2.4).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = [AttrScope()]
+    return _state.stack
+
+
+def current():
+    return _stack()[-1]
+
+
+class AttrScope:
+    """Attach attributes to all symbols created within the scope."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes must be strings")
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge scope attrs with explicitly-passed ones (explicit wins)."""
+        if not self._attr:
+            return dict(attr) if attr else {}
+        ret = dict(self._attr)
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        merged = dict(_stack()[-1]._attr)
+        merged.update(self._attr)
+        scope = AttrScope()
+        scope._attr = merged
+        _stack().append(scope)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()

@@ -1,0 +1,322 @@
+"""Executor: a bound, jit-compiled symbolic graph.
+
+Reference: ``include/mxnet/executor.h`` + ``src/executor/graph_executor.cc``
+(the Init pass pipeline, SURVEY §3.4) and the python wrapper
+``python/mxnet/executor.py``.  TPU-native design: ``bind`` closes the Symbol
+DAG over its argument arrays; ``forward`` runs one ``jax.jit``-compiled
+function (XLA performs gradient, memory planning, fusion — the whole
+reference pass pipeline); ``backward`` runs a jitted ``jax.vjp`` of the same
+trace, re-using the forward PRNG key so stochastic ops (Dropout) replay
+bit-identically (the reference reuses saved forward state instead,
+``autograd.cc:149-240``).
+
+grad_req semantics match the reference ``OpReqType`` (`operator.h:24`):
+'write' overwrites the grad array, 'add' accumulates (kAddTo — model-parallel
+LSTM relies on it), 'null' skips.
+"""
+from __future__ import annotations
+
+import functools
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray, zeros as _nd_zeros
+from .symbol import eval_graph, _classify_vars
+
+__all__ = ["Executor"]
+
+
+def _normalize(values, names, kind, default_ctor=None):
+    """Accept list/tuple ordered by ``names`` or a dict; return dict."""
+    if values is None:
+        return {}
+    if isinstance(values, dict):
+        return dict(values)
+    if isinstance(values, (list, tuple)):
+        if len(values) != len(names):
+            raise MXNetError(
+                "%s: expected %d arrays, got %d" % (kind, len(names),
+                                                    len(values)))
+        return dict(zip(names, values))
+    raise TypeError("%s must be list or dict" % kind)
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else current_context()
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+        self._monitor_all = False
+
+        self._topo = symbol._topo()
+        self._arg_nodes, self._aux_nodes = _classify_vars(self._topo)
+        self._arg_names = [n.name for n in self._arg_nodes]
+        self._aux_names = [n.name for n in self._aux_nodes]
+        self._output_names = symbol.list_outputs()
+
+        self.arg_dict = _normalize(args, self._arg_names, "args")
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("bind: missing argument arrays for %s" % missing)
+        self.aux_dict = _normalize(aux_states, self._aux_names, "aux_states")
+        for n in self._aux_names:
+            if n not in self.aux_dict:
+                raise MXNetError("bind: missing auxiliary state %r" % n)
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self._arg_names}
+
+        self.grad_dict = _normalize(args_grad, self._arg_names, "args_grad")
+        for n, req in self._grad_req.items():
+            if req != "null" and n not in self.grad_dict:
+                src = self.arg_dict[n]
+                self.grad_dict[n] = _nd_zeros(src.shape, ctx=self._ctx,
+                                              dtype=src.dtype)
+
+        self._outputs = None
+        self._last_key = None
+        self._last_train = False
+        self._fwd_cache = {}
+        self._bwd_cache = {}
+        # is_loss flag per head (loss heads seed ones, others zeros, when
+        # backward() is called without explicit head gradients)
+        self._head_is_loss = tuple(
+            bool(node.op is not None and node.op.is_loss)
+            for (node, _i) in symbol._entries)
+
+    # ------------------------------------------------------------- properties
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return self._outputs
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._output_names, self.outputs))
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) if self._grad_req[n] != "null" else None
+                for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def grad_req(self):
+        return dict(self._grad_req)
+
+    # -------------------------------------------------------------- compile
+    def _var_ids(self):
+        return [id(n) for n in self._arg_nodes + self._aux_nodes]
+
+    def _get_forward_fn(self, is_train):
+        fn = self._fwd_cache.get(is_train)
+        if fn is not None:
+            return fn
+        import jax
+        topo, entries = self._topo, self._symbol._entries
+        var_ids = self._var_ids()
+
+        def raw(vals, key):
+            var_values = dict(zip(var_ids, vals))
+            heads, aux_updates = eval_graph(topo, entries, var_values,
+                                            is_train=is_train, key=key)
+            n_args = len(self._arg_nodes)
+            aux_out = [aux_updates.get(id(n), vals[n_args + i])
+                       for i, n in enumerate(self._aux_nodes)]
+            return heads, aux_out
+
+        fn = jax.jit(raw)
+        self._fwd_cache[is_train] = fn
+        return fn
+
+    def _get_backward_fn(self, with_head_grads):
+        key_ = with_head_grads
+        fn = self._bwd_cache.get(key_)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        topo, entries = self._topo, self._symbol._entries
+        var_ids = self._var_ids()
+        diff_idx = tuple(i for i, n in enumerate(self._arg_names)
+                         if self._grad_req[n] != "null")
+        head_is_loss = self._head_is_loss
+
+        def raw(vals, key, out_grads):
+            diff_vals = tuple(vals[i] for i in diff_idx)
+
+            def f(diff):
+                full = list(vals)
+                for j, i in enumerate(diff_idx):
+                    full[i] = diff[j]
+                var_values = dict(zip(var_ids, full))
+                heads, _aux = eval_graph(topo, entries, var_values,
+                                         is_train=True, key=key)
+                return heads
+
+            heads, vjp = jax.vjp(f, diff_vals)
+            if with_head_grads:
+                cot = list(out_grads)
+            else:
+                cot = [jnp.ones_like(h) if is_loss else jnp.zeros_like(h)
+                       for h, is_loss in zip(heads, head_is_loss)]
+            (grads,) = vjp(list(cot))
+            return grads
+
+        fn = jax.jit(raw)
+        self._bwd_cache[key_] = fn
+        return fn
+
+    # ---------------------------------------------------------------- run
+    def _gather_vals(self):
+        return tuple([self.arg_dict[n].data for n in self._arg_names] +
+                     [self.aux_dict[n].data for n in self._aux_names])
+
+    def forward(self, is_train=False, **kwargs):
+        """Run the forward graph.  kwargs update named input arrays
+        (reference python/mxnet/executor.py:95)."""
+        import numpy as np
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown input %r" % k)
+            arr = self.arg_dict[k]
+            if isinstance(v, NDArray):
+                arr._set_data(v.data.astype(arr.dtype))
+            else:
+                import jax.numpy as jnp
+                arr._set_data(jnp.asarray(np.asarray(v), dtype=arr.dtype))
+
+        from . import random as _random
+        key = _random.take_key()
+        self._last_key = key
+        self._last_train = bool(is_train)
+
+        if self._monitor_callback is not None:
+            heads, aux_out = self._forward_monitored(is_train, key)
+        else:
+            fn = self._get_forward_fn(bool(is_train))
+            heads, aux_out = fn(self._gather_vals(), key)
+        if is_train:
+            for n, upd in zip(self._aux_names, aux_out):
+                self.aux_dict[n]._set_data(upd)
+        self._outputs = [NDArray(h) for h in heads]
+        return self._outputs
+
+    def _forward_monitored(self, is_train, key):
+        """Eager per-node execution with the monitor callback installed
+        (reference GraphExecutor::ExecuteMonCallback, disables bulk exec)."""
+        cb = self._monitor_callback
+
+        def monitor(name, val):
+            cb(name, NDArray(val))
+
+        var_values = dict(zip(self._var_ids(), self._gather_vals()))
+        heads, aux_updates = eval_graph(
+            self._topo, self._symbol._entries, var_values,
+            is_train=bool(is_train), key=key, monitor=monitor)
+        n_args = len(self._arg_nodes)
+        vals = self._gather_vals()
+        aux_out = [aux_updates.get(id(n), vals[n_args + i])
+                   for i, n in enumerate(self._aux_nodes)]
+        return heads, aux_out
+
+    def backward(self, out_grads=None, is_train=True):
+        """Accumulate gradients into the bound grad arrays."""
+        if self._outputs is None:
+            raise MXNetError("call forward(is_train=True) before backward()")
+        if not self._last_train:
+            raise MXNetError("backward() requires forward(is_train=True)")
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+
+        with_heads = out_grads is not None
+        fn = self._get_backward_fn(with_heads)
+        og = tuple(g.data if isinstance(g, NDArray) else g
+                   for g in (out_grads or ()))
+        grads = fn(self._gather_vals(), self._last_key, og)
+
+        diff_names = [n for n in self._arg_names
+                      if self._grad_req[n] != "null"]
+        for n, g in zip(diff_names, grads):
+            tgt = self.grad_dict[n]
+            if self._grad_req[n] == "add":
+                tgt._set_data(tgt.data + g)
+            else:
+                tgt._set_data(g.astype(tgt.dtype))
+
+    # ------------------------------------------------------------- utility
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v.data.astype(self.arg_dict[k].dtype)
+                    if isinstance(v, NDArray) else v)
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %r" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._set_data(
+                        v.data.astype(self.aux_dict[k].dtype)
+                        if isinstance(v, NDArray) else v)
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes (reference executor.py reshape).
+        Returns a new Executor sharing parameter arrays whose shapes are
+        unchanged."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args, new_grads, new_aux = {}, {}, {}
+        for n, s in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[n]
+            if tuple(cur.shape) == tuple(s):
+                new_args[n] = cur
+                if n in self.grad_dict:
+                    new_grads[n] = self.grad_dict[n]
+            else:
+                if not (partial_shaping or n in kwargs or True):
+                    raise MXNetError("unexpected shape change for %r" % n)
+                new_args[n] = _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
+                if self._grad_req.get(n, "null") != "null":
+                    new_grads[n] = _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
+        for n, s in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[n]
+            new_aux[n] = cur if tuple(cur.shape) == tuple(s) else \
+                _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux, group2ctx=self._group2ctx)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+        self._monitor_all = monitor_all
+
+    def debug_str(self):
+        lines = ["Symbol Outputs:"]
+        for n in self._output_names:
+            lines.append("\toutput[%s]" % n)
+        for node in self._topo:
+            if node.is_variable:
+                lines.append("Variable:%s" % node.name)
+            else:
+                lines.append("--------------------")
+                lines.append("Op:%s, Name=%s" % (node.op.name, node.name))
+                for (src, idx) in node.inputs:
+                    lines.append("\targ[%d]=%s" % (idx, src.name))
+        return "\n".join(lines)

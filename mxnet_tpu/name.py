@@ -1,0 +1,58 @@
+"""Automatic symbol naming.
+
+Reference: ``python/mxnet/name.py`` (NameManager / Prefix) — auto-names
+anonymous symbols ``convolution0, convolution1, ...`` per hint, with a
+context-manager stack so nested managers (e.g. a Prefix) override.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = [NameManager()]
+    return _state.stack
+
+
+def current():
+    return _stack()[-1]
+
+
+class NameManager:
+    """Names anonymous symbols by hint + running counter."""
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to every auto-generated name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -1,0 +1,160 @@
+"""Parameter-shape inference hooks.
+
+The reference infers weight/bias/aux shapes from data shapes via each op's
+``FInferShape``/``OperatorProperty::InferShape`` (e.g. FullyConnected weight =
+(num_hidden, flattened-in-dim), `src/operator/fully_connected-inl.h:148-187`).
+The TPU build gets *output* shapes for free from ``jax.eval_shape`` over
+fcompute; only the shapes of parameter/aux inputs need op-specific rules —
+registered here, consumed by ``Symbol.infer_shape``/``simple_bind``.
+
+Hook signature: ``hook(attrs, known) -> {arg_or_aux_name: shape}`` where
+``known`` maps already-inferred input names (normally just ``data``) to
+shapes.  A hook may return only what it can infer.
+"""
+from __future__ import annotations
+
+from .rnn import rnn_param_size
+
+_PARAM_SHAPE_HOOKS = {}
+
+
+def register_param_shapes(op_name):
+    def deco(fn):
+        _PARAM_SHAPE_HOOKS[op_name] = fn
+        return fn
+    return deco
+
+
+def get_param_shapes(op_name):
+    return _PARAM_SHAPE_HOOKS.get(op_name)
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+@register_param_shapes("FullyConnected")
+def _fc(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    num_hidden = int(attrs["num_hidden"])
+    in_dim = _prod(data[1:]) if attrs["flatten"] else int(data[-1])
+    out = {"weight": (num_hidden, in_dim)}
+    if not attrs["no_bias"]:
+        out["bias"] = (num_hidden,)
+    return out
+
+
+@register_param_shapes("Convolution")
+def _conv(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    num_filter = int(attrs["num_filter"])
+    group = int(attrs["num_group"])
+    out = {"weight": (num_filter, int(data[1]) // group) + kernel}
+    if not attrs["no_bias"]:
+        out["bias"] = (num_filter,)
+    return out
+
+
+@register_param_shapes("Deconvolution")
+def _deconv(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    num_filter = int(attrs["num_filter"])
+    group = int(attrs["num_group"])
+    # reference: weight shape (C, num_filter/group, *kernel)
+    # (src/operator/deconvolution-inl.h InferShape)
+    out = {"weight": (int(data[1]), num_filter // group) + kernel}
+    if not attrs["no_bias"]:
+        out["bias"] = (num_filter,)
+    return out
+
+
+@register_param_shapes("BatchNorm")
+def _bn(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    c = (int(data[int(attrs.get("axis", 1))]),)
+    return {"gamma": c, "beta": c, "moving_mean": c, "moving_var": c}
+
+
+@register_param_shapes("InstanceNorm")
+def _in(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    c = (int(data[1]),)
+    return {"gamma": c, "beta": c}
+
+
+@register_param_shapes("LeakyReLU")
+def _prelu(attrs, known):
+    data = known.get("data")
+    if data is None or attrs["act_type"] != "prelu":
+        return {}
+    return {"gamma": (int(data[1]),)}
+
+
+@register_param_shapes("Embedding")
+def _embedding(attrs, known):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+@register_param_shapes("SoftmaxOutput")
+def _softmax_out(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    # reference: label is class indices (batch,) unless multi_output
+    # (softmax_output.cc InferShape)
+    if attrs.get("multi_output"):
+        return {"label": (int(data[0]),) + tuple(int(d) for d in data[2:])}
+    return {"label": (int(data[0]),)}
+
+
+@register_param_shapes("SVMOutput")
+def _svm_out(attrs, known):
+    data = known.get("data")
+    return {} if data is None else {"label": (int(data[0]),)}
+
+
+def _same_as_data(attrs, known):
+    data = known.get("data")
+    return {} if data is None else {"label": tuple(data)}
+
+
+for _nm in ("LinearRegressionOutput", "MAERegressionOutput",
+            "LogisticRegressionOutput", "MakeLoss"):
+    _PARAM_SHAPE_HOOKS.setdefault(_nm, _same_as_data)
+
+
+@register_param_shapes("RNN")
+def _rnn(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    # data layout TNC (reference rnn-inl.h: seq_len, batch, input_size)
+    seq_len, batch, input_size = int(data[0]), int(data[1]), int(data[2])
+    mode = attrs["mode"]
+    state_size = int(attrs["state_size"])
+    num_layers = int(attrs["num_layers"])
+    bid = bool(attrs["bidirectional"])
+    dirs = 2 if bid else 1
+    out = {
+        "parameters": (rnn_param_size(mode, input_size, state_size,
+                                      num_layers, bid),),
+        "state": (num_layers * dirs, batch, state_size),
+    }
+    if mode == "lstm":
+        out["state_cell"] = (num_layers * dirs, batch, state_size)
+    return out

@@ -1,0 +1,139 @@
+"""Executor bind/forward/backward — reference tests/python/unittest/
+test_executor.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_bind_forward_add():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    an = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    bn = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(an), "b": mx.nd.array(bn)})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), an + bn, rtol=1e-6)
+
+
+def test_backward_mul():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.MakeLoss(a * b, name="loss")
+    an = np.random.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    bn = np.random.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    ex = c.simple_bind(mx.cpu(), a=(2, 3), b=(2, 3))
+    ex.arg_dict["a"][:] = an
+    ex.arg_dict["b"][:] = bn
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), bn, rtol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["b"].asnumpy(), an, rtol=1e-5)
+
+
+def test_grad_req_add():
+    a = mx.sym.Variable("a")
+    loss = mx.sym.MakeLoss(a * 2.0)
+    ex = a_bind = loss.simple_bind(mx.cpu(), grad_req="add", a=(2, 2))
+    ex.arg_dict["a"][:] = 1.0
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(),
+                               np.full((2, 2), 6.0), rtol=1e-6)
+
+
+def test_softmax_output_grad():
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(data=data, name="softmax")
+    x = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    label = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = net.simple_bind(mx.cpu(), data=(4, 5), softmax_label=(4,))
+    ex.forward(is_train=True, data=x, softmax_label=label)
+    probs = ex.outputs[0].asnumpy()
+    expect = np.exp(x) / np.exp(x).sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(probs, expect, rtol=1e-5)
+    ex.backward()
+    onehot = np.zeros((4, 5), dtype=np.float32)
+    onehot[np.arange(4), label.astype(int)] = 1.0
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               probs - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_aux_update():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=data, momentum=0.5, fix_gamma=False,
+                          name="bn")
+    loss = mx.sym.MakeLoss(bn)
+    ex = loss.simple_bind(mx.cpu(), data=(8, 3, 4, 4))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.normal(2.0, 3.0, (8, 3, 4, 4)).astype(np.float32)
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    batch_mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(mm, 0.5 * batch_mean, rtol=1e-4, atol=1e-4)
+    # inference path must not update aux
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm)
+
+
+def test_dropout_fwd_bwd_consistent():
+    data = mx.sym.Variable("data")
+    net = mx.sym.MakeLoss(mx.sym.Dropout(data=data, p=0.5, name="drop"))
+    ex = net.simple_bind(mx.cpu(), data=(100,))
+    x = np.ones(100, dtype=np.float32)
+    ex.forward(is_train=True, data=x)
+    out = ex.outputs[0].asnumpy()
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    # gradient mask must match the forward mask exactly
+    np.testing.assert_allclose(g, out, rtol=1e-6)
+
+
+def test_shared_params_two_executors():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    ex1 = fc.simple_bind(mx.cpu(), data=(2, 4))
+    w = ex1.arg_dict["fc_weight"]
+    w[:] = 1.0
+    ex2 = fc.bind(mx.cpu(), ex1.arg_dict)
+    x = np.ones((2, 4), dtype=np.float32)
+    out = ex2.forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(out, np.full((2, 3), 4.0), rtol=1e-6)
+
+
+def test_head_gradient():
+    a = mx.sym.Variable("a")
+    out = a * 3.0
+    ex = out.simple_bind(mx.cpu(), a=(2, 2))
+    ex.arg_dict["a"][:] = 1.0
+    ex.forward(is_train=True)
+    og = mx.nd.array(np.full((2, 2), 2.0, dtype=np.float32))
+    ex.backward([og])
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(),
+                               np.full((2, 2), 6.0), rtol=1e-6)
+
+
+def test_reshape():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    ex = fc.simple_bind(mx.cpu(), data=(8, 6))
+    ex.arg_dict["fc_weight"][:] = 0.5
+    ex2 = ex.reshape(data=(2, 6))
+    assert ex2.arg_dict["data"].shape == (2, 6)
+    # weight shared
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    out = ex2.forward(data=np.ones((2, 6), dtype=np.float32))
+    np.testing.assert_allclose(out[0].asnumpy(), np.full((2, 4), 3.0),
+                               rtol=1e-6)
+
+
+def test_monitor_callback():
+    seen = []
+    data = mx.sym.Variable("data")
+    net = mx.sym.sigmoid(data, name="sig")
+    ex = net.simple_bind(mx.cpu(), data=(2, 2))
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(data=np.zeros((2, 2), dtype=np.float32))
+    assert "sig_output" in seen

@@ -1,0 +1,122 @@
+"""Symbol composition, inference, serialization — reference
+tests/python/unittest/test_symbol.py + test_infer_shape.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def make_mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act1 = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act1, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_symbol_compose_names():
+    net = make_mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+
+
+def test_auto_naming():
+    with mx.name.NameManager():
+        d = mx.sym.Variable("data")
+        c1 = mx.sym.Convolution(data=d, kernel=(3, 3), num_filter=8)
+        c2 = mx.sym.Convolution(data=c1, kernel=(3, 3), num_filter=8)
+        assert c1.name == "convolution0"
+        assert c2.name == "convolution1"
+
+
+def test_infer_shape_mlp():
+    net = make_mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 784))
+    args = dict(zip(net.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (128, 784)
+    assert args["fc1_bias"] == (128,)
+    assert args["fc2_weight"] == (10, 128)
+    assert args["softmax_label"] == (32,) or args["softmax_label"] == (32, 10)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=16,
+                              pad=(1, 1), name="conv")
+    bn = mx.sym.BatchNorm(data=conv, name="bn")
+    pool = mx.sym.Pooling(data=bn, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max", name="pool")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(4, 3, 8, 8))
+    args = dict(zip(pool.list_arguments(), arg_shapes))
+    assert args["conv_weight"] == (16, 3, 3, 3)
+    assert args["bn_gamma"] == (16,)
+    assert out_shapes == [(4, 16, 4, 4)]
+    auxs = dict(zip(pool.list_auxiliary_states(), aux_shapes))
+    assert auxs["bn_moving_mean"] == (16,)
+    assert auxs["bn_moving_var"] == (16,)
+
+
+def test_infer_shape_partial():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    arg_shapes, out_shapes, aux_shapes = fc.infer_shape_partial()
+    assert out_shapes is None
+
+
+def test_symbol_arithmetic_and_internals():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b * 2.0
+    assert set(c.list_arguments()) == {"a", "b"}
+    internals = c.get_internals()
+    assert "a" in internals.list_outputs()
+
+
+def test_group_and_getitem():
+    a = mx.sym.Variable("a")
+    s1 = mx.sym.sigmoid(a, name="sig")
+    s2 = mx.sym.tanh(a, name="tanh")
+    g = mx.sym.Group([s1, s2])
+    assert g.list_outputs() == ["sig_output", "tanh_output"]
+    assert g[1].name == "tanh"
+    assert g["sig_output"].name == "sig"
+
+
+def test_json_roundtrip():
+    net = make_mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, x1 = net.infer_shape(data=(8, 100))
+    a2, o2, x2 = net2.infer_shape(data=(8, 100))
+    assert o1 == o2 and a1 == a2
+
+
+def test_attr_scope_and_variable_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = mx.sym.Variable("w", lr_mult=2.0)
+        f = mx.sym.FullyConnected(data=v, num_hidden=3, name="fc")
+    assert v.attr("ctx_group") == "dev1"
+    assert v.attr("__lr_mult__") == "2.0"
+    assert f.attr("ctx_group") == "dev1"
+
+
+def test_infer_type():
+    net = make_mlp()
+    arg_types, out_types, aux_types = net.infer_type(data=np.float32)
+    assert all(t == np.dtype(np.float32) for t in arg_types)
+    assert out_types == [np.dtype(np.float32)]
+
+
+def test_variable_shape_attr():
+    v = mx.sym.Variable("x", shape=(2, 3))
+    out = mx.sym.sum(v, name="s")
+    arg_shapes, out_shapes, _ = out.infer_shape()
+    assert arg_shapes == [(2, 3)]
+    assert out_shapes == [()] or out_shapes == [(1,)]
