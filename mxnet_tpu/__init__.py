@@ -28,3 +28,20 @@ from .executor import Executor
 # generate mx.nd.<op> functions from the registry (reference:
 # python/mxnet/ndarray.py:2281-2423 codegen over the C op registry)
 ndarray._register_op_functions(ops.generate_nd_functions())
+
+# training stack (imported after op injection: optimizer uses nd.sgd_update
+# et al., which only exist once the codegen above has run)
+from . import registry
+from . import initializer
+from .initializer import InitDesc
+from . import lr_scheduler
+from . import optimizer
+from . import metric
+from . import io
+from . import callback
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from . import module
+from .module import Module
+

@@ -1,0 +1,379 @@
+"""Evaluation metrics.
+
+Reference: ``python/mxnet/metric.py`` (1057 L) — EvalMetric registry updated
+per batch by the Module training loop (`base_module.py:495`).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy
+
+from .base import MXNetError
+from . import ndarray
+from .ndarray import NDArray
+from . import registry as _registry_mod
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy", "Loss",
+           "CustomMetric", "np", "create"]
+
+
+def check_label_shapes(labels, preds, shape=0):
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError("Shape of labels {} does not match shape of "
+                         "predictions {}".format(label_shape, pred_shape))
+
+
+class EvalMetric:
+    """Base evaluation metric (reference metric.py EvalMetric)."""
+
+    def __init__(self, name, num=None):
+        self.name = name
+        self.num = num
+        self.reset()
+
+    def reset(self):
+        if self.num is None:
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def get(self):
+        if self.num is None:
+            if self.num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.sum_metric / self.num_inst)
+        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
+        values = [x / y if y != 0 else float("nan")
+                  for x, y in zip(self.sum_metric, self.num_inst)]
+        return (names, values)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+
+_register = _registry_mod.get_register_func(EvalMetric, "metric")
+_alias = _registry_mod.get_alias_func(EvalMetric, "metric")
+_create = _registry_mod.get_create_func(EvalMetric, "metric")
+
+
+def create(metric, num=None, **kwargs):
+    """Create metric from name / callable / list (reference metric.create)."""
+    if callable(metric):
+        return CustomMetric(metric, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, num, **kwargs))
+        return composite
+    if num is not None:
+        kwargs["num"] = num
+    try:
+        return _create(metric, **kwargs)
+    except TypeError:
+        kwargs.pop("num", None)
+        return _create(metric, **kwargs)
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (reference CompositeEvalMetric)."""
+
+    def __init__(self, metrics=None, **kwargs):
+        super().__init__("composite", **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError("Metric index {} is out of range 0 and {}"
+                              .format(index, len(self.metrics)))
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names, results = [], []
+        for metric in self.metrics:
+            name, result = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if not isinstance(result, list):
+                result = [result]
+            names.extend(name)
+            results.extend(result)
+        return names, results
+
+
+@_register
+@_alias("acc")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy"):
+        super().__init__(name)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred = pred_label.asnumpy() if isinstance(pred_label, NDArray) \
+                else numpy.asarray(pred_label)
+            lab = label.asnumpy() if isinstance(label, NDArray) \
+                else numpy.asarray(label)
+            if pred.shape != lab.shape:
+                pred = numpy.argmax(pred, axis=self.axis)
+            pred = pred.astype("int32").flat
+            lab = lab.astype("int32").flat
+            check_label_shapes(numpy.array(lab), numpy.array(pred))
+            self.sum_metric += (numpy.array(lab) == numpy.array(pred)).sum()
+            self.num_inst += len(numpy.array(lab))
+
+
+@_register
+@_alias("top_k_accuracy", "top_k_acc")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy"):
+        super().__init__(name)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred = numpy.argsort(pred_label.asnumpy().astype("float32"), axis=1)
+            lab = label.asnumpy().astype("int32")
+            num_samples = pred.shape[0]
+            num_dims = len(pred.shape)
+            if num_dims == 1:
+                self.sum_metric += (pred.flat == lab.flat).sum()
+            elif num_dims == 2:
+                num_classes = pred.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    self.sum_metric += (
+                        pred[:, num_classes - 1 - j].flat == lab.flat).sum()
+            self.num_inst += num_samples
+
+
+@_register
+class F1(EvalMetric):
+    """Binary-classification F1 (reference metric.py F1)."""
+
+    def __init__(self, name="f1"):
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = pred.asnumpy()
+            label = label.asnumpy().astype("int32")
+            pred_label = numpy.argmax(pred, axis=1)
+            check_label_shapes(label, pred)
+            if len(numpy.unique(label)) > 2:
+                raise ValueError("F1 currently only supports binary "
+                                 "classification.")
+            true_pos = ((pred_label == 1) & (label == 1)).sum()
+            false_pos = ((pred_label == 1) & (label == 0)).sum()
+            false_neg = ((pred_label == 0) & (label == 1)).sum()
+            precision = true_pos / (true_pos + false_pos) \
+                if true_pos + false_pos > 0 else 0.0
+            recall = true_pos / (true_pos + false_neg) \
+                if true_pos + false_neg > 0 else 0.0
+            f1_score = 0.0
+            if precision + recall > 0:
+                f1_score = 2 * precision * recall / (precision + recall)
+            self.sum_metric += f1_score
+            self.num_inst += 1
+
+
+@_register
+class Perplexity(EvalMetric):
+    """Reference metric.py Perplexity: exp(sum CE / n)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity"):
+        super().__init__(name)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            lab = label.asnumpy().astype("int32").reshape(-1)
+            prob = pred.asnumpy().reshape(-1, pred.shape[-1] if self.axis == -1
+                                          else pred.shape[self.axis])
+            probs = prob[numpy.arange(lab.size), lab]
+            if self.ignore_label is not None:
+                ignore = (lab == self.ignore_label).astype(probs.dtype)
+                num -= int(ignore.sum())
+                probs = probs * (1 - ignore) + ignore
+            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
+            num += lab.size
+        self.sum_metric += numpy.exp(loss / num) * num
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+
+@_register
+class MAE(EvalMetric):
+    def __init__(self, name="mae"):
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += numpy.abs(label - pred).mean()
+            self.num_inst += 1
+
+
+@_register
+class MSE(EvalMetric):
+    def __init__(self, name="mse"):
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += ((label - pred) ** 2.0).mean()
+            self.num_inst += 1
+
+
+@_register
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse"):
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
+            self.num_inst += 1
+
+
+@_register
+@_alias("ce")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-8, name="cross-entropy"):
+        super().__init__(name)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            label = label.ravel()
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
+            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+@_register
+class Loss(EvalMetric):
+    """Average of the raw outputs (for MakeLoss-style nets)."""
+
+    def __init__(self, name="loss"):
+        super().__init__(name)
+
+    def update(self, _, preds):
+        for pred in preds:
+            self.sum_metric += ndarray.sum(pred).asnumpy().sum()
+            self.num_inst += pred.size
+
+
+@_register
+class Torch(Loss):
+    def __init__(self, name="torch"):
+        super().__init__(name)
+
+
+@_register
+class Caffe(Loss):
+    def __init__(self, name="caffe"):
+        super().__init__(name)
+
+
+@_register
+class CustomMetric(EvalMetric):
+    """Wrap a python feval(label, pred) (reference CustomMetric)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval into a CustomMetric (reference metric.np)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,375 @@
+"""Data-parallel executor group.
+
+Reference: ``python/mxnet/module/executor_group.py`` (679 L) — the
+data-parallel core: slice the batch across devices (`decide_slices`:218),
+bind one executor per device (`_bind_ith_exec`:565) with shared param
+arrays, scatter inputs / gather outputs (`_load_data`/
+`_merge_multi_context`:16-81).  TPU note: single-process multi-device; for
+pjit-fused data parallelism over a Mesh see :mod:`mxnet_tpu.parallel` —
+this class keeps the reference's per-device executor semantics (and works
+on the CPU-device-impersonation test trick, SURVEY §4.2).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import context as ctx_mod
+from .. import io as io_mod
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _load_general(data, targets):
+    """Load a list of batch arrays into per-device slices of targets."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, NDArray):
+            d_targets[:] = d_src
+        else:
+            src = d_src.asnumpy() if isinstance(d_src, NDArray) else \
+                np.asarray(d_src)
+            for slice_idx, d_dst in d_targets:
+                d_dst[:] = src[slice_idx.start:slice_idx.stop]
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+def _merge_multi_context(outputs, major_axis):
+    """Concatenate per-device outputs along the batch axis
+    (reference executor_group.py:55-81)."""
+    rets = []
+    for tensors, axis in zip(outputs, major_axis):
+        if axis >= 0 and len(tensors) > 1:
+            arrs = [t.asnumpy() for t in tensors]
+            rets.append(nd.array(np.concatenate(arrs, axis=axis)))
+        else:
+            rets.append(tensors[0])
+    return rets
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes,
+                 label_shapes, param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+
+        if not for_training:
+            grad_req = "null"
+
+        data_names = [x.name for x in data_shapes]
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = "null" \
+                        if k in self.fixed_param_names else grad_req
+                elif k in data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {k: "null" for k in self.arg_names}
+            self.grad_req.update(grad_req)
+        else:
+            raise ValueError("invalid grad_req")
+
+        if shared_group is not None:
+            self.shared_data_arrays = shared_group.shared_data_arrays
+        else:
+            self.shared_data_arrays = [{} for _ in contexts]
+
+        self.output_names = symbol.list_outputs()
+        self.output_layouts = [
+            io_mod.DataDesc.get_batch_axis("NCHW") for _ in self.output_names]
+
+        self.batch_size = None
+        self.slices = None
+        self.execs = []
+        self._default_execs = None
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_layouts = None
+        self.label_layouts = None
+        self.param_arrays = None
+        self.grad_arrays = None
+        self.aux_arrays = None
+        self.input_grad_arrays = None
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """Workload-weighted batch slicing (reference :218-243)."""
+        assert len(data_shapes) > 0
+        major_axis = [io_mod.DataDesc.get_batch_axis(getattr(d, "layout",
+                                                             "NCHW"))
+                      for d in data_shapes]
+        for (name, shape), axis in zip(data_shapes, major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, \
+                    ("all data must have the same batch size: "
+                     + ("batch_size = %d, but " % self.batch_size)
+                     + ("%s has shape %s" % (name, shape)))
+            else:
+                self.batch_size = batch_size
+                total_workload = sum(self.workload)
+                self.slices = []
+                start = 0
+                for k, w in enumerate(self.workload):
+                    if k == len(self.workload) - 1:
+                        end = batch_size
+                    else:
+                        end = start + int(
+                            round(batch_size * w / total_workload))
+                    self.slices.append(slice(start, end))
+                    start = end
+        return major_axis
+
+    def _collect_arrays(self):
+        """Gather param/grad/aux array lists over devices (reference
+        executor_group.py bind_exec tail)."""
+        self.param_arrays = [[exe.arg_dict[name] for exe in self.execs]
+                             for name in self.param_names]
+        if self.for_training:
+            self.grad_arrays = [[exe.grad_dict.get(name) for exe in self.execs]
+                                for name in self.param_names]
+        else:
+            self.grad_arrays = None
+        data_names = [x[0] for x in self.data_shapes]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [
+                [exe.grad_dict[name] for exe in self.execs]
+                for name in data_names if name in self.execs[0].grad_dict]
+        self.aux_arrays = [[exe.aux_dict[name] for exe in self.execs]
+                           for name in self.aux_names]
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.batch_size = None
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None:
+            self.label_layouts = self.decide_slices(label_shapes)
+
+        self.execs = []
+        for i in range(len(self.contexts)):
+            self.execs.append(
+                self._bind_ith_exec(i, data_shapes, label_shapes,
+                                    shared_group))
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self._collect_arrays()
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and \
+                label_shapes == self.label_shapes:
+            return
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    def set_params(self, arg_params, aux_params):
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=True)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params over devices into the given dicts
+        (reference executor_group.py get_params)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.asnumpy() for w in block) / len(block)
+            arg_params[name][:] = weight.astype(arg_params[name].dtype)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.asnumpy() for w in block) / len(block)
+            aux_params[name][:] = weight.astype(aux_params[name].dtype)
+
+    def forward(self, data_batch, is_train=None):
+        _load_data(data_batch, self.data_arrays)
+        if is_train is None:
+            is_train = self.for_training
+        if self.label_shapes is not None and data_batch.label:
+            _load_label(data_batch, self.label_arrays)
+        for exec_ in self.execs:
+            exec_.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True"
+        for i, exec_ in enumerate(self.execs):
+            out_grads_slice = []
+            if out_grads is not None:
+                for grad, axis in zip(out_grads, self.output_layouts):
+                    if axis >= 0 and len(self.execs) > 1:
+                        og = grad.asnumpy()[self.slices[i]]
+                        out_grads_slice.append(nd.array(og))
+                    else:
+                        out_grads_slice.append(grad)
+                exec_.backward(out_grads=out_grads_slice)
+            else:
+                exec_.backward()
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[exe.outputs[i] for exe in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return _merge_multi_context(outputs, self.output_layouts)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        data_names = [x[0] for x in self.data_shapes]
+        grads = [[exe.grad_dict[name] for exe in self.execs]
+                 for name in data_names]
+        if merge_multi_context:
+            return _merge_multi_context(grads, self.data_layouts)
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        """Per-device metric update with sliced labels
+        (reference executor_group.py:530-563)."""
+        for current_exec, islice in zip(self.execs, self.slices):
+            labels_slice = []
+            for label, axis in zip(labels, self.label_layouts or
+                                   [0] * len(labels)):
+                if axis == 0:
+                    if len(self.execs) > 1:
+                        lab = label.asnumpy()[islice]
+                        labels_slice.append(nd.array(lab))
+                    else:
+                        labels_slice.append(label)
+                else:
+                    labels_slice.append(label)
+            eval_metric.update(labels_slice, current_exec.outputs)
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        """Shape of the i-th executor's slice."""
+        sliced_shapes = []
+        for desc, axis in zip(shapes, major_axis):
+            shape = list(desc.shape)
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            sliced_shapes.append(
+                io_mod.DataDesc(desc.name, tuple(shape),
+                                getattr(desc, "dtype", np.float32)))
+        return sliced_shapes
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        """Bind executor i, sharing params across executors
+        (reference _bind_ith_exec:565-660)."""
+        shared_exec = None if shared_group is None else shared_group.execs[i]
+        context = self.contexts[i]
+        shared_data_arrays = self.shared_data_arrays[i]
+
+        data_shapes_i = self._sliced_shape(data_shapes, i, self.data_layouts)
+        if label_shapes is not None:
+            label_shapes_i = self._sliced_shape(label_shapes, i,
+                                                self.label_layouts)
+        else:
+            label_shapes_i = []
+
+        input_shapes = dict(data_shapes_i)
+        input_shapes.update(dict(label_shapes_i))
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        assert arg_shapes is not None, "shape inference failed"
+
+        input_types = {x.name: getattr(x, "dtype", np.float32)
+                       for x in data_shapes_i + label_shapes_i}
+        arg_types, _, aux_types = self.symbol.infer_type(**input_types)
+
+        arg_arrays = []
+        grad_arrays = {} if self.for_training else None
+
+        def _get_or_reshape(name, shared_data_arrays, arg_shape, arg_type,
+                            context, logger):
+            if name in shared_data_arrays:
+                arg_arr = shared_data_arrays[name]
+                if np.prod(arg_arr.shape) >= np.prod(arg_shape):
+                    arg_arr = arg_arr.reshape(arg_shape) \
+                        if arg_arr.shape != arg_shape else arg_arr
+                else:
+                    arg_arr = nd.zeros(arg_shape, ctx=context,
+                                       dtype=arg_type)
+                    shared_data_arrays[name] = arg_arr
+            else:
+                arg_arr = nd.zeros(arg_shape, ctx=context, dtype=arg_type)
+                shared_data_arrays[name] = arg_arr
+            return arg_arr
+
+        for j, name in enumerate(self.arg_names):
+            if name in self.param_names:  # model parameters
+                if shared_exec is None:
+                    arg_arr = nd.zeros(arg_shapes[j], ctx=context,
+                                       dtype=arg_types[j])
+                    if self.grad_req[name] != "null":
+                        grad_arr = nd.zeros(arg_shapes[j], ctx=context,
+                                            dtype=arg_types[j])
+                        grad_arrays[name] = grad_arr
+                else:
+                    arg_arr = shared_exec.arg_dict[name]
+                    assert tuple(arg_arr.shape) == tuple(arg_shapes[j])
+                    if self.grad_req[name] != "null":
+                        grad_arrays[name] = shared_exec.grad_dict[name]
+            else:  # data, label, or states
+                arg_arr = _get_or_reshape(name, shared_data_arrays,
+                                          arg_shapes[j], arg_types[j],
+                                          context, self.logger)
+                if self.grad_req[name] != "null":
+                    grad_arrays[name] = _get_or_reshape(
+                        "grad of " + name, shared_data_arrays,
+                        arg_shapes[j], arg_types[j], context, self.logger)
+            arg_arrays.append(arg_arr)
+
+        if shared_exec is None:
+            aux_arrays = [nd.zeros(s, ctx=context, dtype=t)
+                          for s, t in zip(aux_shapes, aux_types)]
+        else:
+            aux_arrays = shared_exec.aux_arrays[:]
+
+        executor = self.symbol.bind(ctx=context, args=arg_arrays,
+                                    args_grad=grad_arrays,
+                                    aux_states=aux_arrays,
+                                    grad_req=self.grad_req,
+                                    shared_exec=shared_exec)
+        return executor
+
+    @property
+    def data_arrays(self):
+        data_names = [x[0] for x in self.data_shapes]
+        return [[(self.slices[i], e.arg_dict[name])
+                 for i, e in enumerate(self.execs)]
+                for name in data_names]
+
+    @property
+    def label_arrays(self):
+        label_names = [x[0] for x in self.label_shapes]
+        return [[(self.slices[i], e.arg_dict[name])
+                 for i, e in enumerate(self.execs)]
+                for name in label_names]
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            mon.install(exe)

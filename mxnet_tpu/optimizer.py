@@ -1,0 +1,457 @@
+"""Optimizers.
+
+Reference: ``python/mxnet/optimizer.py`` (802 L) — registry + SGD family with
+fused NDArray update ops (`src/operator/optimizer_op.cc:18-161`), lr/wd
+multipliers sourced from symbol attrs, and the ``get_updater`` closure used
+for worker-side updates.  The fused paths (sgd/sgd_mom/adam/rmsprop) each
+compile to a single XLA elementwise fusion — one HBM pass per parameter.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray
+from .ndarray import NDArray, zeros
+from .ndarray import sqrt, square, sgd_update, sgd_mom_update, adam_update, \
+    rmsprop_update, rmspropalex_update
+from .lr_scheduler import LRScheduler
+
+
+def clip(arr, a_min, a_max):
+    return ndarray.clip(arr, a_min=a_min, a_max=a_max)
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "DCASGD", "Test", "Updater",
+           "get_updater", "create", "register"]
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -------------------------------------------------------------- registry
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("WARNING: New optimizer %s.%s is overriding "
+                            "existing optimizer %s", klass.__module__,
+                            klass.__name__, name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    # ------------------------------------------------------------- interface
+    def create_state(self, index, weight):
+        """Create optimizer state (momentum etc.) for one parameter."""
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    # ----------------------------------------------------------- multipliers
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # reference: no decay on bias/gamma/beta by default
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    # --------------------------------------------------------------- helpers
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, via the fused sgd(_mom)_update ops
+    (reference optimizer.py:308-356)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = {"rescale_grad": self.rescale_grad, "lr": lr, "wd": wd}
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        if state is not None:
+            sgd_mom_update(weight, grad, state, out=weight,
+                           momentum=self.momentum, **kwargs)
+        else:
+            sgd_update(weight, grad, out=weight, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        mon, previous_weight = state
+        comp = grad + wd * weight + self.lamda * grad * grad * (
+            weight - previous_weight)
+        if mon is not None:
+            mon[:] = self.momentum * mon - lr * comp
+        else:
+            mon = -lr * comp
+        previous_weight[:] = weight
+        weight[:] = weight + mon
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py NAG)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom[:] = mom * self.momentum
+            grad = grad + wd * weight
+            mom[:] = mom + grad
+            grad = grad + self.momentum * mom
+            weight[:] = weight - lr * grad
+        else:
+            weight[:] = weight - lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        from . import random as _random
+        noise = _random.normal(0, math.sqrt(lr), shape=weight.shape,
+                               dtype=weight.dtype)
+        weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class ccSGD(SGD):
+    """Deprecated alias of SGD (reference keeps it for compat)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+@register
+class Adam(Optimizer):
+    """Adam, via the fused adam_update op; lr pre-scaled by the bias
+    correction as in the reference (optimizer.py Adam.update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        kwargs = {"beta1": self.beta1, "beta2": self.beta2,
+                  "epsilon": self.epsilon, "rescale_grad": self.rescale_grad,
+                  "lr": lr, "wd": wd}
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        mean, var = state
+        adam_update(weight, grad, mean, var, out=weight, **kwargs)
+
+
+@register
+class AdaGrad(Optimizer):
+    """Reference optimizer.py AdaGrad."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        history = state
+        history[:] = history + square(grad)
+        weight[:] = weight - lr * (grad / sqrt(history + self.float_stable_eps)
+                                   + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp: Tieleman/Hinton (non-centered, fused rmsprop_update) or
+    Graves-2013 centered variant (fused rmspropalex_update).
+    Reference optimizer.py RMSProp."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context),  # n
+                    zeros(weight.shape, ctx=weight.context),  # g
+                    zeros(weight.shape, ctx=weight.context))  # delta
+        return (zeros(weight.shape, ctx=weight.context),)     # n
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = {"gamma1": self.gamma1, "epsilon": self.epsilon,
+                  "rescale_grad": self.rescale_grad, "lr": lr, "wd": wd}
+        if self.centered:
+            kwargs["gamma2"] = self.gamma2
+        if self.clip_gradient:
+            kwargs["clip_gradient"] = self.clip_gradient
+        if self.clip_weights:
+            kwargs["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            rmsprop_update(weight, grad, n, out=weight, **kwargs)
+        else:
+            n, g, delta = state
+            rmspropalex_update(weight, grad, n, g, delta, out=weight, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """Reference optimizer.py AdaDelta."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * grad * grad
+        current_delta = sqrt(acc_delta + self.epsilon) / \
+            sqrt(acc_g + self.epsilon) * grad
+        acc_delta[:] = self.rho * acc_delta + \
+            (1.0 - self.rho) * current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """Reference optimizer.py Ftrl."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),  # dn
+                zeros(weight.shape, ctx=weight.context))  # n
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = clip(grad, -self.clip_gradient, self.clip_gradient)
+        dn, n = state
+        dn[:] = dn + grad - (sqrt(n + grad * grad) - sqrt(n)) * weight / lr
+        n[:] = n + grad * grad
+        import numpy as _np
+        dn_np = dn.asnumpy()
+        n_np = n.asnumpy()
+        w = (_np.sign(dn_np) * self.lamda1 - dn_np) / \
+            ((self.beta + _np.sqrt(n_np)) / lr + wd) * \
+            (_np.abs(dn_np) > self.lamda1)
+        weight[:] = w
+
+
+@register
+class Test(Optimizer):
+    """Deterministic test optimizer (reference optimizer.py Test; used by the
+    distributed kvstore tests for bitwise-reproducible updates)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight + grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater:
+    """Closure applying an optimizer on worker side
+    (reference optimizer.py get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        def _restore(v):
+            if isinstance(v, tuple):
+                return tuple(_restore(x) for x in v)
+            if isinstance(v, np.ndarray):
+                return ndarray.array(v)
+            return v
+        self.states = {k: _restore(v)
+                       for k, v in pickle.loads(states).items()}
+
+    def get_states(self):
+        def _npify(v):
+            if isinstance(v, tuple):
+                return tuple(_npify(x) for x in v)
+            if isinstance(v, NDArray):
+                return v.asnumpy()
+            return v
+        return pickle.dumps({k: _npify(v) for k, v in self.states.items()})
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

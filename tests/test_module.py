@@ -1,0 +1,182 @@
+"""Module API + end-to-end training — reference
+tests/python/unittest/test_module.py + tests/python/train/test_mlp.py."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def make_mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    act1 = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act1, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def make_blob_data(n=400, seed=0):
+    """Two Gaussian blobs — linearly separable 2-class problem."""
+    rng = np.random.RandomState(seed)
+    half = n // 2
+    x = np.concatenate([rng.normal(-2.0, 1.0, (half, 10)),
+                        rng.normal(2.0, 1.0, (half, 10))]).astype(np.float32)
+    y = np.concatenate([np.zeros(half), np.ones(half)]).astype(np.float32)
+    order = rng.permutation(n)
+    return x[order], y[order]
+
+
+def test_module_bind_init_forward():
+    net = make_mlp()
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.initializer.Uniform(0.1))
+    batch = mx.io.DataBatch(data=[mx.nd.ones((8, 10))],
+                            label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), np.ones(8),
+                               rtol=1e-5)
+
+
+def test_module_fit_converges():
+    x, y = make_blob_data()
+    train_iter = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=False)
+    mod = mx.module.Module(make_mlp(), context=mx.cpu())
+    mod.fit(train_iter, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            eval_metric="acc",
+            initializer=mx.initializer.Xavier())
+    score_iter = mx.io.NDArrayIter(x, y, batch_size=32)
+    res = dict(mod.score(score_iter, "acc"))
+    assert res["accuracy"] > 0.95, res
+
+
+def test_module_multi_device_matches_single():
+    """Data-parallel over 2 impersonated devices == single device
+    (reference test strategy SURVEY §4.2)."""
+    x, y = make_blob_data(n=64, seed=3)
+    net = make_mlp()
+
+    def run(ctxs, seed=7):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        it = mx.io.NDArrayIter(x, y, batch_size=16)
+        mod = mx.module.Module(net, context=ctxs)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        for _ in range(3):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    single = run(mx.cpu(0))
+    multi = run([mx.cpu(0), mx.cpu(1)])
+    for k in single:
+        np.testing.assert_allclose(single[k], multi[k], rtol=1e-3,
+                                   atol=1e-4, err_msg=k)
+
+
+def test_module_checkpoint_roundtrip():
+    x, y = make_blob_data(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.module.Module(make_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "test")
+        mod.save_checkpoint(prefix, 1)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0001.params")
+
+        mod2 = mx.module.Module.load(prefix, 1)
+        mod2.bind(data_shapes=it.provide_data,
+                  label_shapes=it.provide_label)
+        a1, _ = mod.get_params()
+        a2, _ = mod2.get_params()
+        for k in a1:
+            np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_module_predict():
+    x, y = make_blob_data(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.module.Module(make_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (64, 2)
+
+
+def test_module_input_grads():
+    net = make_mlp()
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 10))],
+                            label=[mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_kvstore_local():
+    """Reference tests/python/unittest/test_kvstore.py aggregation."""
+    kv = mx.kv.create("local")
+    shape = (4, 4)
+    kv.init(3, mx.nd.ones(shape))
+    # push from 4 impersonated devices
+    vals = [mx.nd.ones(shape)] * 4
+    kv.push(3, vals)
+    out = mx.nd.zeros(shape)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(shape, 4.0))
+
+    # with updater
+    kv2 = mx.kv.create("local")
+    kv2.init("a", mx.nd.zeros(shape))
+    kv2.set_updater(lambda key, recv, stored:
+                    stored.__setitem__(slice(None), stored + recv))
+    for _ in range(3):
+        kv2.push("a", [mx.nd.ones(shape)] * 2)
+    out = mx.nd.zeros(shape)
+    kv2.pull("a", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(shape, 6.0))
+
+
+def test_sgd_vs_manual():
+    """Optimizer matches hand-rolled SGD+momentum (reference
+    test_optimizer.py pattern)."""
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(5).astype(np.float32)
+    g = rng.rand(5).astype(np.float32)
+    lr, mom, wd = 0.1, 0.9, 0.01
+
+    w_ref = w0.copy()
+    m_ref = np.zeros(5, np.float32)
+    for _ in range(3):
+        gg = g + wd * w_ref
+        m_ref = mom * m_ref - lr * gg
+        w_ref = w_ref + m_ref
+
+    w = mx.nd.array(w0)
+    opt = mx.optimizer.create("sgd", learning_rate=lr, momentum=mom, wd=wd)
+    upd = mx.optimizer.get_updater(opt)
+    for _ in range(3):
+        upd(0, mx.nd.array(g), w)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5)
