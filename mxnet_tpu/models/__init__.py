@@ -1,0 +1,30 @@
+"""Model zoo: symbol factories mirroring the reference's
+``example/image-classification/symbols/`` directory.
+
+Each module exposes ``get_symbol(num_classes, ...)``.  ``get_model`` is the
+name-keyed dispatch used by bench.py and the train scripts (reference:
+``importlib.import_module('symbols.'+args.network)`` in
+example/image-classification/common/fit.py).
+"""
+from __future__ import annotations
+
+import importlib
+
+_MODELS = ("mlp", "lenet", "alexnet", "vgg", "resnet", "inception_bn",
+           "googlenet")
+
+
+def get_model(name, **kwargs):
+    """Build a symbol by model name (aliases: inception-bn -> inception_bn,
+    resnet-50 -> resnet(num_layers=50))."""
+    name = name.replace("-", "_")
+    if name.startswith("resnet") and name != "resnet":
+        kwargs.setdefault("num_layers", int(name[len("resnet"):]))
+        name = "resnet"
+    if name.startswith("vgg") and name != "vgg":
+        kwargs.setdefault("num_layers", int(name[len("vgg"):]))
+        name = "vgg"
+    if name not in _MODELS:
+        raise ValueError("unknown model %r (have %s)" % (name, _MODELS))
+    mod = importlib.import_module("." + name, __package__)
+    return mod.get_symbol(**kwargs)

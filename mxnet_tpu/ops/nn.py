@@ -153,12 +153,15 @@ def pooling(attrs, ctx, data):
                 hi += stride[i] - rem
         padding.append((lo, hi))
     ptype = attrs["pool_type"]
+    # init values must be python literals (the identity element) so JAX's
+    # reduce_window autodiff monoid pattern-match fires
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
-            else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+            else int(jnp.iinfo(data.dtype).min)
+        return lax.reduce_window(data, init, lax.max,
                                  window, strides, padding)
-    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+    zero = 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0
+    summed = lax.reduce_window(data, zero, lax.add,
                                window, strides, padding)
     if ptype == "sum":
         return summed

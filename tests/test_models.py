@@ -1,0 +1,57 @@
+"""Model zoo shape checks + tiny forward/backward smoke tests."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize("name,shape,classes", [
+    ("mlp", (2, 784), 10),
+    ("lenet", (2, 1, 28, 28), 10),
+    ("resnet18", (2, 3, 32, 32), 10),
+])
+def test_model_forward_backward(name, shape, classes):
+    net = models.get_model(name, num_classes=classes,
+                           image_shape=",".join(str(s) for s in shape[1:]))
+    ex = net.simple_bind(mx.cpu(), data=shape,
+                         softmax_label=(shape[0],))
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            mx.initializer.Xavier()(k, v)
+    for k, v in ex.aux_dict.items():
+        if k.endswith("moving_var"):
+            v[:] = 1.0
+    x = np.random.uniform(-1, 1, shape).astype(np.float32)
+    y = np.random.randint(0, classes, shape[0]).astype(np.float32)
+    ex.forward(is_train=True, data=x, softmax_label=y)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (shape[0], classes)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(shape[0]), rtol=1e-4)
+    ex.backward()
+    g = ex.grad_dict["data"] if "data" in ex.grad_dict else None
+
+
+def test_resnet50_shapes():
+    """ResNet-50 infers the canonical parameter shapes."""
+    net = models.get_model("resnet50", num_classes=1000,
+                           image_shape="3,224,224")
+    args = net.list_arguments()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 224, 224))
+    d = dict(zip(args, arg_shapes))
+    assert d["conv0_weight"] == (64, 3, 7, 7)
+    assert d["fc1_weight"] == (1000, 2048)
+    assert out_shapes == [(2, 1000)]
+    # ~25.5M params
+    n_params = sum(int(np.prod(s)) for n, s in d.items()
+                   if n not in ("data", "softmax_label"))
+    assert 25_000_000 < n_params < 26_000_000, n_params
+
+
+@pytest.mark.parametrize("name", ["inception_bn", "googlenet", "vgg16",
+                                  "alexnet"])
+def test_imagenet_models_infer(name):
+    net = models.get_model(name, num_classes=1000)
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 1000)]
