@@ -1,0 +1,81 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Mirrors the reference's headline number (`docs/how_to/perf.md:161-193`,
+ResNet-50 train_imagenet.py batch 32).  Baseline for vs_baseline: 45.52
+img/s on 1x K80 (the reference's own published p2.xlarge number,
+BASELINE.md).  Runs the fused pjit train step (mxnet_tpu.parallel.
+ShardedTrainer) on all available local devices.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 45.52  # reference ResNet-50 train, 1x K80, batch 32
+
+
+def main():
+    import jax
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    platform = devices[0].platform
+
+    # batch 32 per chip, matching the reference benchmark config
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "32"))
+    batch = per_chip_batch * n_dev
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    num_layers = int(os.environ.get("BENCH_LAYERS", "50"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    if platform == "cpu":
+        # CPU smoke fallback: tiny config so the bench always completes
+        per_chip_batch, batch, image, steps = 4, 4 * n_dev, 64, 3
+
+    net = models.get_model("resnet%d" % num_layers, num_classes=1000,
+                           image_shape="3,%d,%d" % (image, image))
+    mesh = build_mesh(tp=1)  # pure data parallel across local chips
+    trainer = ShardedTrainer(
+        net, mesh,
+        data_shapes={"data": (batch, 3, image, image)},
+        label_shapes={"softmax_label": (batch,)},
+        learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+        dtype=os.environ.get("BENCH_DTYPE", "bfloat16"))
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)
+    y = rng.randint(0, 1000, batch).astype(np.float32)
+    batch_dict = {"data": x, "softmax_label": y}
+
+    # warmup (compile)
+    loss = trainer.step(batch_dict)
+    jax.block_until_ready(loss)
+    loss = trainer.step(batch_dict)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(batch_dict)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = steps * batch / dt
+    img_per_sec_chip = img_per_sec / n_dev
+    print(json.dumps({
+        "metric": "resnet%d_train_images_per_sec_per_chip" % num_layers,
+        "value": round(img_per_sec_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

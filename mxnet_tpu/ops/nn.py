@@ -37,8 +37,9 @@ def fully_connected(attrs, ctx, data, weight, bias=None):
         x = data.reshape((data.shape[0], -1))
     else:
         x = data
-    # accumulate in f32 on the MXU regardless of input dtype
-    y = jnp.dot(x, weight.T, preferred_element_type=jnp.float32)
+    # the TPU MXU accumulates bf16 dots in f32 natively; no upcast
+    # annotation (preferred_element_type breaks the conv/dot transpose rule)
+    y = jnp.dot(x, weight.T)
     if bias is not None:
         y = y + bias
     return y.astype(data.dtype)
@@ -70,8 +71,7 @@ def convolution(attrs, ctx, data, weight, bias=None):
     y = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=int(attrs["num_group"]),
-        preferred_element_type=jnp.float32)
+        dimension_numbers=dn, feature_group_count=int(attrs["num_group"]))
     if bias is not None:
         y = y + bias.reshape((1, -1) + (1,) * nd)
     return y.astype(data.dtype)
@@ -112,7 +112,7 @@ def deconvolution(attrs, ctx, data, weight, bias=None):
     y = lax.conv_general_dilated(
         data, w, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=stride, dimension_numbers=dn,
-        feature_group_count=groups, preferred_element_type=jnp.float32)
+        feature_group_count=groups)
     if bias is not None:
         y = y + bias.reshape((1, -1) + (1,) * nd)
     return y.astype(data.dtype)

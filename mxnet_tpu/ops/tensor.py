@@ -263,7 +263,7 @@ def dot(attrs, ctx, lhs, rhs):
     """Reference: src/operator/tensor/matrix_op.cc dot."""
     a = lhs.T if attrs["transpose_a"] else lhs
     b = rhs.T if attrs["transpose_b"] else rhs
-    return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(lhs.dtype)
+    return jnp.dot(a, b).astype(lhs.dtype)
 
 
 @register("batch_dot", arg_names=("lhs", "rhs"),
@@ -271,7 +271,7 @@ def dot(attrs, ctx, lhs, rhs):
 def batch_dot(attrs, ctx, lhs, rhs):
     a = jnp.swapaxes(lhs, -1, -2) if attrs["transpose_a"] else lhs
     b = jnp.swapaxes(rhs, -1, -2) if attrs["transpose_b"] else rhs
-    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(lhs.dtype)
+    return jnp.matmul(a, b).astype(lhs.dtype)
 
 
 @register("transpose", params={"axes": ()})

@@ -1,0 +1,15 @@
+"""Distributed / multi-chip execution.
+
+Reference equivalents (SURVEY §5.8): the kvstore 'device' GPU reduce and the
+ps-lite parameter server are both replaced by XLA collectives over ICI/DCN,
+driven by sharding annotations on a ``jax.sharding.Mesh``.  This package
+holds the TPU-native machinery:
+
+* :mod:`mesh` — device-mesh construction (dp × tp axes).
+* :mod:`trainer` — ``ShardedTrainer``: the Symbol graph fused into ONE
+  pjit-compiled train step (forward + backward + optimizer + collectives),
+  the performant path that Module's per-call forward/backward approximates.
+* :mod:`dist_kvstore` — the ``dist_sync`` KVStore facade over collectives.
+"""
+from .mesh import build_mesh, data_parallel_spec
+from .trainer import ShardedTrainer

@@ -1,0 +1,88 @@
+"""Distributed KVStore facade over JAX multi-host collectives.
+
+Reference: ``src/kvstore/kvstore_dist.h`` + ``kvstore_dist_server.h`` —
+worker push/pull against parameter servers with sync aggregation over
+exactly ``ps::NumWorkers()`` pushes.  TPU-native design (SURVEY §5.8): no
+servers exist; ``dist_sync`` push = a global psum over all hosts' gradients
+via a jitted sum on a process-spanning mesh (DCN/ICI collectives), followed
+by the local updater.  ``dist_async`` has no TPU analogue (collectives are
+globally synchronous); we map it to sync semantics and warn — see
+SURVEY §7.7 for the descoping rationale.
+
+Bootstrap: ``jax.distributed.initialize`` replaces the ``DMLC_*`` env
+bootstrap (`kvstore.h:162` InitPSEnv); ``tools/launch.py`` sets the
+coordinator env vars.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..kvstore import KVStore
+
+__all__ = ["DistKVStore"]
+
+
+class DistKVStore(KVStore):
+    """Multi-host synchronous kvstore."""
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        import jax
+        if "async" in kv_type:
+            logging.warning(
+                "dist_async has no TPU analogue (collectives are globally "
+                "synchronous); using dist_sync semantics.")
+        self._num_workers = jax.process_count()
+        self._rank = jax.process_index()
+        self._psum_fn = None
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def _global_sum(self, arr):
+        """Sum an array over all processes (DCN collective)."""
+        import jax
+        if self._num_workers == 1:
+            return arr
+        import jax.numpy as jnp
+        from jax.experimental.multihost_utils import (
+            process_allgather)
+        # all-gather over hosts then sum: one DCN collective per push.
+        gathered = process_allgather(arr.data if hasattr(arr, "data")
+                                     else arr)
+        return jnp.sum(gathered, axis=0)
+
+    def push(self, key, value, priority=0):
+        from ..kvstore import _ctype_key_value, _group_kv_pairs
+        from ..ndarray import NDArray
+        keys, vals = _ctype_key_value(key, value)
+        uniq, grouped = _group_kv_pairs(keys, vals)
+        for k, group in zip(uniq, grouped):
+            merged = group[0].copy()
+            for other in group[1:]:
+                merged += other
+            if self._num_workers > 1:
+                merged = NDArray(self._global_sum(merged))
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %s has not been inited" % str(k))
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def barrier(self):
+        if self._num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+    @staticmethod
+    def init_env(**kwargs):
+        """Initialize the multi-host runtime (replaces InitPSEnv)."""
+        import jax
+        jax.distributed.initialize(**kwargs)

@@ -1,0 +1,46 @@
+"""Device-mesh construction helpers.
+
+The reference's multi-device story is per-GPU worker threads + kvstore
+reduce (SURVEY §2.4); the TPU-native story is one ``jax.sharding.Mesh``
+whose axes name the parallelism kinds.  Convention here:
+
+* ``data``  — data parallelism (batch dim sharded; grad psum rides ICI)
+* ``model`` — tensor parallelism (weight dims sharded; GSPMD inserts
+  all-gather/reduce-scatter)
+
+Pipeline/sequence/expert axes are added by their owners when used.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["build_mesh", "data_parallel_spec", "largest_tp_factor"]
+
+
+def largest_tp_factor(n, cap=8):
+    """Largest power-of-two divisor of n, capped (heuristic tp size)."""
+    tp = 1
+    while n % (tp * 2) == 0 and tp * 2 <= cap:
+        tp *= 2
+    return tp
+
+
+def build_mesh(n_devices=None, tp=1, axis_names=("data", "model"),
+               devices=None):
+    """Build a (data, model) Mesh over the first n_devices jax devices."""
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    assert n % tp == 0, "n_devices %d not divisible by tp %d" % (n, tp)
+    arr = np.array(devices).reshape(n // tp, tp)
+    return Mesh(arr, axis_names=axis_names)
+
+
+def data_parallel_spec(mesh):
+    """PartitionSpec sharding dim 0 (batch) over the data axis."""
+    from jax.sharding import PartitionSpec as P
+    return P(mesh.axis_names[0])

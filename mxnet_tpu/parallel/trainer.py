@@ -1,0 +1,267 @@
+"""ShardedTrainer: a Symbol fused into one pjit train step.
+
+This is the TPU-native performant path.  The reference runs forward,
+backward, and optimizer as separate engine pushes with kvstore reduce in
+between (SURVEY §3.1); here the whole training step — forward, vjp,
+gradient collectives, optimizer update, aux-state update — is ONE
+jit-compiled XLA program over a device mesh:
+
+* batch sharded over the ``data`` axis → XLA inserts the gradient psum over
+  ICI (the role of kvstore 'device', `src/kvstore/comm.h:220-385`);
+* nominated weights sharded over the ``model`` axis → GSPMD tensor
+  parallelism (absent in the reference, SURVEY §2.4);
+* parameters are donated, so updates are in-place in HBM.
+
+Module/Executor remain the API-parity path; bench.py and the pod-scale
+training scripts use this.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..symbol import eval_graph, _classify_vars
+from ..initializer import Xavier, InitDesc
+
+__all__ = ["ShardedTrainer"]
+
+
+class ShardedTrainer:
+    def __init__(self, symbol, mesh, data_shapes, label_shapes=(),
+                 optimizer="sgd", learning_rate=0.05, momentum=0.9,
+                 weight_decay=0.0, initializer=None, dtype="float32",
+                 tp_rules=None, seed=0):
+        """
+        symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
+        mesh: jax.sharding.Mesh with ('data', 'model') axes.
+        data_shapes/label_shapes: dict name -> GLOBAL shape (batch dim 0).
+        tp_rules: {param_name: axis_index} — weight dims to shard over the
+            'model' axis.  Default: classifier-style FullyConnected weights
+            whose output dim divides the tp size.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.symbol = symbol
+        self.mesh = mesh
+        self.lr = learning_rate
+        self.momentum = momentum
+        self.wd = weight_decay
+        self.dtype = dtype
+
+        self._topo = symbol._topo()
+        arg_nodes, aux_nodes = _classify_vars(self._topo)
+        self._arg_nodes, self._aux_nodes = arg_nodes, aux_nodes
+        arg_names = [n.name for n in arg_nodes]
+        self._input_names = list(data_shapes) + list(label_shapes or ())
+        self._param_names = [n for n in arg_names
+                             if n not in self._input_names]
+        self._aux_names = [n.name for n in aux_nodes]
+
+        shapes = dict(data_shapes)
+        shapes.update(label_shapes or {})
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        self._arg_shapes = dict(zip(arg_names, arg_shapes))
+        self._aux_shapes = dict(zip(self._aux_names, aux_shapes))
+        batch_axis_size = next(iter(data_shapes.values()))[0]
+        self._rescale = 1.0 / batch_axis_size
+
+        # ---- init params on host, then device_put with shardings
+        init = initializer or Xavier(rnd_type="gaussian", factor_type="in",
+                                     magnitude=2)
+        rng = np.random.RandomState(seed)
+        host_params = {}
+        for name in self._param_names:
+            arr = _HostArray(np.zeros(self._arg_shapes[name],
+                                      np.dtype(dtype)))
+            try:
+                init(InitDesc(name), arr)
+            except Exception:
+                arr.data[...] = rng.normal(
+                    0, 0.01, self._arg_shapes[name]).astype(dtype)
+            host_params[name] = arr.data
+        host_aux = {}
+        for name in self._aux_names:
+            v = np.zeros(self._aux_shapes[name], np.dtype(dtype))
+            if name.endswith("moving_var"):
+                v[...] = 1.0
+            host_aux[name] = v
+
+        tp_size = mesh.shape.get("model", 1)
+        if tp_rules is None:
+            tp_rules = {}
+            for name in self._param_names:
+                shp = self._arg_shapes[name]
+                # output-parallel sharding for large FC weights
+                if (name.endswith("_weight") and len(shp) == 2 and
+                        shp[0] % tp_size == 0 and shp[0] >= tp_size and
+                        tp_size > 1):
+                    tp_rules[name] = 0
+        self.tp_rules = tp_rules
+
+        def param_spec(name):
+            shp = self._arg_shapes.get(name, self._aux_shapes.get(name))
+            spec = [None] * len(shp)
+            if name in tp_rules:
+                spec[tp_rules[name]] = "model"
+            return P(*spec)
+
+        self._param_sharding = {
+            n: NamedSharding(mesh, param_spec(n)) for n in self._param_names}
+        self._aux_sharding = {
+            n: NamedSharding(mesh, P(*([None] * len(self._aux_shapes[n]))))
+            for n in self._aux_names}
+        self._batch_sharding = {
+            n: NamedSharding(
+                mesh, P(*(["data"] + [None] * (len(shapes[n]) - 1))))
+            for n in self._input_names}
+
+        with mesh:
+            self.params = {n: jax.device_put(host_params[n],
+                                             self._param_sharding[n])
+                           for n in self._param_names}
+            self.aux = {n: jax.device_put(host_aux[n],
+                                          self._aux_sharding[n])
+                        for n in self._aux_names}
+            self.momentum_state = {
+                n: jax.device_put(np.zeros_like(host_params[n]),
+                                  self._param_sharding[n])
+                for n in self._param_names}
+
+        self._step_fn = self._build_step()
+        self._fwd_fn = None
+        self._step_count = 0
+        self._key = jax.random.PRNGKey(seed)
+
+    # ------------------------------------------------------------ builders
+    def _node_value_map(self, params, batch, aux):
+        vals = {}
+        for node in self._arg_nodes:
+            if node.name in params:
+                vals[id(node)] = params[node.name]
+            else:
+                vals[id(node)] = batch[node.name]
+        for node in self._aux_nodes:
+            vals[id(node)] = aux[node.name]
+        return vals
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        topo, entries = self._topo, self.symbol._entries
+        head_is_loss = [bool(n.op is not None and n.op.is_loss)
+                        for (n, _i) in entries]
+        lr, mom, wd, rescale = self.lr, self.momentum, self.wd, self._rescale
+
+        def step(params, mom_state, aux, batch, key):
+            def fwd(p):
+                var_values = self._node_value_map(p, batch, aux)
+                heads, aux_upd = eval_graph(topo, entries, var_values,
+                                            is_train=True, key=key)
+                return heads, aux_upd
+
+            heads, vjp, aux_upd = jax.vjp(fwd, params, has_aux=True)
+            cot = [jnp.ones_like(h) if il else jnp.zeros_like(h)
+                   for h, il in zip(heads, head_is_loss)]
+            (grads,) = vjp(list(cot))
+
+            new_params, new_mom = {}, {}
+            for k, w in params.items():
+                g = grads[k].astype(jnp.float32) * rescale + \
+                    wd * w.astype(jnp.float32)
+                m = mom * mom_state[k].astype(jnp.float32) - lr * g
+                new_mom[k] = m.astype(w.dtype)
+                new_params[k] = (w.astype(jnp.float32) + m).astype(w.dtype)
+
+            new_aux = {}
+            aux_by_id = {id(n): n.name for n in self._aux_nodes}
+            for n in self._aux_nodes:
+                new_aux[n.name] = aux_upd.get(id(n), aux[n.name])
+
+            # monitoring loss: mean -log p(label) from the softmax head
+            loss = jnp.float32(0)
+            label = None
+            for nm in self._input_names:
+                if "label" in nm:
+                    label = batch[nm]
+            if label is not None and head_is_loss[0]:
+                probs = heads[0]
+                if probs.ndim == 2 and label.ndim == 1:
+                    idx = label.astype(jnp.int32)
+                    p = probs[jnp.arange(probs.shape[0]), idx]
+                    loss = -jnp.mean(jnp.log(jnp.maximum(p, 1e-10)))
+            return new_params, new_mom, new_aux, loss
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        in_shardings = (self._param_sharding, self._param_sharding,
+                        self._aux_sharding, self._batch_sharding, None)
+        out_shardings = (self._param_sharding, self._param_sharding,
+                         self._aux_sharding, None)
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ api
+    def _cast_batch(self, batch):
+        """Data inputs follow the compute dtype (bf16 training); labels
+        keep their own dtype."""
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if "label" not in k and v.dtype.kind == "f":
+                v = v.astype(self.dtype)
+            out[k] = v
+        return out
+
+    def step(self, batch):
+        """One fused training step.  ``batch``: dict name -> host array
+        with GLOBAL batch dim.  Returns the (device) loss scalar."""
+        import jax
+        self._key, sub = jax.random.split(self._key)
+        dev_batch = {k: jax.device_put(v, self._batch_sharding[k])
+                     for k, v in self._cast_batch(batch).items()}
+        self.params, self.momentum_state, self.aux, loss = self._step_fn(
+            self.params, self.momentum_state, self.aux, dev_batch, sub)
+        self._step_count += 1
+        return loss
+
+    def forward(self, batch, is_train=False):
+        """Jitted inference forward returning head arrays."""
+        import jax
+        if self._fwd_fn is None:
+            topo, entries = self._topo, self.symbol._entries
+
+            def fwd(params, aux, batch):
+                var_values = self._node_value_map(params, batch, aux)
+                heads, _ = eval_graph(topo, entries, var_values,
+                                      is_train=False, key=None)
+                return heads
+            self._fwd_fn = jax.jit(fwd, in_shardings=(
+                self._param_sharding, self._aux_sharding,
+                self._batch_sharding))
+        dev_batch = {k: jax.device_put(v, self._batch_sharding[k])
+                     for k, v in self._cast_batch(batch).items()}
+        return self._fwd_fn(self.params, self.aux, dev_batch)
+
+
+class _HostArray:
+    """Minimal NDArray-like shim so Initializers can write numpy in-place."""
+
+    def __init__(self, data):
+        self.data = data
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def __setitem__(self, key, value):
+        self.data[key] = np.asarray(value)
+
+    def __getitem__(self, key):
+        return self.data[key]

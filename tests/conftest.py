@@ -11,3 +11,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the axon TPU plugin (sitecustomize) prepends itself to jax_platforms
+# regardless of env; force pure-CPU so the virtual 8-device mesh exists
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
