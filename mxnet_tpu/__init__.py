@@ -44,4 +44,5 @@ from . import kvstore as kv
 from . import model
 from . import module
 from .module import Module
+from . import rnn
 

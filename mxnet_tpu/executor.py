@@ -133,8 +133,10 @@ class Executor:
 
         def raw(vals, key):
             var_values = dict(zip(var_ids, vals))
+            bsz = vals[0].shape[0] if vals and vals[0].ndim else None
             heads, aux_updates = eval_graph(topo, entries, var_values,
-                                            is_train=is_train, key=key)
+                                            is_train=is_train, key=key,
+                                            batch_size=bsz)
             n_args = len(self._arg_nodes)
             aux_out = [aux_updates.get(id(n), vals[n_args + i])
                        for i, n in enumerate(self._aux_nodes)]
@@ -165,8 +167,10 @@ class Executor:
                 for j, i in enumerate(diff_idx):
                     full[i] = diff[j]
                 var_values = dict(zip(var_ids, full))
+                bsz = full[0].shape[0] if full and full[0].ndim else None
                 heads, _aux = eval_graph(topo, entries, var_values,
-                                         is_train=True, key=key)
+                                         is_train=True, key=key,
+                                         batch_size=bsz)
                 return heads
 
             heads, vjp = jax.vjp(f, diff_vals)
@@ -225,10 +229,13 @@ class Executor:
         def monitor(name, val):
             cb(name, NDArray(val))
 
-        var_values = dict(zip(self._var_ids(), self._gather_vals()))
+        vals = self._gather_vals()
+        var_values = dict(zip(self._var_ids(), vals))
+        bsz = vals[0].shape[0] if vals and vals[0].ndim else None
         heads, aux_updates = eval_graph(
             self._topo, self._symbol._entries, var_values,
-            is_train=bool(is_train), key=key, monitor=monitor)
+            is_train=bool(is_train), key=key, monitor=monitor,
+            batch_size=bsz)
         n_args = len(self._arg_nodes)
         vals = self._gather_vals()
         aux_out = [aux_updates.get(id(n), vals[n_args + i])

@@ -305,16 +305,11 @@ class DataParallelExecutorGroup:
 
         def _get_or_reshape(name, shared_data_arrays, arg_shape, arg_type,
                             context, logger):
-            if name in shared_data_arrays:
-                arg_arr = shared_data_arrays[name]
-                if np.prod(arg_arr.shape) >= np.prod(arg_shape):
-                    arg_arr = arg_arr.reshape(arg_shape) \
-                        if arg_arr.shape != arg_shape else arg_arr
-                else:
-                    arg_arr = nd.zeros(arg_shape, ctx=context,
-                                       dtype=arg_type)
-                    shared_data_arrays[name] = arg_arr
-            else:
+            # the reference reuses a bigger pooled buffer via reshape
+            # (executor_group.py _get_or_reshape); XLA owns memory here, so
+            # shape mismatch just allocates per-shape
+            arg_arr = shared_data_arrays.get(name)
+            if arg_arr is None or tuple(arg_arr.shape) != tuple(arg_shape):
                 arg_arr = nd.zeros(arg_shape, ctx=context, dtype=arg_type)
                 shared_data_arrays[name] = arg_arr
             return arg_arr

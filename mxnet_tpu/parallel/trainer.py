@@ -156,10 +156,13 @@ class ShardedTrainer:
         lr, mom, wd, rescale = self.lr, self.momentum, self.wd, self._rescale
 
         def step(params, mom_state, aux, batch, key):
+            bsz = next(iter(batch.values())).shape[0]
+
             def fwd(p):
                 var_values = self._node_value_map(p, batch, aux)
                 heads, aux_upd = eval_graph(topo, entries, var_values,
-                                            is_train=True, key=key)
+                                            is_train=True, key=key,
+                                            batch_size=bsz)
                 return heads, aux_upd
 
             heads, vjp, aux_upd = jax.vjp(fwd, params, has_aux=True)
@@ -236,7 +239,9 @@ class ShardedTrainer:
             def fwd(params, aux, batch):
                 var_values = self._node_value_map(params, batch, aux)
                 heads, _ = eval_graph(topo, entries, var_values,
-                                      is_train=False, key=None)
+                                      is_train=False, key=None,
+                                      batch_size=next(
+                                          iter(batch.values())).shape[0])
                 return heads
             self._fwd_fn = jax.jit(fwd, in_shardings=(
                 self._param_sharding, self._aux_sharding,

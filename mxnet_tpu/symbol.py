@@ -103,12 +103,16 @@ def _classify_vars(topo):
 
 
 def eval_graph(topo, entries, var_values, is_train=False, key=None,
-               monitor=None):
+               monitor=None, batch_size=None):
     """Execute the DAG as a pure function.
 
     ``var_values``: dict id(var-node) -> array.  Returns (head values,
     aux-updates dict id(var-node) -> new array).  Stochastic nodes fold
     their topo index into ``key`` so replay is deterministic.
+
+    ``batch_size`` specializes 0-dims in init-op shapes (the RNN toolkit's
+    deferred begin_state zeros; the reference resolves these via nnvm
+    backward shape inference).
     """
     import jax
     vals = {}
@@ -121,14 +125,24 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
                 raise MXNetError("no value bound for variable %r" % node.name)
             continue
         ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
+        node_attrs = node.attrs
+        shp = node_attrs.get("shape")
+        if isinstance(shp, (tuple, list)) and any(s == 0 for s in shp):
+            if batch_size is None:
+                raise MXNetError(
+                    "node %r has a deferred (0) dim in shape %s but no "
+                    "batch size is known" % (node.name, shp))
+            node_attrs = dict(node_attrs)
+            node_attrs["shape"] = tuple(batch_size if s == 0 else int(s)
+                                        for s in shp)
         stoch = node.op.stochastic
         if callable(stoch):
-            stoch = stoch(node.attrs)
+            stoch = stoch(node_attrs)
         k = None
         if stoch and key is not None:
             k = jax.random.fold_in(key, i)
         octx = OpContext(is_train=is_train, key=k)
-        outs = apply_op(node.op, node.attrs, octx, *ins)
+        outs = apply_op(node.op, node_attrs, octx, *ins)
         n_vis = node.num_outputs()
         n_aux = len(node.inputs) - node.num_args
         vals[id(node)] = outs[:n_vis]
@@ -341,6 +355,12 @@ class Symbol:
                     json.loads(node.raw_attr["__shape__"]))
             dtypes[id(node)] = node.raw_attr.get("__dtype__", "float32")
 
+        batch_size = None
+        for n in arg_nodes:
+            if id(n) in shapes and len(shapes[id(n)]) > 0:
+                batch_size = int(shapes[id(n)][0])
+                break
+
         # propagate: per-op param-shape hooks fill parameter/aux variables
         for node in topo:
             if node.is_variable:
@@ -358,7 +378,7 @@ class Symbol:
                           # need data shapes, resolved in the eval pass
             # run a partial eval up to this node to learn non-var input shapes
             inferred = hook(node.attrs, _resolve_input_shapes(
-                node, shapes, dtypes, topo, known_in))
+                node, shapes, dtypes, topo, known_in, batch_size))
             for nm, shp in inferred.items():
                 try:
                     slot = names.index(nm)
@@ -384,7 +404,8 @@ class Symbol:
 
         def fn(var_vals):
             heads, _aux = eval_graph(topo, entries, var_vals,
-                                     is_train=False, key=None)
+                                     is_train=False, key=None,
+                                     batch_size=batch_size)
             return heads
 
         var_vals = {id(n): jax.ShapeDtypeStruct(shapes[id(n)],
@@ -543,7 +564,8 @@ def _attr_str(v):
     return str(v)
 
 
-def _resolve_input_shapes(node, var_shapes, var_dtypes, topo, seed):
+def _resolve_input_shapes(node, var_shapes, var_dtypes, topo, seed,
+                          batch_size=None):
     """Best-effort shapes of ``node``'s inputs by name (for shape hooks).
 
     Variable inputs read ``var_shapes``; op-output inputs are resolved by an
@@ -568,9 +590,16 @@ def _resolve_input_shapes(node, var_shapes, var_dtypes, topo, seed):
         var_vals = {id(n): jax.ShapeDtypeStruct(
             var_shapes[id(n)], jnp.dtype(var_dtypes.get(id(n), "float32")))
             for n in needed}
+        bsz = batch_size
+        if bsz is None:
+            for n in needed:
+                if len(var_shapes[id(n)]) > 0:
+                    bsz = int(var_shapes[id(n)][0])
+                    break
 
-        def fn(vv, _sub_topo=sub_topo, _src=src, _idx=idx):
-            heads, _ = eval_graph(_sub_topo, [(_src, _idx)], vv)
+        def fn(vv, _sub_topo=sub_topo, _src=src, _idx=idx, _bsz=bsz):
+            heads, _ = eval_graph(_sub_topo, [(_src, _idx)], vv,
+                                  batch_size=_bsz)
             return heads[0]
         try:
             st = jax.eval_shape(fn, var_vals)
@@ -697,14 +726,20 @@ def _register_sym_functions():
 _register_sym_functions()
 
 
-# convenience creators mirroring mx.sym.zeros/ones/arange
-def zeros(shape, dtype="float32", name=None):
-    return _create("_zeros", name, None, [],
+# convenience creators mirroring mx.sym.zeros/ones/arange.  A 0 in shape is
+# a deferred batch dim resolved at bind time (the RNN begin_state pattern);
+# meta kwargs (e.g. __layout__) become node attrs.
+def zeros(shape, dtype="float32", name=None, **kwargs):
+    attr = {k: str(v) for k, v in kwargs.items()
+            if k.startswith("__") and k.endswith("__")}
+    return _create("_zeros", name, attr or None, [],
                    {"shape": tuple(shape), "dtype": dtype})
 
 
-def ones(shape, dtype="float32", name=None):
-    return _create("_ones", name, None, [],
+def ones(shape, dtype="float32", name=None, **kwargs):
+    attr = {k: str(v) for k, v in kwargs.items()
+            if k.startswith("__") and k.endswith("__")}
+    return _create("_ones", name, attr or None, [],
                    {"shape": tuple(shape), "dtype": dtype})
 
 

@@ -1,0 +1,162 @@
+"""RNN cells: unroll shapes, fused-vs-unfused parity, bucketing training.
+
+Reference: tests/python/unittest/test_rnn.py (cell unroll vs fused
+consistency) + example/rnn/lstm_bucketing.py (the bucketing acid test,
+SURVEY §5.7)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(50, prefix="rnn_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    args, outs, auxs = outputs.infer_shape(
+        t0_data=(10, 50), t1_data=(10, 50), t2_data=(10, 50))
+    assert outs == [(10, 50), (10, 50), (10, 50)]
+
+
+def test_lstm_cell_unroll():
+    cell = mx.rnn.LSTMCell(100, prefix="rnn_", forget_bias=1.0)
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    args, outs, auxs = outputs.infer_shape(
+        t0_data=(10, 50), t1_data=(10, 50), t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_gru_cell_unroll():
+    cell = mx.rnn.GRUCell(64, prefix="gru_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(t0_data=(4, 16), t1_data=(4, 16))
+    assert outs == [(4, 64), (4, 64)]
+
+
+def test_fused_rnn_shapes():
+    cell = mx.rnn.FusedRNNCell(32, num_layers=2, mode="lstm",
+                               prefix="lstm_")
+    data = mx.sym.Variable("data")
+    out, _ = cell.unroll(5, data, layout="NTC", merge_outputs=True)
+    _, outs, _ = out.infer_shape(data=(8, 5, 16))
+    assert outs == [(8, 5, 32)]
+
+
+def test_fused_vs_unfused_lstm():
+    """Fused lax.scan kernel == explicit unrolled cells with the same
+    packed weights (reference test_rnn.py test_lstm / cudnn consistency)."""
+    T, B, I, H = 4, 3, 5, 6
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                prefix="lstm_", get_next_state=True)
+    stack = fused.unfuse()
+
+    data = mx.sym.Variable("data")
+    f_out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+    u_out, _ = stack.unroll(T, data, layout="NTC", merge_outputs=True)
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (B, T, I)).astype(np.float32)
+
+    # random fused parameter vector, converted to unfused arg dict
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size("lstm", I, H, 1, False)
+    pvec = mx.nd.array(rng.uniform(-0.2, 0.2, psize).astype(np.float32))
+    # fused flat vector -> per-gate dict -> per-cell concatenated dict
+    unpacked = stack.pack_weights(fused.unpack_weights(
+        {"lstm_parameters": pvec}))
+
+    f_ex = f_out.simple_bind(mx.cpu(), data=(B, T, I))
+    f_ex.arg_dict["lstm_parameters"][:] = pvec
+    f_res = f_ex.forward(data=x)[0].asnumpy()
+
+    u_ex = u_out.simple_bind(mx.cpu(), data=(B, T, I))
+    for k, v in unpacked.items():
+        u_ex.arg_dict[k][:] = v
+    u_res = u_ex.forward(data=x)[0].asnumpy()
+
+    np.testing.assert_allclose(f_res, u_res, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="gru", prefix="gru_")
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    psize = rnn_param_size("gru", 4, 8, 2, False)
+    vec = mx.nd.array(np.arange(psize, dtype=np.float32))
+    unpacked = cell.unpack_weights({"gru_parameters": vec})
+    packed = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["gru_parameters"].asnumpy(),
+                               vec.asnumpy())
+
+
+def _make_bucketing_model(num_hidden=32, num_embed=16, vocab=30):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab,
+                                 output_dim=num_embed, name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="lstm_l0_"))
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab,
+                                     name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def test_bucketing_module_lstm():
+    """lstm_bucketing equivalent: two buckets, shared params, loss falls
+    (reference example/rnn/lstm_bucketing.py)."""
+    rng = np.random.RandomState(0)
+    vocab = 30
+    sentences = [list(rng.randint(1, vocab, rng.randint(3, 8)))
+                 for _ in range(200)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=16,
+                                   buckets=[4, 8], invalid_label=0)
+    mod = mx.module.BucketingModule(
+        _make_bucketing_model(vocab=vocab),
+        default_bucket_key=it.default_bucket_key, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.Perplexity(ignore_label=None)
+
+    first_ppl = None
+    for epoch in range(3):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        ppl = metric.get()[1]
+        if first_ppl is None:
+            first_ppl = ppl
+    assert len(mod._buckets) == 2
+    assert ppl < first_ppl, (first_ppl, ppl)
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(1)
+    sentences = [list(rng.randint(1, 20, rng.randint(2, 10)))
+                 for _ in range(100)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[5, 10], invalid_label=0)
+    seen = set()
+    for batch in it:
+        assert batch.data[0].shape[0] == 8
+        assert batch.bucket_key in (5, 10)
+        assert batch.data[0].shape[1] == batch.bucket_key
+        seen.add(batch.bucket_key)
+    assert seen
