@@ -19,6 +19,7 @@ from . import ndarray as nd
 from . import random
 from . import autograd
 from . import ops
+from . import operator  # registers the "Custom" op before codegen below
 from . import name
 from .attribute import AttrScope
 from . import symbol
@@ -45,4 +46,17 @@ from . import model
 from . import module
 from .module import Module
 from . import rnn
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import recordio
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+
+# optional: image pipeline needs PIL
+try:
+    from . import image
+except ImportError:  # pragma: no cover
+    image = None
 

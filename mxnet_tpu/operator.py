@@ -1,0 +1,222 @@
+"""Custom python operators.
+
+Reference: ``python/mxnet/operator.py`` (855 L) — ``CustomOp``/
+``CustomOpProp`` registered via ``MXCustomOpRegister``; the engine invokes
+python callbacks on a worker thread (`src/operator/custom/custom-inl.h`).
+TPU-native design (SURVEY §7 hard parts): the python body runs as a
+``jax.pure_callback`` inside the jitted graph — CustomOpProp's declared
+shapes give the callback its output ShapeDtypeStructs; ``jax.custom_vjp``
+routes the declared backward through a second callback.  Stateless between
+calls (the reference caches one CustomOp instance per executor node; here
+an instance is created per call — document stateful ops accordingly).
+
+Legacy ``PythonOp``/``NDArrayOp`` are intentionally absent (deprecated in
+the reference too); use CustomOp.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ops.registry import register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_CUSTOM_PROPS = {}
+
+
+class CustomOp:
+    """Base class for custom python operators (reference operator.CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g, r in zip(in_grad, req):
+            self.assign(g, r, np.zeros_like(g.asnumpy())
+                        if hasattr(g, "asnumpy") else np.zeros_like(g))
+
+    def assign(self, dst, req, src):
+        """Write src to dst honoring OpReqType (reference CustomOp.assign)."""
+        if req in ("null",):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src if hasattr(dst, "asnumpy") else dst[:] + src
+        else:
+            raise ValueError("invalid req %s" % req)
+
+
+class CustomOpProp:
+    """Declares shapes/types/backward deps (reference CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp class under ``reg_name``
+    (reference operator.register → MXCustomOpRegister)."""
+    def do_register(prop_cls):
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered():
+    return dict(_CUSTOM_PROPS)
+
+
+class _HostNDArray:
+    """numpy-backed stand-in handed to CustomOp.forward/backward."""
+
+    def __init__(self, arr):
+        self._arr = np.array(arr)
+
+    def asnumpy(self):
+        return self._arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def __setitem__(self, key, value):
+        self._arr[key] = value.asnumpy() if isinstance(value, _HostNDArray) \
+            else np.asarray(value)
+
+    def __getitem__(self, key):
+        return self._arr[key]
+
+    def __add__(self, other):
+        o = other.asnumpy() if isinstance(other, _HostNDArray) else other
+        return self._arr + o
+
+
+def _make_prop(attrs):
+    name = attrs.get("op_type")
+    if name not in _CUSTOM_PROPS:
+        raise MXNetError("custom op type %r is not registered" % name)
+    kwargs = {k: str(v) for k, v in attrs.items()
+              if k not in ("op_type",) and v is not None}
+    try:
+        return _CUSTOM_PROPS[name](**kwargs)
+    except TypeError:
+        return _CUSTOM_PROPS[name]()
+
+
+def _custom_arg_names(attrs):
+    return tuple(_make_prop(attrs).list_arguments())
+
+
+def _custom_aux_names(attrs):
+    return tuple(_make_prop(attrs).list_auxiliary_states())
+
+
+def _custom_num_outputs(attrs):
+    return len(_make_prop(attrs).list_outputs())
+
+
+@_register_op("Custom", arg_names=_custom_arg_names,
+              aux_names=_custom_aux_names,
+              num_outputs=_custom_num_outputs,
+              params={"op_type": None})
+def _custom_fcompute(attrs, octx, *inputs):
+    """The Custom op body: host callbacks inside the jitted graph."""
+    import jax
+    import jax.numpy as jnp
+
+    prop = _make_prop(attrs)
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    n_out = len(prop.list_outputs())
+    args = inputs[:n_args]
+    aux = inputs[n_args:n_args + n_aux]
+    is_train = bool(octx.is_train)
+
+    in_shapes = [tuple(a.shape) for a in args]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    in_types = [a.dtype for a in args]
+    _, out_types, _ = prop.infer_type(in_types)
+    out_structs = tuple(jax.ShapeDtypeStruct(tuple(s), jnp.dtype(t))
+                        for s, t in zip(out_shapes, out_types))
+
+    def host_forward(*host_args):
+        op = prop.create_operator(None, in_shapes, in_types)
+        in_data = [_HostNDArray(a) for a in host_args[:n_args]]
+        aux_data = [_HostNDArray(a) for a in host_args[n_args:]]
+        out_data = [_HostNDArray(np.zeros(s.shape, s.dtype))
+                    for s in out_structs]
+        op.forward(is_train, ["write"] * n_out, in_data, out_data, aux_data)
+        return tuple(o.asnumpy() for o in out_data)
+
+    def host_backward(*host_args):
+        # layout: out_grads, in_data, out_data, aux
+        ogs = host_args[:n_out]
+        ins = host_args[n_out:n_out + n_args]
+        outs = host_args[n_out + n_args:n_out + n_args + n_out]
+        auxs = host_args[n_out + n_args + n_out:]
+        op = prop.create_operator(None, in_shapes, in_types)
+        in_data = [_HostNDArray(a) for a in ins]
+        out_data = [_HostNDArray(a) for a in outs]
+        out_grad = [_HostNDArray(a) for a in ogs]
+        aux_data = [_HostNDArray(a) for a in auxs]
+        in_grad = [_HostNDArray(np.zeros_like(np.asarray(a))) for a in ins]
+        op.backward(["write"] * n_args, out_grad, in_data, out_data,
+                    in_grad, aux_data)
+        return tuple(g.asnumpy() for g in in_grad)
+
+    @jax.custom_vjp
+    def f(*xs):
+        return jax.pure_callback(host_forward, out_structs, *xs, *aux)
+
+    def f_fwd(*xs):
+        outs = f(*xs)
+        return outs, (xs, outs)
+
+    def f_bwd(res, gs):
+        xs, outs = res
+        in_structs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                           for x in xs)
+        grads = jax.pure_callback(host_backward, in_structs,
+                                  *gs, *xs, *outs, *aux)
+        return tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*args)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    # aux states pass through unchanged (host-side aux mutation is not
+    # propagated; the reference mutates aux in place on the engine thread)
+    return tuple(outs) + tuple(aux)

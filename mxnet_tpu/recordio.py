@@ -1,0 +1,208 @@
+"""RecordIO: the reference's binary record container.
+
+Reference: ``python/mxnet/recordio.py`` + dmlc-core recordio (magic-framed
+records, `.idx` sidecar for random seek — SURVEY §2.1 Data IO row; C API
+MXRecordIO* `src/c_api/c_api.cc:710-787`).  Pure-python implementation
+writing the SAME on-disk format so `.rec` datasets interop with the
+reference's tools (im2rec).
+
+Format per record: [uint32 magic][uint32 lrecord][data][padding to 4B]
+where lrecord encodes cflag (upper 3 bits) and length (lower 29 bits).
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LENGTH_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.fid = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.fid.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fid"] = None
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+        if self.flag == "r":
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fid.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.fid.seek(pos)
+
+    def write(self, buf):
+        """Write one framed record."""
+        assert self.writable
+        lrec = len(buf) & _LENGTH_MASK
+        self.fid.write(struct.pack("<II", _MAGIC, lrec))
+        self.fid.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        """Read the next record, or None at EOF."""
+        assert not self.writable
+        header = self.fid.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise IOError("Invalid magic number in %s" % self.uri)
+        length = lrec & _LENGTH_MASK
+        buf = self.fid.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fid.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with `.idx` sidecar
+    (reference MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write("%s\t%d\n" % (str(k), self.idx[k]))
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# header of an image record (reference recordio.py IRHeader)
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload into a record string
+    (reference recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        header = header._replace(label=float(header.label))
+        packed = struct.pack(_IR_FORMAT, 0, header.label, header.id,
+                             header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label,
+                             header.id, header.id2) + label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (reference recordio.pack_img; PIL instead of
+    OpenCV)."""
+    import io as _pyio
+    from PIL import Image
+    im = Image.fromarray(img.astype(np.uint8))
+    buf = _pyio.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    kwargs = {"quality": quality} if fmt == "JPEG" else {}
+    im.save(buf, format=fmt, **kwargs)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, image array)."""
+    import io as _pyio
+    from PIL import Image
+    header, img_bytes = unpack(s)
+    im = Image.open(_pyio.BytesIO(img_bytes))
+    if iscolor == 0:
+        im = im.convert("L")
+    elif iscolor == 1:
+        im = im.convert("RGB")
+    return header, np.asarray(im)

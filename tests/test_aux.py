@@ -1,0 +1,144 @@
+"""Aux subsystems: recordio, custom op, profiler, monitor, visualization.
+
+Reference: tests/python/unittest/{test_recordio.py, test_operator.py
+(CustomOp), test_profiler.py, test_viz.py}."""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_recordio_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.rec")
+        w = mx.recordio.MXRecordIO(path, "w")
+        for i in range(5):
+            w.write(b"record_%d" % i)
+        w.close()
+        r = mx.recordio.MXRecordIO(path, "r")
+        for i in range(5):
+            assert r.read() == b"record_%d" % i
+        assert r.read() is None
+        r.close()
+
+
+def test_indexed_recordio():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.rec")
+        idx = os.path.join(d, "test.idx")
+        w = mx.recordio.MXIndexedRecordIO(idx, path, "w")
+        for i in range(5):
+            w.write_idx(i, b"record_%d" % i)
+        w.close()
+        r = mx.recordio.MXIndexedRecordIO(idx, path, "r")
+        assert r.read_idx(3) == b"record_3"
+        assert r.read_idx(0) == b"record_0"
+        r.close()
+
+
+def test_irheader_pack_unpack():
+    header = mx.recordio.IRHeader(0, 2.0, 7, 0)
+    s = mx.recordio.pack(header, b"payload")
+    h2, payload = mx.recordio.unpack(s)
+    assert h2.label == 2.0 and h2.id == 7
+    assert payload == b"payload"
+    # vector label
+    header = mx.recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 9, 0)
+    s = mx.recordio.pack(header, b"x")
+    h2, payload = mx.recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0].asnumpy() ** 2)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    2 * in_data[0].asnumpy() * out_grad[0].asnumpy())
+
+
+def test_custom_op_imperative():
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    y = mx.nd.Custom(x, op_type="sqr")
+    np.testing.assert_allclose(y.asnumpy(), [1.0, 4.0, 9.0])
+
+
+def test_custom_op_symbolic_grad():
+    data = mx.sym.Variable("data")
+    net = mx.sym.MakeLoss(mx.sym.Custom(data, op_type="sqr", name="sqr"))
+    ex = net.simple_bind(mx.cpu(), data=(3,))
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    ex.forward(is_train=True, data=x)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x ** 2)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-5)
+
+
+def test_profiler_chrome_trace():
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "profile.json")
+        mx.profiler.profiler_set_config(mode="all", filename=fname)
+        mx.profiler.profiler_set_state("run")
+        with mx.profiler.record_scope("test_op"):
+            pass
+        mx.profiler.profiler_set_state("stop")
+        mx.profiler.dump_profile()
+        with open(fname) as f:
+            trace = json.load(f)
+        assert "traceEvents" in trace
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "test_op" in names
+
+
+def test_monitor():
+    data = mx.sym.Variable("data")
+    net = mx.sym.sigmoid(data, name="sig")
+    ex = net.simple_bind(mx.cpu(), data=(2, 2))
+    mon = mx.Monitor(1, pattern=".*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(data=np.zeros((2, 2), np.float32))
+    res = mon.toc()
+    assert any("sig_output" == k for (_, k, _v) in res)
+
+
+def test_print_summary(capsys):
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    total = mx.viz.print_summary(out, shape={"data": (1, 8)})
+    captured = capsys.readouterr()
+    assert "fc(FullyConnected)" in captured.out
+    assert total == (8 + 1) * 4
+
+
+def test_image_aug():
+    if mx.image is None:
+        pytest.skip("PIL not available")
+    src = (np.random.rand(40, 30, 3) * 255).astype(np.uint8)
+    out = mx.image.resize_short(src, 32)
+    assert min(out.shape[:2]) == 32
+    crop, _ = mx.image.center_crop(src, (20, 20))
+    assert crop.shape[:2] == (20, 20)
+    augs = mx.image.CreateAugmenter((3, 24, 24), rand_mirror=True,
+                                    mean=True, std=True)
+    res = src
+    for aug in augs:
+        res = aug(res)[0]
+    assert res.shape == (24, 24, 3)
+    assert res.dtype == np.float32
