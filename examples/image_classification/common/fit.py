@@ -1,0 +1,161 @@
+"""Shared CLI training harness.
+
+Reference: ``example/image-classification/common/fit.py`` (:45-89 — the
+network/num-layers/devices/kv-store/lr-schedule/checkpoint argument set).
+Device flag parity: ``--gpus`` retained (maps to accelerator contexts, so
+reference commands run unchanged on TPU); ``--tpus`` is the native spelling.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    """Reference fit.py:45-89."""
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", type=str, default="mlp",
+                       help="the neural network to use")
+    train.add_argument("--num-layers", type=int,
+                       help="number of layers in the neural network, "
+                            "required by some networks such as resnet")
+    train.add_argument("--gpus", type=str,
+                       help="list of gpus to run, e.g. 0 or 0,2,5. "
+                            "empty means using cpu")
+    train.add_argument("--tpus", type=str,
+                       help="list of tpu cores to run on (native spelling "
+                            "of --gpus)")
+    train.add_argument("--kv-store", type=str, default="device",
+                       help="key-value store type")
+    train.add_argument("--num-epochs", type=int, default=100,
+                       help="max num of epochs")
+    train.add_argument("--lr", type=float, default=0.1,
+                       help="initial learning rate")
+    train.add_argument("--lr-factor", type=float, default=0.1,
+                       help="the ratio to reduce lr on each step")
+    train.add_argument("--lr-step-epochs", type=str,
+                       help="the epochs to reduce the lr, e.g. 30,60")
+    train.add_argument("--optimizer", type=str, default="sgd",
+                       help="the optimizer type")
+    train.add_argument("--mom", type=float, default=0.9,
+                       help="momentum for sgd")
+    train.add_argument("--wd", type=float, default=0.0001,
+                       help="weight decay for sgd")
+    train.add_argument("--batch-size", type=int, default=128,
+                       help="the batch size")
+    train.add_argument("--disp-batches", type=int, default=20,
+                       help="show progress for every n batches")
+    train.add_argument("--model-prefix", type=str,
+                       help="model prefix for checkpointing")
+    train.add_argument("--load-epoch", type=int,
+                       help="load the model on an epoch using the "
+                            "model-prefix")
+    train.add_argument("--top-k", type=int, default=0,
+                       help="report the top-k accuracy. 0 means no report.")
+    train.add_argument("--test-io", type=int, default=0,
+                       help="1 means test reading speed without training")
+    train.add_argument("--monitor", dest="monitor", type=int, default=0,
+                       help="log network parameters every N iters if larger "
+                            "than 0")
+    return train
+
+
+def _get_contexts(args):
+    spec = args.tpus or args.gpus
+    if spec:
+        return [mx.tpu(int(i)) for i in spec.split(",")]
+    return [mx.cpu()]
+
+
+def _get_lr_scheduler(args, kv):
+    if not args.lr_step_epochs:
+        return (args.lr, None)
+    epoch_size = args.num_examples // args.batch_size
+    if "dist" in args.kv_store:
+        epoch_size //= kv.num_workers
+    begin_epoch = args.load_epoch if args.load_epoch else 0
+    step_epochs = [int(l) for l in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin_epoch >= s:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjust learning rate to %e for epoch %d", lr,
+                     begin_epoch)
+    steps = [epoch_size * (x - begin_epoch) for x in step_epochs
+             if x - begin_epoch > 0]
+    return (lr, mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                     factor=args.lr_factor))
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Train the model (reference fit.py fit())."""
+    kv = mx.kv.create(args.kv_store)
+    logging.basicConfig(level=logging.DEBUG,
+                        format="%(asctime)-15s Node[" + str(kv.rank) +
+                        "] %(message)s")
+    logging.info("start with arguments %s", args)
+
+    (train, val) = data_loader(args, kv)
+    if args.test_io:
+        tic = time.time()
+        for i, batch in enumerate(train):
+            for j in batch.data:
+                j.wait_to_read()
+            if (i + 1) % args.disp_batches == 0:
+                logging.info("Batch [%d]\tSpeed: %.2f samples/sec", i,
+                             args.disp_batches * args.batch_size /
+                             (time.time() - tic))
+                tic = time.time()
+        return
+
+    if args.load_epoch and args.model_prefix:
+        sym, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+    else:
+        sym, arg_params, aux_params = network, None, None
+
+    devs = _get_contexts(args)
+    lr, lr_scheduler = _get_lr_scheduler(args, kv)
+
+    model = mx.module.Module(context=devs, symbol=sym)
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "wd": args.wd,
+        "lr_scheduler": lr_scheduler,
+    }
+    if args.optimizer in ("sgd", "nag", "dcasgd"):
+        optimizer_params["momentum"] = args.mom
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=args.top_k))
+
+    batch_end_callbacks = [mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches)]
+    checkpoint = None
+    if args.model_prefix:
+        checkpoint = mx.callback.do_checkpoint(
+            args.model_prefix if kv.rank == 0 else
+            "%s-%d" % (args.model_prefix, kv.rank))
+
+    monitor = mx.Monitor(args.monitor, pattern=".*") if args.monitor > 0 \
+        else None
+
+    model.fit(train, begin_epoch=args.load_epoch or 0,
+              num_epoch=args.num_epochs, eval_data=val,
+              eval_metric=eval_metrics, kvstore=kv,
+              optimizer=args.optimizer, optimizer_params=optimizer_params,
+              initializer=mx.initializer.Xavier(
+                  rnd_type="gaussian", factor_type="in", magnitude=2),
+              arg_params=arg_params, aux_params=aux_params,
+              batch_end_callback=batch_end_callbacks,
+              epoch_end_callback=checkpoint, allow_missing=True,
+              monitor=monitor)
+    return model

@@ -1,0 +1,124 @@
+"""LSTM language model with bucketing.
+
+Reference: ``example/rnn/lstm_bucketing.py`` — PennTreeBank LSTM with
+BucketingModule (the dynamic-shape acid test, SURVEY §5.7: one jit cache
+entry per bucket).  Reads PTB-format text if present, else synthesizes a
+corpus.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(
+    description="Train RNN on Penn Tree Bank",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--data-dir", type=str, default="data/ptb/")
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--gpus", type=str)
+parser.add_argument("--kv-store", type=str, default="device")
+parser.add_argument("--num-epochs", type=int, default=25)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--optimizer", type=str, default="sgd")
+parser.add_argument("--mom", type=float, default=0.0)
+parser.add_argument("--wd", type=float, default=0.00001)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--stack-rnn", default=False, action="store_true")
+parser.add_argument("--bidirectional", default=False, action="store_true")
+
+buckets = [10, 20, 30, 40, 50, 60]
+start_label = 1
+invalid_label = 0
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    """Reference lstm_bucketing.tokenize_text."""
+    with open(fname) as f:
+        lines = f.readlines()
+    lines = [filter(None, i.split(" ")) for i in lines]
+    sentences, vocab = mx.rnn.encode_sentences(
+        lines, vocab=vocab, invalid_label=invalid_label,
+        start_label=start_label)
+    return sentences, vocab
+
+
+def synthetic_corpus(n=2000, vocab_size=500, seed=0):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(start_label, vocab_size,
+                             rng.randint(5, 60)))
+            for _ in range(n)], vocab_size
+
+
+if __name__ == "__main__":
+    args = parser.parse_args()
+
+    train_file = os.path.join(args.data_dir, "ptb.train.txt")
+    if os.path.exists(train_file):
+        train_sent, vocab = tokenize_text(
+            train_file, start_label=start_label,
+            invalid_label=invalid_label)
+        val_sent, _ = tokenize_text(
+            os.path.join(args.data_dir, "ptb.test.txt"), vocab=vocab,
+            invalid_label=invalid_label)
+        vocab_size = len(vocab) + start_label
+    else:
+        train_sent, vocab_size = synthetic_corpus(2000)
+        val_sent, _ = synthetic_corpus(200, vocab_size, seed=1)
+
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=buckets,
+                                           invalid_label=invalid_label)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets,
+                                         invalid_label=invalid_label)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    if args.gpus:
+        contexts = [mx.tpu(int(i)) for i in args.gpus.split(",")]
+    else:
+        contexts = mx.cpu(0)
+
+    model = mx.module.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=contexts)
+
+    model.fit(
+        train_data=data_train, eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        kvstore=args.kv_store, optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                          "wd": args.wd},
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))

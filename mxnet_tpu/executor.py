@@ -50,6 +50,19 @@ class Executor:
         self._monitor_callback = None
         self._monitor_all = False
 
+        # model-parallel placement: ctx_group attr -> device (reference
+        # AssignContext + PlaceDevice, graph_executor.cc:249-341)
+        self._device_map = {}
+        if self._group2ctx:
+            topo_nodes = symbol._topo()
+            for node in topo_nodes:
+                if node.is_variable:
+                    continue
+                grp = node.raw_attr.get("ctx_group")
+                dev_ctx = self._group2ctx.get(grp, self._ctx) if grp \
+                    else self._ctx
+                self._device_map[id(node)] = dev_ctx.jax_device()
+
         self._topo = symbol._topo()
         self._arg_nodes, self._aux_nodes = _classify_vars(self._topo)
         self._arg_names = [n.name for n in self._arg_nodes]
@@ -136,15 +149,22 @@ class Executor:
             bsz = vals[0].shape[0] if vals and vals[0].ndim else None
             heads, aux_updates = eval_graph(topo, entries, var_values,
                                             is_train=is_train, key=key,
-                                            batch_size=bsz)
+                                            batch_size=bsz,
+                                            device_map=self._device_map)
             n_args = len(self._arg_nodes)
             aux_out = [aux_updates.get(id(n), vals[n_args + i])
                        for i, n in enumerate(self._aux_nodes)]
             return heads, aux_out
 
-        fn = jax.jit(raw)
+        # a multi-device placed graph must run eagerly: jit would collapse
+        # per-node device_put placements onto one device (the reference
+        # runs per-node engine pushes anyway; XLA async dispatch overlaps)
+        fn = raw if self._multi_device_placed() else jax.jit(raw)
         self._fwd_cache[is_train] = fn
         return fn
+
+    def _multi_device_placed(self):
+        return len(set(self._device_map.values())) > 1
 
     def _get_backward_fn(self, with_head_grads):
         key_ = with_head_grads
@@ -170,7 +190,8 @@ class Executor:
                 bsz = full[0].shape[0] if full and full[0].ndim else None
                 heads, _aux = eval_graph(topo, entries, var_values,
                                          is_train=True, key=key,
-                                         batch_size=bsz)
+                                         batch_size=bsz,
+                                         device_map=self._device_map)
                 return heads
 
             heads, vjp = jax.vjp(f, diff_vals)
@@ -182,7 +203,7 @@ class Executor:
             (grads,) = vjp(list(cot))
             return grads
 
-        fn = jax.jit(raw)
+        fn = raw if self._multi_device_placed() else jax.jit(raw)
         self._bwd_cache[key_] = fn
         return fn
 
@@ -235,7 +256,7 @@ class Executor:
         heads, aux_updates = eval_graph(
             self._topo, self._symbol._entries, var_values,
             is_train=bool(is_train), key=key, monitor=monitor,
-            batch_size=bsz)
+            batch_size=bsz, device_map=self._device_map)
         n_args = len(self._arg_nodes)
         vals = self._gather_vals()
         aux_out = [aux_updates.get(id(n), vals[n_args + i])

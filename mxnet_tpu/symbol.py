@@ -103,7 +103,7 @@ def _classify_vars(topo):
 
 
 def eval_graph(topo, entries, var_values, is_train=False, key=None,
-               monitor=None, batch_size=None):
+               monitor=None, batch_size=None, device_map=None):
     """Execute the DAG as a pure function.
 
     ``var_values``: dict id(var-node) -> array.  Returns (head values,
@@ -113,10 +113,17 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
     ``batch_size`` specializes 0-dims in init-op shapes (the RNN toolkit's
     deferred begin_state zeros; the reference resolves these via nnvm
     backward shape inference).
+
+    ``device_map`` (id(node) -> jax.Device) places each op on a device —
+    the model-parallel ctx_group path (reference AssignContext +
+    PlaceDevice inserting _CrossDeviceCopy, graph_executor.cc:249-341;
+    here the copy is a jax.device_put and XLA async dispatch overlaps the
+    per-device segments).
     """
     import jax
     vals = {}
     aux_updates = {}
+    device_map = device_map or {}
     for i, node in enumerate(topo):
         if node.is_variable:
             try:
@@ -125,6 +132,9 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
                 raise MXNetError("no value bound for variable %r" % node.name)
             continue
         ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
+        dev = device_map.get(id(node))
+        if dev is not None:
+            ins = [jax.device_put(x, dev) for x in ins]
         node_attrs = node.attrs
         shp = node_attrs.get("shape")
         if isinstance(shp, (tuple, list)) and any(s == 0 for s in shp):

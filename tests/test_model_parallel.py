@@ -1,0 +1,68 @@
+"""Model parallelism via ctx_group — reference
+tests/python/unittest/test_model_parallel.py + test_multi_device_exec.py
+(CPU contexts impersonate devices, SURVEY §4.2)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+        act1 = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(data=act1, num_hidden=8, name="fc2")
+        net = mx.sym.MakeLoss(fc2, name="loss")
+    return net
+
+
+def test_ctx_group_attrs():
+    net = build_net()
+    attrs = net.attr_dict()
+    assert attrs["fc1"]["ctx_group"] == "dev1"
+    assert attrs["fc2"]["ctx_group"] == "dev2"
+
+
+def test_multi_device_exec_forward_backward():
+    """Cross-device graph == single-device graph (reference
+    test_model_parallel.py:12-50)."""
+    net = build_net()
+    shapes = {"data": (4, 10)}
+    rng = np.random.RandomState(0)
+
+    arg_names = net.list_arguments()
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    arrays = {n: rng.uniform(-1, 1, s).astype(np.float32)
+              for n, s in zip(arg_names, arg_shapes)}
+
+    def run(group2ctx):
+        ex = net.bind(mx.cpu(0),
+                      {n: mx.nd.array(v) for n, v in arrays.items()},
+                      grad_req="write", group2ctx=group2ctx)
+        ex.forward(is_train=True)
+        out = ex.outputs[0].asnumpy()
+        ex.backward()
+        grads = {n: g.asnumpy() for n, g in ex.grad_dict.items()}
+        return out, grads
+
+    out1, grads1 = run(None)
+    out2, grads2 = run({"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+    for n in grads1:
+        np.testing.assert_allclose(grads1[n], grads2[n], rtol=1e-5,
+                                   err_msg=n)
+
+
+def test_placement_actually_crosses_devices():
+    """Outputs of dev2-group ops land on the dev2 jax device."""
+    import jax
+    if len(jax.devices()) < 2:
+        return
+    net = build_net()
+    ex = net.simple_bind(mx.cpu(0), grad_req="null", data=(2, 10),
+                         group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    ex.forward(data=np.ones((2, 10), np.float32))
+    out_dev = list(ex.outputs[0].data.devices())[0]
+    assert out_dev == mx.cpu(1).jax_device()

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Multi-host job launcher.
+
+Reference: ``tools/launch.py`` delegating to dmlc_tracker
+(ssh/mpi/sge/yarn, launch.py:11-29) to bootstrap scheduler + servers +
+workers with DMLC_* env.  TPU-native design (SURVEY §5.8): there are no
+parameter servers — every host runs the SAME script and joins one
+``jax.distributed`` job; this launcher sets the coordinator env
+(MXNET_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID, consumed by
+``mxnet_tpu.parallel.dist_kvstore.DistKVStore.init_env``) and forks local
+workers (``--launcher local``, the reference's single-host test mode for
+multi-node semantics) or SSHes to hosts (``--launcher ssh``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def dmlc_opts(opts):
+    """Map the reference's flags onto env for each process."""
+    env = dict(os.environ)
+    env["MXNET_TPU_NUM_PROCESSES"] = str(opts.num_workers)
+    env["MXNET_TPU_COORDINATOR"] = opts.coordinator
+    return env
+
+
+def launch_local(opts, command):
+    """Fork N workers on this host (reference dmlc_tracker local mode —
+    multi-node semantics without a cluster, SURVEY §4.6)."""
+    procs = []
+    base_env = dmlc_opts(opts)
+    for rank in range(opts.num_workers):
+        env = dict(base_env)
+        env["MXNET_TPU_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(command, shell=True, env=env))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def launch_ssh(opts, command):
+    """One worker per host over ssh."""
+    hosts = []
+    with open(opts.hostfile) as f:
+        for line in f:
+            h = line.strip()
+            if h:
+                hosts.append(h)
+    assert len(hosts) >= opts.num_workers
+    procs = []
+    for rank in range(opts.num_workers):
+        env_prefix = ("MXNET_TPU_NUM_PROCESSES=%d MXNET_TPU_PROCESS_ID=%d "
+                      "MXNET_TPU_COORDINATOR=%s"
+                      % (opts.num_workers, rank, opts.coordinator))
+        cmd = "ssh -o StrictHostKeyChecking=no %s 'cd %s; %s %s'" % (
+            hosts[rank], os.getcwd(), env_prefix, command)
+        procs.append(subprocess.Popen(cmd, shell=True))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (reference tools/launch.py)")
+    parser.add_argument("-n", "--num-workers", required=True, type=int,
+                        help="number of worker processes to launch")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for reference CLI parity; the TPU "
+                             "backend has no parameter servers (collectives "
+                             "replace them)")
+    parser.add_argument("-H", "--hostfile", type=str,
+                        help="host file with one host per line (ssh mode)")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh"],
+                        help="cluster launcher mode")
+    parser.add_argument("--coordinator", type=str,
+                        default="127.0.0.1:8431",
+                        help="jax.distributed coordinator address")
+    parser.add_argument("command", nargs="+", help="command to launch")
+    opts = parser.parse_args()
+    command = " ".join(opts.command)
+    if opts.launcher == "local":
+        code = launch_local(opts, command)
+    else:
+        code = launch_ssh(opts, command)
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

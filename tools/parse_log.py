@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Parse training logs into a table.
+
+Reference: ``tools/parse_log.py`` — extracts per-epoch train/val accuracy
+and speed from the Speedometer/epoch log lines (same line formats here).
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse_log(lines):
+    res = [re.compile(r".*Epoch\[(\d+)\] Train-([a-z0-9_\-]+)=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Validation-([a-z0-9_\-]+)=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Time cost=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Batch \[(\d+)\]\tSpeed: ([.\d]+)")]
+    data = {}
+    speeds = {}
+    for l in lines:
+        i = 0
+        while i < len(res):
+            m = res[i].match(l)
+            if m:
+                break
+            i += 1
+        else:
+            continue
+        assert len(m.groups()) <= 3
+        epoch = int(m.groups()[0])
+        if epoch not in data:
+            data[epoch] = {}
+        if i == 0:
+            data[epoch]["train-" + m.groups()[1]] = float(m.groups()[2])
+        elif i == 1:
+            data[epoch]["val-" + m.groups()[1]] = float(m.groups()[2])
+        elif i == 2:
+            data[epoch]["time"] = float(m.groups()[1])
+        else:
+            speeds.setdefault(epoch, []).append(float(m.groups()[2]))
+    for epoch, sp in speeds.items():
+        data.setdefault(epoch, {})["speed"] = sum(sp) / len(sp)
+    return data
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Parse mxnet_tpu training logs")
+    parser.add_argument("logfile", nargs=1, type=str)
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"])
+    args = parser.parse_args()
+    with open(args.logfile[0]) as f:
+        lines = f.readlines()
+    data = parse_log(lines)
+    if not data:
+        print("no epochs found")
+        return
+    keys = sorted({k for v in data.values() for k in v})
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(keys) + " |")
+        print("| --- " * (len(keys) + 1) + "|")
+    for epoch in sorted(data):
+        row = [str(epoch)] + ["%.6g" % data[epoch].get(k, float("nan"))
+                              for k in keys]
+        print("| " + " | ".join(row) + " |")
+
+
+if __name__ == "__main__":
+    main()
