@@ -1,0 +1,111 @@
+"""Model-parallel LSTM: each layer group on its own device.
+
+Reference: ``example/model-parallel-lstm/lstm.py`` (:48-112 layers placed on
+different GPUs via ctx_group, :142-205 executors with grad_req='add').
+TPU-native: ctx_group maps onto per-device placement in the executor
+(SURVEY §2.4 row 'Model parallelism'); XLA async dispatch pipelines the
+per-device segments the way the reference's dependency engine overlaps
+ctx groups.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def build_lstm(num_layers, seq_len, num_hidden, num_embed, vocab,
+               group_per_layer=True):
+    """Stacked LSTM with one ctx_group per layer."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    with mx.AttrScope(ctx_group="embed"):
+        embed = mx.sym.Embedding(data=data, input_dim=vocab,
+                                 output_dim=num_embed, name="embed")
+    inputs = embed
+    for i in range(num_layers):
+        group = "layer%d" % i if group_per_layer else "layers"
+        with mx.AttrScope(ctx_group=group):
+            cell = mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                   prefix="lstm_l%d_" % i)
+            outputs, _ = cell.unroll(seq_len, inputs=inputs,
+                                     merge_outputs=True)
+        inputs = outputs
+    with mx.AttrScope(ctx_group="decode"):
+        pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab,
+                                     name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        net = mx.sym.SoftmaxOutput(data=pred, label=lab, name="softmax")
+    return net
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="model-parallel LSTM (reference "
+                    "example/model-parallel-lstm)")
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--vocab", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-batches", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+
+    import jax
+    n_dev = len(jax.devices())
+    # map layer groups round-robin over available devices
+    group2ctx = {"embed": mx.cpu(0) if n_dev == 1 else mx.tpu(0)}
+    for i in range(args.num_layers):
+        dev = (i + 1) % max(n_dev, 1)
+        group2ctx["layer%d" % i] = mx.cpu(dev) if n_dev == 1 \
+            else mx.tpu(dev)
+    group2ctx["decode"] = group2ctx["layer%d" % (args.num_layers - 1)]
+
+    net = build_lstm(args.num_layers, args.seq_len, args.num_hidden,
+                     args.num_embed, args.vocab)
+
+    # grad_req='add' as the reference uses for shared params across
+    # ctx groups (example/model-parallel-lstm/lstm.py:199)
+    ex = net.simple_bind(mx.cpu(0), grad_req="add",
+                         data=(args.batch_size, args.seq_len),
+                         softmax_label=(args.batch_size, args.seq_len),
+                         group2ctx=group2ctx)
+    init = mx.initializer.Xavier()
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            init(k, v)
+
+    rng = np.random.RandomState(0)
+    opt = mx.optimizer.create("sgd", learning_rate=args.lr,
+                              rescale_grad=1.0 / args.batch_size)
+    updater = mx.optimizer.get_updater(opt)
+
+    for step in range(args.num_batches):
+        x = rng.randint(0, args.vocab,
+                        (args.batch_size, args.seq_len)).astype(np.float32)
+        y = np.roll(x, -1, axis=1)
+        for g in ex.grad_dict.values():
+            g[:] = 0.0
+        ex.forward(is_train=True, data=x, softmax_label=y)
+        ex.backward()
+        for i, name in enumerate(k for k in ex.arg_dict
+                                 if k not in ("data", "softmax_label")):
+            updater(i, ex.grad_dict[name], ex.arg_dict[name])
+        probs = ex.outputs[0].asnumpy()
+        idx = y.reshape(-1).astype(int)
+        nll = -np.log(np.maximum(
+            probs[np.arange(probs.shape[0]), idx], 1e-10)).mean()
+        print("batch %d  nll %.4f" % (step, nll))
+
+
+if __name__ == "__main__":
+    main()

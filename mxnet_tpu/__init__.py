@@ -53,6 +53,7 @@ from . import recordio
 from . import visualization
 from . import visualization as viz
 from . import test_utils
+from . import contrib
 
 # optional: image pipeline needs PIL
 try:

@@ -1,0 +1,12 @@
+"""Contrib namespace (reference: ``python/mxnet/contrib/``).
+
+``mx.contrib.autograd`` is the imperative autograd surface
+(reference python/mxnet/contrib/autograd.py); ``ndarray``/``symbol`` give
+prefix-free access to the ``_contrib_*`` op corpus (MultiBox*, CTCLoss,
+fft, quantize, count_sketch — src/operator/contrib/).
+"""
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym

@@ -1,0 +1,27 @@
+"""Imperative autograd surface (reference python/mxnet/contrib/autograd.py).
+
+Thin re-export of :mod:`mxnet_tpu.autograd` under the reference's contrib
+path so scripts using ``mx.contrib.autograd.train_section()`` port
+unchanged.
+"""
+from ..autograd import (is_training, set_is_training, train_section,
+                        test_section, record, pause, mark_variables,
+                        backward, grad_and_loss)
+
+__all__ = ["is_training", "set_is_training", "train_section",
+           "test_section", "mark_variables", "backward", "grad_and_loss"]
+
+
+def compute_gradient(outputs):
+    """Reference contrib/autograd.compute_gradient."""
+    backward(outputs)
+
+
+def grad(func, argnum=None):
+    """Return a function computing only gradients (reference
+    contrib/autograd.grad)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+    return wrapped

@@ -1,0 +1,15 @@
+"""Prefix-free imperative access to contrib ops: ``mx.contrib.nd.
+MultiBoxPrior(...)`` == ``mx.nd._contrib_MultiBoxPrior(...)``."""
+from .. import ndarray as _nd
+
+_PREFIX = "_contrib_"
+
+
+def _populate():
+    g = globals()
+    for name in dir(_nd):
+        if name.startswith(_PREFIX):
+            g[name[len(_PREFIX):]] = getattr(_nd, name)
+
+
+_populate()

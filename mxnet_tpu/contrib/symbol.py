@@ -1,0 +1,15 @@
+"""Prefix-free symbolic access to contrib ops: ``mx.contrib.sym.
+MultiBoxPrior(...)`` == ``mx.sym._contrib_MultiBoxPrior(...)``."""
+from .. import symbol as _sym
+
+_PREFIX = "_contrib_"
+
+
+def _populate():
+    g = globals()
+    for name in dir(_sym):
+        if name.startswith(_PREFIX):
+            g[name[len(_PREFIX):]] = getattr(_sym, name)
+
+
+_populate()

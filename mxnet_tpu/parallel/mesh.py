@@ -36,7 +36,11 @@ def build_mesh(n_devices=None, tp=1, axis_names=("data", "model"),
             devices = devices[:n_devices]
     n = len(devices)
     assert n % tp == 0, "n_devices %d not divisible by tp %d" % (n, tp)
-    arr = np.array(devices).reshape(n // tp, tp)
+    if len(axis_names) == 1:
+        assert tp == 1, "single-axis mesh cannot have tp > 1"
+        arr = np.array(devices)
+    else:
+        arr = np.array(devices).reshape(n // tp, tp)
     return Mesh(arr, axis_names=axis_names)
 
 

@@ -1,0 +1,106 @@
+"""Sequence/context parallelism: ring attention over a device mesh.
+
+The reference (2017-era) has no attention ops; its long-sequence story is
+bucketing (SURVEY §5.7).  This module is the TPU-native long-context
+capability the new framework treats as first-class: sequence-sharded
+attention where K/V blocks rotate around the ICI ring (``lax.ppermute``)
+while each device holds its Q shard — HBM use per device is O(T/n), and
+compute overlaps the neighbor transfer (Ring Attention; flash-style online
+softmax keeps the accumulation numerically stable).
+
+Layouts: q/k/v are (batch, seq, heads, head_dim), sharded along ``seq``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+
+__all__ = ["ring_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, causal=False):
+    """Single-device softmax attention (the correctness oracle)."""
+    import jax.numpy as jnp
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    # (B, H, Tq, Tk)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_attention_local(q, k, v, axis_name, causal):
+    """Per-shard body under shard_map: rotate K/V around the ring."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: pass to neighbor
+
+    b, _, h, d = q.shape
+    o = jnp.zeros((b, t_local, h, d), jnp.float32)
+    l = jnp.zeros((b, h, t_local), jnp.float32)       # softmax denominator
+    m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)  # running max
+    # mark accumulators device-varying for shard_map's scan typing
+    o, l, m = (lax.pcast(x, (axis_name,), to="varying")
+               for x in (o, l, m))
+
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    def step(carry, step_idx):
+        o, l, m, k_blk, v_blk = carry
+        src_idx = (my_idx - step_idx) % n          # whose block we hold
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src_idx * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(-1))
+        # guard fully-masked rows (exp(-inf - -inf))
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, l_new, m_new, k_next, v_next), None
+
+    (o, l, m, _, _), _ = lax.scan(step, (o, l, m, k, v), jnp.arange(n))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = o / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, seq_axis="data", causal=False):
+    """Sequence-parallel attention.
+
+    q/k/v: (batch, seq, heads, head_dim) with ``seq`` sharded over
+    ``seq_axis`` of ``mesh``.  Returns the attention output with the same
+    sharding.  K/V blocks ride the ICI ring; each of the n steps computes a
+    (T/n × T/n) block and the online softmax merges it.
+    """
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, seq_axis, None, None)
+    body = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                             causal=causal)
+    f = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=spec)
+    return f(q, k, v)
