@@ -1,0 +1,164 @@
+"""SSD detector symbol over reduced VGG-16.
+
+Reference: ``example/ssd/symbol/`` (VGG16-reduced backbone + per-scale
+multibox heads; contrib MultiBoxPrior/Target/Detection ops,
+src/operator/contrib/multibox_*.cc).  Structure follows the reference's
+multi-scale head wiring with the TPU-native contrib ops.
+"""
+from __future__ import annotations
+
+import sys
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def conv_act_layer(from_layer, name, num_filter, kernel=(3, 3), pad=(1, 1),
+                   stride=(1, 1), act_type="relu"):
+    conv = sym.Convolution(data=from_layer, kernel=kernel, pad=pad,
+                           stride=stride, num_filter=num_filter,
+                           name="conv{}".format(name))
+    relu = sym.Activation(data=conv, act_type=act_type,
+                          name="{}{}".format(act_type, name))
+    return relu
+
+
+def vgg16_reduced(data):
+    """VGG16 body with reduced fc6/fc7 as convs (reference
+    symbol/vgg16_reduced.py)."""
+    body = data
+    filters = [64, 128, 256, 512, 512]
+    layers = [2, 2, 3, 3, 3]
+    feat = {}
+    for i, (f, n) in enumerate(zip(filters, layers)):
+        for j in range(n):
+            body = sym.Convolution(data=body, kernel=(3, 3), pad=(1, 1),
+                                   num_filter=f,
+                                   name="conv%d_%d" % (i + 1, j + 1))
+            body = sym.Activation(data=body, act_type="relu",
+                                  name="relu%d_%d" % (i + 1, j + 1))
+        feat["relu%d_%d" % (i + 1, n)] = body
+        if i < 4:
+            body = sym.Pooling(data=body, pool_type="max", kernel=(2, 2),
+                               stride=(2, 2), name="pool%d" % (i + 1))
+        else:
+            body = sym.Pooling(data=body, pool_type="max", kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1),
+                               name="pool%d" % (i + 1))
+    # fc6/fc7 as dilated convs
+    body = sym.Convolution(data=body, kernel=(3, 3), pad=(6, 6),
+                           dilate=(6, 6), num_filter=1024, name="fc6")
+    body = sym.Activation(data=body, act_type="relu", name="relu6")
+    body = sym.Convolution(data=body, kernel=(1, 1), num_filter=1024,
+                           name="fc7")
+    body = sym.Activation(data=body, act_type="relu", name="relu7")
+    feat["relu7"] = body
+    return feat
+
+
+def multi_layer_feature(feat):
+    """Extra SSD feature scales (reference common.multi_layer_feature)."""
+    layers = [feat["relu4_3"], feat["relu7"]]
+    body = feat["relu7"]
+    for i, (f1, f2, s) in enumerate([(256, 512, 2), (128, 256, 2),
+                                     (128, 256, 2), (128, 256, 2)]):
+        body = conv_act_layer(body, "8_%d_1x1" % i, f1, kernel=(1, 1),
+                              pad=(0, 0))
+        body = conv_act_layer(body, "8_%d_3x3" % i, f2, kernel=(3, 3),
+                              pad=(1, 1), stride=(s, s))
+        layers.append(body)
+    return layers
+
+
+def multibox_layer(from_layers, num_classes, sizes, ratios):
+    """Per-scale loc/cls heads + priors (reference common.multibox_layer)."""
+    cls_preds = []
+    loc_preds = []
+    anchors = []
+    for k, from_layer in enumerate(from_layers):
+        size, ratio = sizes[k], ratios[k]
+        num_anchors = len(size) + len(ratio) - 1
+        # location prediction
+        loc = sym.Convolution(data=from_layer, kernel=(3, 3), pad=(1, 1),
+                              num_filter=num_anchors * 4,
+                              name="loc_pred%d_conv" % k)
+        loc = sym.transpose(loc, axes=(0, 2, 3, 1))
+        loc = sym.Flatten(data=loc)
+        loc_preds.append(loc)
+        # class prediction
+        cls = sym.Convolution(data=from_layer, kernel=(3, 3), pad=(1, 1),
+                              num_filter=num_anchors * (num_classes + 1),
+                              name="cls_pred%d_conv" % k)
+        cls = sym.transpose(cls, axes=(0, 2, 3, 1))
+        cls = sym.Reshape(cls, shape=(0, -1, num_classes + 1))
+        cls_preds.append(cls)
+        # anchors
+        anchor = mx.contrib.sym.MultiBoxPrior(
+            from_layer, sizes=tuple(size), ratios=tuple(ratio),
+            name="anchor%d" % k)
+        anchors.append(sym.Reshape(anchor, shape=(0, -1, 4)))
+    loc_preds = sym.Concat(*loc_preds, dim=1, name="multibox_loc_pred")
+    cls_preds = sym.Concat(*cls_preds, dim=1, name="multibox_cls_pred")
+    cls_preds = sym.transpose(cls_preds, axes=(0, 2, 1))
+    anchors = sym.Concat(*anchors, dim=1, name="multibox_anchors")
+    return [loc_preds, cls_preds, anchors]
+
+
+DEFAULT_SIZES = [[0.1, 0.141], [0.2, 0.272], [0.37, 0.447], [0.54, 0.619],
+                 [0.71, 0.79], [0.88, 0.961]]
+DEFAULT_RATIOS = [[1, 2, 0.5], [1, 2, 0.5, 3, 1.0 / 3],
+                  [1, 2, 0.5, 3, 1.0 / 3], [1, 2, 0.5, 3, 1.0 / 3],
+                  [1, 2, 0.5], [1, 2, 0.5]]
+
+
+def get_symbol_train(num_classes=20, **kwargs):
+    """Training net: multibox target + losses (reference
+    symbol_builder.get_symbol_train)."""
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    feat = vgg16_reduced(data)
+    layers = multi_layer_feature(feat)
+    loc_preds, cls_preds, anchors = multibox_layer(
+        layers, num_classes, DEFAULT_SIZES, DEFAULT_RATIOS)
+
+    tmp = mx.contrib.sym.MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=0.5,
+        ignore_label=-1, negative_mining_ratio=3, minimum_negative_samples=0,
+        negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2),
+        name="multibox_target")
+    loc_target = tmp[0]
+    loc_target_mask = tmp[1]
+    cls_target = tmp[2]
+
+    cls_prob = sym.SoftmaxOutput(data=cls_preds, label=cls_target,
+                                 ignore_label=-1, use_ignore=True,
+                                 multi_output=True,
+                                 normalization="valid", name="cls_prob")
+    loc_diff = loc_target_mask * (loc_preds - loc_target)
+    loc_loss = sym.MakeLoss(sym.smooth_l1(loc_diff, scalar=1.0),
+                            grad_scale=1.0, normalization="valid",
+                            name="loc_loss")
+    cls_label = sym.BlockGrad(cls_target, name="cls_label")
+    det = mx.contrib.sym.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=0.45,
+        force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+        nms_topk=400, name="detection")
+    det = sym.BlockGrad(det, name="det_out")
+    return sym.Group([cls_prob, loc_loss, cls_label, det])
+
+
+def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
+               nms_topk=400, **kwargs):
+    """Inference net (reference symbol_builder.get_symbol)."""
+    data = sym.Variable("data")
+    feat = vgg16_reduced(data)
+    layers = multi_layer_feature(feat)
+    loc_preds, cls_preds, anchors = multibox_layer(
+        layers, num_classes, DEFAULT_SIZES, DEFAULT_RATIOS)
+    cls_prob = sym.SoftmaxActivation(data=cls_preds, mode="channel",
+                                     name="cls_prob")
+    out = mx.contrib.sym.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
+        force_suppress=force_suppress, variances=(0.1, 0.1, 0.2, 0.2),
+        nms_topk=nms_topk, name="detection")
+    return out

@@ -54,6 +54,7 @@ from . import visualization
 from . import visualization as viz
 from . import test_utils
 from . import contrib
+from . import config
 
 # optional: image pipeline needs PIL
 try:

@@ -1,0 +1,80 @@
+"""Runtime configuration: the MXNET_* env-var catalog.
+
+Reference: ``docs/how_to/env_var.md`` + ``dmlc::GetEnv`` reads at singleton
+init (SURVEY §5.6).  The TPU build honors the same names where the concept
+survives; names whose job XLA took over are documented as accepted-but-
+inert so existing launch scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get", "get_int", "get_bool", "describe"]
+
+# name -> (default, status, note)
+_CATALOG = {
+    # engine / threading — XLA owns scheduling; kept for script compat
+    "MXNET_ENGINE_TYPE": ("ThreadedEnginePerDevice", "inert",
+                          "XLA async dispatch replaces the engine; "
+                          "NaiveEngine debugging == JAX_DISABLE_JIT=1"),
+    "MXNET_CPU_WORKER_NTHREADS": ("1", "inert", "XLA intra-op threading"),
+    "MXNET_GPU_WORKER_NTHREADS": ("2", "inert", ""),
+    "MXNET_GPU_COPY_NTHREADS": ("2", "inert", ""),
+    "MXNET_CPU_PRIORITY_NTHREADS": ("4", "inert", ""),
+    # memory
+    "MXNET_GPU_MEM_POOL_RESERVE": ("5", "inert",
+                                   "XLA/PJRT owns the HBM allocator"),
+    "MXNET_EXEC_NUM_TEMP": ("1", "inert", ""),
+    # executor
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": ("1", "inert",
+                                       "whole-graph jit is always on"),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": ("1", "inert", ""),
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN": ("15", "inert", ""),
+    "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": ("8", "inert", ""),
+    "MXNET_BACKWARD_DO_MIRROR": ("0", "honored",
+                                 "maps to jax.checkpoint/remat in the "
+                                 "fused trainer"),
+    "NNVM_EXEC_MATCH_RANGE": ("16", "inert", "XLA memory planning"),
+    # kvstore
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": ("4", "inert", ""),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": ("1000000", "honored",
+                                     "update_on_kvstore heuristic"),
+    "MXNET_ENABLE_GPU_P2P": ("1", "inert", "ICI is always direct"),
+    # profiler
+    "MXNET_PROFILER_AUTOSTART": ("0", "honored", "see profiler.py"),
+    "MXNET_PROFILER_MODE": ("0", "honored", ""),
+    "MXNET_PROFILER_FILENAME": ("profile.json", "honored", ""),
+    "MXNET_PROFILER_XLA_DIR": ("", "honored", "xprof trace capture dir"),
+    # cudnn — no analogue
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": ("0", "inert",
+                                     "XLA autotuning is automatic"),
+    # tests
+    "MXNET_TEST_DEVICE": ("cpu", "honored", "test_utils.default_context"),
+    # TPU-native additions
+    "MXNET_TPU_NUM_PROCESSES": ("1", "honored",
+                                "multi-host bootstrap (tools/launch.py)"),
+    "MXNET_TPU_PROCESS_ID": ("0", "honored", ""),
+    "MXNET_TPU_COORDINATOR": ("", "honored",
+                              "jax.distributed coordinator address"),
+}
+
+
+def get(name, default=None):
+    if name in _CATALOG and default is None:
+        default = _CATALOG[name][0]
+    return os.environ.get(name, default)
+
+
+def get_int(name, default=None):
+    v = get(name, default)
+    return int(v) if v not in (None, "") else 0
+
+
+def get_bool(name, default=None):
+    v = get(name, default)
+    return str(v) in ("1", "true", "True")
+
+
+def describe():
+    """Catalog as {name: (default, status, note)} — the env_var.md table."""
+    return dict(_CATALOG)

@@ -1,0 +1,103 @@
+"""ctypes binding to the native IO library (src/recordio.cc).
+
+Reference: the C++ data pipeline (`src/io/iter_prefetcher.h` +
+dmlc-core recordio) — here a small C++ shared library with a background
+prefetch thread and a bounded queue, auto-built on first use (make -C src)
+and loaded via ctypes (the environment has no pybind11; SURVEY §7 native
+policy).  Falls back cleanly when no compiler is available — callers
+check :func:`available`.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+_LIB_PATH = os.path.join(_SRC_DIR, "libmxtpu_io.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                           capture_output=True)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.MXTPURecordIOReaderCreate.restype = ctypes.c_void_p
+    lib.MXTPURecordIOReaderCreate.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_int64]
+    lib.MXTPURecordIOReaderFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPURecordIOReaderNext.restype = ctypes.c_int64
+    lib.MXTPURecordIOReaderNext.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+    lib.MXTPURecordIOReadFloatBatch.restype = ctypes.c_int64
+    lib.MXTPURecordIOReadFloatBatch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
+    _LIB = lib
+    return lib
+
+
+def available():
+    return _load() is not None
+
+
+class NativeRecordIOReader:
+    """Threaded-prefetch sequential reader over the reference .rec format."""
+
+    def __init__(self, path, queue_cap=64, max_record=1 << 24):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native IO library unavailable")
+        self._lib = lib
+        self._handle = lib.MXTPURecordIOReaderCreate(
+            path.encode(), queue_cap)
+        if not self._handle:
+            raise IOError("cannot open %s" % path)
+        self._buf = (ctypes.c_uint8 * max_record)()
+        self._max_record = max_record
+
+    def read(self):
+        """Next record bytes, or None at EOF."""
+        n = self._lib.MXTPURecordIOReaderNext(self._handle, self._buf,
+                                              self._max_record)
+        if n <= 0:
+            return None
+        return bytes(bytearray(self._buf[:n]))
+
+    def read_float_batch(self, batch, record_floats):
+        """Parse ``batch`` records of IRHeader+float32 payload into
+        (labels, data) numpy arrays in one native call."""
+        labels = np.zeros(batch, np.float32)
+        data = np.zeros((batch, record_floats), np.float32)
+        n = self._lib.MXTPURecordIOReadFloatBatch(
+            self._handle,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            record_floats, batch)
+        return int(n), labels, data
+
+    def close(self):
+        if self._handle:
+            self._lib.MXTPURecordIOReaderFree(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

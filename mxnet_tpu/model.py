@@ -40,11 +40,13 @@ def _create_kvstore(kvstore, num_device, arg_params):
         else:
             kv = kvs.create(kvstore)
             if kvstore == "local":
-                # biggest-key heuristic (reference: invalidate
-                # update_on_kvstore for big params on local)
+                # biggest-key heuristic (reference model.py:62-66 with
+                # MXNET_KVSTORE_BIGARRAY_BOUND)
+                from . import config
+                bound = config.get_int("MXNET_KVSTORE_BIGARRAY_BOUND")
                 max_size = max(np.prod(param.shape)
                                for param in arg_params.values())
-                if max_size > 1024 * 1024 * 16:
+                if max_size > bound:
                     update_on_kvstore = False
     else:
         raise TypeError("kvstore must be KVStore, str or None")

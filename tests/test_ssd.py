@@ -1,0 +1,65 @@
+"""SSD symbol + contrib MultiBox ops end-to-end — reference example/ssd +
+tests for src/operator/contrib/multibox_*.cc."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "examples", "ssd", "symbol"))
+
+import mxnet_tpu as mx
+
+
+def test_multibox_prior_shapes():
+    x = mx.nd.zeros((1, 8, 4, 4))
+    anchors = mx.contrib.nd.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                                          ratios=(1.0, 2.0))
+    # (1, num_anchors, 4); 4x4 grid x (2 sizes + 2 ratios - 1)
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()
+    assert (a >= -0.5).all() and (a <= 1.5).all()
+
+
+def test_multibox_target_and_detection():
+    rng = np.random.RandomState(0)
+    num_anchors, num_classes = 20, 3
+    anchor = mx.nd.array(
+        np.clip(np.sort(rng.rand(1, num_anchors, 4), axis=-1), 0, 1))
+    # one gt box: class 1
+    label = mx.nd.array(np.array(
+        [[[1, 0.1, 0.1, 0.5, 0.5], [-1, 0, 0, 0, 0]]], np.float32))
+    cls_pred = mx.nd.array(rng.rand(1, num_classes + 1, num_anchors))
+    out = mx.contrib.nd.MultiBoxTarget(anchor, label, cls_pred)
+    loc_target, loc_mask, cls_target = out
+    assert loc_target.shape == (1, num_anchors * 4)
+    assert cls_target.shape == (1, num_anchors)
+
+    cls_prob = mx.nd.array(rng.rand(1, num_classes + 1, num_anchors))
+    loc_pred = mx.nd.array(rng.rand(1, num_anchors * 4) * 0.1)
+    det = mx.contrib.nd.MultiBoxDetection(cls_prob, loc_pred, anchor)
+    assert det.shape[0] == 1 and det.shape[2] == 6
+
+
+@pytest.mark.slow
+def test_ssd_train_forward_backward():
+    import ssd_vgg16
+    net = ssd_vgg16.get_symbol_train(num_classes=4)
+    ex = net.simple_bind(mx.cpu(), grad_req="write",
+                         data=(1, 3, 128, 128), label=(1, 3, 5))
+    init = mx.initializer.Xavier()
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "label"):
+            init(k, v)
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 3, 128, 128).astype(np.float32)
+    lab = np.array([[[1, 0.2, 0.2, 0.6, 0.6],
+                     [2, 0.5, 0.5, 0.9, 0.9],
+                     [-1, 0, 0, 0, 0]]], np.float32)
+    ex.forward(is_train=True, data=x, label=lab)
+    outs = [o.asnumpy() for o in ex.outputs]
+    assert all(np.isfinite(o).all() for o in outs)
+    ex.backward()
+    g = ex.grad_dict["conv1_1_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
