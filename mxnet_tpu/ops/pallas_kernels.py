@@ -1,0 +1,135 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference's hand-written CUDA kernels (mshadow/cuDNN, SURVEY §2.2) map
+to XLA for almost everything; Pallas covers the ops XLA can't fuse well.
+First resident: block-wise flash attention — Q blocks stream through VMEM
+against the K/V panel, softmax runs on the VPU, both matmuls hit the MXU.
+Used single-chip; the sequence-parallel wrapper
+(:mod:`mxnet_tpu.parallel.sequence`) rings K/V between chips and calls the
+same math per block.
+
+Exposed as the ``_contrib_FlashAttention`` operator (q, k, v) with layout
+(batch, seq, heads, head_dim); backward is a jnp recompute via custom_vjp
+(the standard Pallas custom-VJP pattern).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_BLOCK_Q = 128
+
+
+def _attention_jnp(q, k, v, causal):
+    """Reference path (CPU / fallback / backward recompute)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    s = s - s.max(-1, keepdims=True)
+    p = jnp.exp(s)
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)        # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)        # (T, D)
+    v = v_ref[0].astype(jnp.float32)        # (T, D)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = k.shape[0]
+        row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, t), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
+        s = jnp.where(row >= col, s, -jnp.inf)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=-1, keepdims=True)
+    o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
+    """q/k/v: (B, T, H, D) -> (B, T, H, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q = min(_BLOCK_Q, t)
+    assert t % block_q == 0, "seq length must be a multiple of the Q block"
+
+    # fold heads into batch; kernel works on (BH, T, D)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, interpret=False):
+    """Block-wise attention; Pallas on TPU, jnp elsewhere."""
+    return _flash_attention_fwd_pallas(q, k, v, causal, interpret)
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    return flash_attention(q, k, v, causal, interpret), (q, k, v)
+
+
+def _fa_bwd(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _attention_jnp(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@register("_contrib_FlashAttention", arg_names=("q", "k", "v"),
+          params={"causal": False})
+def flash_attention_op(attrs, ctx, q, k, v):
+    """Attention over (batch, seq, heads, head_dim) inputs.
+
+    New TPU-native capability (the reference era has no attention ops);
+    Pallas kernel on TPU, jnp fallback elsewhere.
+    """
+    causal = bool(attrs["causal"])
+    if _on_tpu():
+        return flash_attention(q, k, v, causal)
+    return _attention_jnp(q, k, v, causal)
