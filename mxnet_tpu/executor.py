@@ -26,6 +26,12 @@ from .symbol import eval_graph, _classify_vars
 __all__ = ["Executor"]
 
 
+def _as_jnp(v, dtype):
+    import numpy as np
+    import jax.numpy as jnp
+    return jnp.asarray(np.asarray(v), dtype=dtype)
+
+
 def _normalize(values, names, kind, default_ctor=None):
     """Accept list/tuple ordered by ``names`` or a dict; return dict."""
     if values is None:
@@ -206,6 +212,80 @@ class Executor:
         fn = raw if self._multi_device_placed() else jax.jit(raw)
         self._bwd_cache[key_] = fn
         return fn
+
+    def _get_fused_fn(self):
+        """Forward + backward + aux update as ONE compiled program — the
+        training hot path (Module.forward_backward).  XLA shares the
+        forward computation between the primal and the vjp, which the
+        separate forward()/backward() pair cannot."""
+        fn = self._bwd_cache.get("fused")
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        topo, entries = self._topo, self._symbol._entries
+        var_ids = self._var_ids()
+        diff_idx = tuple(i for i, n in enumerate(self._arg_names)
+                         if self._grad_req[n] != "null")
+        head_is_loss = self._head_is_loss
+        n_args = len(self._arg_nodes)
+
+        def raw(vals, key):
+            diff_vals = tuple(vals[i] for i in diff_idx)
+
+            def f(diff):
+                full = list(vals)
+                for j, i in enumerate(diff_idx):
+                    full[i] = diff[j]
+                var_values = dict(zip(var_ids, full))
+                bsz = full[0].shape[0] if full and full[0].ndim else None
+                heads, aux_upd = eval_graph(topo, entries, var_values,
+                                            is_train=True, key=key,
+                                            batch_size=bsz,
+                                            device_map=self._device_map)
+                return heads, aux_upd
+
+            heads, vjp, aux_upd = jax.vjp(f, diff_vals, has_aux=True)
+            cot = [jnp.ones_like(h) if il else jnp.zeros_like(h)
+                   for h, il in zip(heads, head_is_loss)]
+            (grads,) = vjp(list(cot))
+            aux_out = [aux_upd.get(id(n), vals[n_args + i])
+                       for i, n in enumerate(self._aux_nodes)]
+            return heads, aux_out, grads
+
+        fn = raw if self._multi_device_placed() else jax.jit(raw)
+        self._bwd_cache["fused"] = fn
+        return fn
+
+    def forward_backward(self, **kwargs):
+        """Fused training step: outputs + gradients in one XLA program.
+        Equivalent to forward(is_train=True) followed by backward()."""
+        if self._monitor_callback is not None:
+            self.forward(is_train=True, **kwargs)
+            self.backward()
+            return self._outputs
+        for k, v in kwargs.items():
+            arr = self.arg_dict[k]
+            arr._set_data(v.data.astype(arr.dtype) if isinstance(v, NDArray)
+                          else _as_jnp(v, arr.dtype))
+        from . import random as _random
+        key = _random.take_key()
+        self._last_key = key
+        self._last_train = True
+        fn = self._get_fused_fn()
+        heads, aux_out, grads = fn(self._gather_vals(), key)
+        for n, upd in zip(self._aux_names, aux_out):
+            self.aux_dict[n]._set_data(upd)
+        diff_names = [n for n in self._arg_names
+                      if self._grad_req[n] != "null"]
+        for n, g in zip(diff_names, grads):
+            tgt = self.grad_dict[n]
+            if self._grad_req[n] == "add":
+                tgt._set_data(tgt.data + g)
+            else:
+                tgt._set_data(g.astype(tgt.dtype))
+        self._outputs = [NDArray(h) for h in heads]
+        return self._outputs
 
     # ---------------------------------------------------------------- run
     def _gather_vals(self):

@@ -217,6 +217,15 @@ class DataParallelExecutorGroup:
         for exec_ in self.execs:
             exec_.forward(is_train=is_train)
 
+    def forward_backward(self, data_batch):
+        """Fused per-device train step (one XLA program per device)."""
+        assert self.for_training
+        _load_data(data_batch, self.data_arrays)
+        if self.label_shapes is not None and data_batch.label:
+            _load_label(data_batch, self.label_arrays)
+        for exec_ in self.execs:
+            exec_.forward_backward()
+
     def backward(self, out_grads=None):
         assert self.for_training, "re-bind with for_training=True"
         for i, exec_ in enumerate(self.execs):

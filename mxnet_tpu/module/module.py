@@ -338,6 +338,13 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec_group.forward(data_batch, is_train)
 
+    def forward_backward(self, data_batch):
+        """Fused train step (reference runs forward and backward as
+        separate engine pushes; here one XLA program shares the forward
+        between primal and vjp)."""
+        assert self.binded and self.params_initialized
+        self._exec_group.forward_backward(data_batch)
+
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
         self._exec_group.backward(out_grads=out_grads)

@@ -1,0 +1,313 @@
+"""Broad operator sweep: forward vs numpy + sampled numeric gradients.
+
+Reference: tests/python/unittest/test_operator.py (3119 L) checks every op;
+this file covers the families programmatically against numpy oracles.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient)
+
+RNG = np.random.RandomState(7)
+
+# ---- unary elementwise: (op, numpy fn, domain)
+UNARY = [
+    ("abs", np.abs, (-2, 2)),
+    ("ceil", np.ceil, (-2, 2)),
+    ("floor", np.floor, (-2, 2)),
+    ("rint", np.rint, (-2, 2)),
+    ("trunc", np.trunc, (-2, 2)),
+    ("sign", np.sign, (-2, 2)),
+    ("square", np.square, (-2, 2)),
+    ("sqrt", np.sqrt, (0.1, 4)),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), (0.1, 4)),
+    ("cbrt", np.cbrt, (0.1, 4)),
+    ("rcbrt", lambda x: 1 / np.cbrt(x), (0.1, 4)),
+    ("exp", np.exp, (-2, 2)),
+    ("expm1", np.expm1, (-2, 2)),
+    ("log", np.log, (0.1, 4)),
+    ("log10", np.log10, (0.1, 4)),
+    ("log2", np.log2, (0.1, 4)),
+    ("log1p", np.log1p, (-0.5, 4)),
+    ("sin", np.sin, (-3, 3)),
+    ("cos", np.cos, (-3, 3)),
+    ("tan", np.tan, (-1, 1)),
+    ("arcsin", np.arcsin, (-0.9, 0.9)),
+    ("arccos", np.arccos, (-0.9, 0.9)),
+    ("arctan", np.arctan, (-3, 3)),
+    ("sinh", np.sinh, (-2, 2)),
+    ("cosh", np.cosh, (-2, 2)),
+    ("tanh", np.tanh, (-2, 2)),
+    ("arcsinh", np.arcsinh, (-2, 2)),
+    ("arccosh", np.arccosh, (1.1, 4)),
+    ("arctanh", np.arctanh, (-0.9, 0.9)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), (-3, 3)),
+    ("relu", lambda x: np.maximum(x, 0), (-2, 2)),
+    ("softsign", lambda x: x / (1 + np.abs(x)), (-2, 2)),
+    ("reciprocal", lambda x: 1 / x, (0.5, 3)),
+    ("negative", lambda x: -x, (-2, 2)),
+    ("degrees", np.degrees, (-3, 3)),
+    ("radians", np.radians, (-180, 180)),
+    ("gamma", lambda x: np.vectorize(__import__("math").gamma)(x), (0.5, 4)),
+    ("gammaln", lambda x: np.vectorize(__import__("math").lgamma)(x),
+     (0.5, 4)),
+    ("erf", lambda x: np.vectorize(__import__("math").erf)(x), (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,fn,dom", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_forward(name, fn, dom):
+    x = RNG.uniform(dom[0], dom[1], (3, 4)).astype(np.float32)
+    out = getattr(mx.nd, name)(mx.nd.array(x))
+    assert_almost_equal(out, fn(x), rtol=1e-4, atol=1e-5)
+
+
+# ---- binary broadcast: (op, numpy fn)
+BINARY = [
+    ("broadcast_add", np.add),
+    ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply),
+    ("broadcast_div", np.divide),
+    ("broadcast_power", np.power),
+    ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_mod", np.mod),
+    ("broadcast_hypot", np.hypot),
+    ("broadcast_equal", lambda a, b: (a == b).astype(np.float32)),
+    ("broadcast_not_equal", lambda a, b: (a != b).astype(np.float32)),
+    ("broadcast_greater", lambda a, b: (a > b).astype(np.float32)),
+    ("broadcast_greater_equal", lambda a, b: (a >= b).astype(np.float32)),
+    ("broadcast_lesser", lambda a, b: (a < b).astype(np.float32)),
+    ("broadcast_lesser_equal", lambda a, b: (a <= b).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("name,fn", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_broadcast_forward(name, fn):
+    a = RNG.uniform(0.5, 2.0, (2, 3, 4)).astype(np.float32)
+    b = RNG.uniform(0.5, 2.0, (2, 1, 4)).astype(np.float32)
+    out = getattr(mx.nd, name)(mx.nd.array(a), mx.nd.array(b))
+    assert_almost_equal(out, fn(a, b), rtol=1e-4, atol=1e-5)
+
+
+# ---- scalar ops
+SCALAR = [
+    ("_plus_scalar", lambda x, s: x + s),
+    ("_minus_scalar", lambda x, s: x - s),
+    ("_rminus_scalar", lambda x, s: s - x),
+    ("_mul_scalar", lambda x, s: x * s),
+    ("_div_scalar", lambda x, s: x / s),
+    ("_rdiv_scalar", lambda x, s: s / x),
+    ("_power_scalar", lambda x, s: x ** s),
+    ("_rpower_scalar", lambda x, s: s ** x),
+    ("_maximum_scalar", lambda x, s: np.maximum(x, s)),
+    ("_minimum_scalar", lambda x, s: np.minimum(x, s)),
+    ("_mod_scalar", lambda x, s: np.mod(x, s)),
+]
+
+
+@pytest.mark.parametrize("name,fn", SCALAR, ids=[s[0] for s in SCALAR])
+def test_scalar_forward(name, fn):
+    x = RNG.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    out = getattr(mx.nd, name)(mx.nd.array(x), scalar=1.5)
+    assert_almost_equal(out, fn(x, 1.5), rtol=1e-4, atol=1e-5)
+
+
+# ---- reductions
+REDUCE = [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod), ("nansum", np.nansum), ("nanprod", np.nanprod),
+]
+
+
+@pytest.mark.parametrize("name,fn", REDUCE, ids=[r[0] for r in REDUCE])
+def test_reduce_forward(name, fn):
+    x = RNG.uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    out = getattr(mx.nd, name)(mx.nd.array(x), axis=1)
+    assert_almost_equal(out, fn(x, axis=1), rtol=1e-4, atol=1e-5)
+    out_all = getattr(mx.nd, name)(mx.nd.array(x))
+    assert_almost_equal(out_all, np.array(fn(x)), rtol=1e-4, atol=1e-4)
+
+
+def test_reduce_keepdims():
+    x = RNG.uniform(0, 1, (2, 3, 4)).astype(np.float32)
+    out = mx.nd.sum(mx.nd.array(x), axis=(0, 2), keepdims=True)
+    assert_almost_equal(out, x.sum(axis=(0, 2), keepdims=True), rtol=1e-5)
+
+
+# ---- shape manipulation
+def test_shape_ops():
+    x = RNG.uniform(0, 1, (2, 3, 4)).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.transpose(a), x.T)
+    assert_almost_equal(mx.nd.transpose(a, axes=(0, 2, 1)),
+                        x.transpose(0, 2, 1))
+    assert_almost_equal(mx.nd.expand_dims(a, axis=1),
+                        np.expand_dims(x, 1))
+    assert_almost_equal(mx.nd.flip(a, axis=2), x[:, :, ::-1])
+    assert_almost_equal(mx.nd.tile(a, reps=(2, 1, 1)), np.tile(x, (2, 1, 1)))
+    assert_almost_equal(mx.nd.repeat(a, repeats=2, axis=1),
+                        np.repeat(x, 2, axis=1))
+    assert_almost_equal(mx.nd.Reshape(a, shape=(6, 4)), x.reshape(6, 4))
+    assert_almost_equal(mx.nd.Flatten(a), x.reshape(2, 12))
+    assert_almost_equal(mx.nd.SwapAxis(a, dim1=0, dim2=2),
+                        np.swapaxes(x, 0, 2))
+    assert_almost_equal(mx.nd.slice_axis(a, axis=1, begin=1, end=3),
+                        x[:, 1:3])
+
+
+def test_reshape_special_codes():
+    """Reference Reshape 0/-1/-2/-3/-4 semantics (matrix_op.cc)."""
+    x = RNG.uniform(0, 1, (2, 3, 4)).astype(np.float32)
+    a = mx.nd.array(x)
+    assert mx.nd.Reshape(a, shape=(0, -1)).shape == (2, 12)
+    assert mx.nd.Reshape(a, shape=(-1,)).shape == (24,)
+    assert mx.nd.Reshape(a, shape=(0, 0, -1)).shape == (2, 3, 4)
+
+
+def test_concat_stack_slice():
+    x = RNG.uniform(0, 1, (2, 3)).astype(np.float32)
+    y = RNG.uniform(0, 1, (2, 3)).astype(np.float32)
+    assert_almost_equal(mx.nd.Concat(mx.nd.array(x), mx.nd.array(y), dim=1),
+                        np.concatenate([x, y], axis=1))
+    assert_almost_equal(mx.nd.stack(mx.nd.array(x), mx.nd.array(y), axis=0),
+                        np.stack([x, y]))
+    outs = mx.nd.SliceChannel(mx.nd.array(x), num_outputs=3, axis=1)
+    assert len(outs) == 3
+    assert_almost_equal(outs[1], x[:, 1:2])
+    sq = mx.nd.SliceChannel(mx.nd.array(x), num_outputs=3, axis=1,
+                            squeeze_axis=True)
+    assert sq[0].shape == (2,)
+
+
+def test_indexing_ops():
+    w = RNG.uniform(0, 1, (10, 4)).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    assert_almost_equal(mx.nd.take(mx.nd.array(w), mx.nd.array(idx)),
+                        w[[1, 3, 5]])
+    assert_almost_equal(
+        mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10,
+                        output_dim=4), w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array(np.array([0, 2], np.float32)), depth=3)
+    assert_almost_equal(oh, np.eye(3, dtype=np.float32)[[0, 2]])
+    x = RNG.uniform(0, 1, (3, 4)).astype(np.float32)
+    bt = mx.nd.batch_take(mx.nd.array(x),
+                          mx.nd.array(np.array([0, 2, 1], np.float32)))
+    assert_almost_equal(bt, x[np.arange(3), [0, 2, 1]])
+
+
+def test_ordering_ops():
+    x = RNG.uniform(0, 1, (3, 5)).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.argmax(a, axis=1),
+                        np.argmax(x, axis=1).astype(np.float32))
+    assert_almost_equal(mx.nd.argmin(a, axis=1),
+                        np.argmin(x, axis=1).astype(np.float32))
+    assert_almost_equal(mx.nd.sort(a, axis=1), np.sort(x, axis=1))
+    assert_almost_equal(mx.nd.argsort(a, axis=1),
+                        np.argsort(x, axis=1).astype(np.float32))
+    topk = mx.nd.topk(a, axis=1, k=2)
+    expect = np.argsort(-x, axis=1)[:, :2].astype(np.float32)
+    assert_almost_equal(topk, expect)
+
+
+def test_where_clip():
+    cond = np.array([[1, 0], [0, 1]], np.float32)
+    x = np.full((2, 2), 2.0, np.float32)
+    y = np.full((2, 2), 3.0, np.float32)
+    assert_almost_equal(
+        mx.nd.where(mx.nd.array(cond), mx.nd.array(x), mx.nd.array(y)),
+        np.where(cond > 0, x, y))
+    z = np.array([-2.0, 0.5, 2.0], np.float32)
+    assert_almost_equal(mx.nd.clip(mx.nd.array(z), a_min=-1, a_max=1),
+                        np.clip(z, -1, 1))
+
+
+def test_dot_ops():
+    a = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    b = RNG.uniform(-1, 1, (4, 5)).astype(np.float32)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)), a @ b,
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a.T), mx.nd.array(b), transpose_a=True),
+        a @ b, rtol=1e-4, atol=1e-5)
+    ba = RNG.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    bb = RNG.uniform(-1, 1, (2, 4, 5)).astype(np.float32)
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(ba), mx.nd.array(bb)),
+                        ba @ bb, rtol=1e-4, atol=1e-5)
+
+
+# ---- sampled numeric gradients across families
+GRAD_CASES = [
+    ("sigmoid", [(3, 4)], {}),
+    ("tanh", [(3, 4)], {}),
+    ("exp", [(3, 4)], {}),
+    ("square", [(3, 4)], {}),
+    ("broadcast_mul", [(2, 3), (2, 1)], {}),
+    ("broadcast_div", [(2, 3), (2, 1)], {}),
+    ("sum", [(3, 4)], {"axis": 1}),
+    ("mean", [(3, 4)], {}),
+    ("dot", [(3, 4), (4, 2)], {}),
+    ("transpose", [(3, 4)], {}),
+    ("BatchNorm", None, None),  # covered in test_executor
+    ("SoftmaxActivation", [(3, 4)], {}),
+    ("L2Normalization", [(3, 4)], {}),
+    ("smooth_l1", [(3, 4)], {"scalar": 1.0}),
+]
+
+
+@pytest.mark.parametrize(
+    "name,shapes,attrs",
+    [c for c in GRAD_CASES if c[1] is not None],
+    ids=[c[0] for c in GRAD_CASES if c[1] is not None])
+def test_numeric_gradient(name, shapes, attrs):
+    arrays = [RNG.uniform(0.5, 1.5, s) for s in shapes]
+    check_numeric_gradient(name, arrays, attrs)
+
+
+def test_random_ops_moments():
+    """Reference test_random.py pattern: sample moments."""
+    mx.random.seed(42)
+    u = mx.nd._random_uniform(low=-1, high=1, shape=(50000,))
+    nu = u.asnumpy()
+    assert abs(nu.mean()) < 0.02
+    assert abs(nu.std() - np.sqrt(4 / 12)) < 0.02
+    n = mx.nd._random_normal(loc=2.0, scale=3.0, shape=(50000,))
+    nn = n.asnumpy()
+    assert abs(nn.mean() - 2.0) < 0.1
+    assert abs(nn.std() - 3.0) < 0.1
+    p = mx.nd._random_poisson(lam=4.0, shape=(50000,))
+    assert abs(p.asnumpy().mean() - 4.0) < 0.15
+
+
+def test_sequence_ops():
+    x = RNG.uniform(0, 1, (4, 2, 3)).astype(np.float32)  # (T, B, C)
+    length = np.array([2, 4], np.float32)
+    masked = mx.nd.SequenceMask(mx.nd.array(x), mx.nd.array(length),
+                                use_sequence_length=True)
+    m = masked.asnumpy()
+    assert (m[2:, 0] == 0).all() and (m[:, 1] == x[:, 1]).all()
+    last = mx.nd.SequenceLast(mx.nd.array(x), mx.nd.array(length),
+                              use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[3, 1]]))
+    rev = mx.nd.SequenceReverse(mx.nd.array(x), mx.nd.array(length),
+                                use_sequence_length=True)
+    r = rev.asnumpy()
+    assert_almost_equal(r[0, 0], x[1, 0])
+    assert_almost_equal(r[0, 1], x[3, 1])
+
+
+def test_upsampling_pad():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    up = mx.nd.UpSampling(mx.nd.array(x), scale=2, sample_type="nearest")
+    assert up.shape == (1, 1, 4, 4)
+    assert_almost_equal(up.asnumpy()[0, 0, :2, :2],
+                        np.array([[0, 0], [0, 1]], np.float32) * 0 +
+                        x[0, 0, 0, 0])
+    padded = mx.nd.Pad(mx.nd.array(x), mode="constant",
+                       pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                       constant_value=5.0)
+    assert padded.shape == (1, 1, 4, 4)
+    assert padded.asnumpy()[0, 0, 0, 0] == 5.0
