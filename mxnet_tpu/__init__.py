@@ -55,6 +55,8 @@ from . import visualization as viz
 from . import test_utils
 from . import contrib
 from . import config
+from . import predictor
+from .predictor import Predictor
 
 # optional: image pipeline needs PIL
 try:

@@ -11,7 +11,7 @@ from __future__ import annotations
 import importlib
 
 _MODELS = ("mlp", "lenet", "alexnet", "vgg", "resnet", "inception_bn",
-           "googlenet")
+           "inception_v3", "googlenet")
 
 
 def get_model(name, **kwargs):

@@ -1,0 +1,90 @@
+"""Standalone inference predictor.
+
+Reference: ``include/mxnet/c_predict_api.h`` + ``src/c_api/c_predict_api.cc``
+— the deployment-facing minimal API (create from symbol JSON + param bytes,
+set input, forward, get output) that the amalgamation build ships.  Same
+surface here, jit-compiled underneath.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .context import cpu
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Reference MXPredCreate / MXPredForward / MXPredGetOutput."""
+
+    def __init__(self, symbol_json, param_bytes_or_dict, input_shapes,
+                 ctx=None, output_names=None):
+        """
+        symbol_json: JSON string or path of the network (``*-symbol.json``).
+        param_bytes_or_dict: path to ``*.params``, or {name: NDArray}.
+        input_shapes: dict name -> shape.
+        """
+        if symbol_json.strip().startswith("{"):
+            symbol = sym_mod.load_json(symbol_json)
+        else:
+            symbol = sym_mod.load(symbol_json)
+        if output_names:
+            internals = symbol.get_internals()
+            outs = [internals[n if n.endswith("_output") else n + "_output"]
+                    for n in output_names]
+            symbol = sym_mod.Group(outs)
+        self._symbol = symbol
+        ctx = ctx or cpu()
+
+        if isinstance(param_bytes_or_dict, str):
+            loaded = nd.load(param_bytes_or_dict)
+            params = {}
+            for k, v in loaded.items():
+                if ":" in k:
+                    k = k.split(":", 1)[1]
+                params[k] = v
+        else:
+            params = dict(param_bytes_or_dict)
+
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**input_shapes)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in input_shapes:
+                args[name] = nd.zeros(shape, ctx=ctx)
+            elif name in params:
+                args[name] = params[name]
+            else:
+                raise MXNetError("missing parameter %r" % name)
+        aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in params:
+                aux[name] = params[name]
+            else:
+                aux[name] = nd.zeros(shape, ctx=ctx)
+        self._input_names = list(input_shapes)
+        self._executor = symbol.bind(ctx, args, grad_req="null",
+                                     aux_states=aux)
+
+    def set_input(self, name, value):
+        if name not in self._input_names:
+            raise MXNetError("unknown input %r" % name)
+        arr = self._executor.arg_dict[name]
+        arr[:] = value
+
+    def forward(self, **inputs):
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        self._executor.forward(is_train=False)
+        return self
+
+    def get_output(self, index=0):
+        return self._executor.outputs[index].asnumpy()
+
+    def reshape(self, input_shapes):
+        self._executor = self._executor.reshape(**input_shapes)
+        return self

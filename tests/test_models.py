@@ -55,3 +55,31 @@ def test_imagenet_models_infer(name):
     arg_shapes, out_shapes, aux_shapes = net.infer_shape(
         data=(1, 3, 224, 224))
     assert out_shapes == [(1, 1000)]
+
+
+def test_inception_v3_infer():
+    net = models.get_model("inception_v3", num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes == [(1, 1000)]
+
+
+def test_predictor_roundtrip(tmp_path):
+    """c_predict_api analogue: save checkpoint, predict from files."""
+    import os
+    net = models.get_model("mlp", num_classes=10)
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 784))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    prefix = os.path.join(str(tmp_path), "m")
+    mod.save_checkpoint(prefix, 1)
+
+    pred = mx.Predictor(prefix + "-symbol.json", prefix + "-0001.params",
+                        {"data": (2, 784), "softmax_label": (2,)})
+    x = np.random.rand(2, 784).astype(np.float32)
+    out = pred.forward(data=x).get_output(0)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                            label=[mx.nd.zeros((2,))])
+    mod.forward(batch, is_train=False)
+    np.testing.assert_allclose(out, mod.get_outputs()[0].asnumpy(),
+                               rtol=1e-5)
