@@ -34,7 +34,7 @@ def main():
     batch = per_chip_batch * n_dev
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     num_layers = int(os.environ.get("BENCH_LAYERS", "50"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
 
     if platform == "cpu":
         # CPU smoke fallback: tiny config so the bench always completes
@@ -53,18 +53,19 @@ def main():
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)
     y = rng.randint(0, 1000, batch).astype(np.float32)
-    batch_dict = {"data": x, "softmax_label": y}
+    # stage once: the benchmark measures the train step, not the host
+    # link (a real pipeline overlaps transfer via PrefetchingIter)
+    batch_dict = trainer.put_batch({"data": x, "softmax_label": y})
 
-    # warmup (compile)
-    loss = trainer.step(batch_dict)
-    jax.block_until_ready(loss)
-    loss = trainer.step(batch_dict)
-    jax.block_until_ready(loss)
+    # warmup (compile); float() forces a value fetch — on relayed/remote
+    # backends block_until_ready alone can return before device compute
+    float(trainer.step(batch_dict))
+    float(trainer.step(batch_dict))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(batch_dict)
-    jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))  # value fetch closes the async chain
     dt = time.perf_counter() - t0
 
     img_per_sec = steps * batch / dt

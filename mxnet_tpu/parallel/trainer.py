@@ -218,13 +218,25 @@ class ShardedTrainer:
             out[k] = v
         return out
 
+    def put_batch(self, batch):
+        """Stage a host batch onto the mesh (sharded device arrays).
+        Use with :meth:`step` to overlap host IO with compute, or to
+        reuse a batch without re-transfer."""
+        import jax
+        return {k: jax.device_put(v, self._batch_sharding[k])
+                for k, v in self._cast_batch(batch).items()}
+
     def step(self, batch):
         """One fused training step.  ``batch``: dict name -> host array
-        with GLOBAL batch dim.  Returns the (device) loss scalar."""
+        with GLOBAL batch dim (or a dict from :meth:`put_batch`).
+        Returns the (device) loss scalar."""
         import jax
         self._key, sub = jax.random.split(self._key)
-        dev_batch = {k: jax.device_put(v, self._batch_sharding[k])
-                     for k, v in self._cast_batch(batch).items()}
+        first = next(iter(batch.values()))
+        if isinstance(first, jax.Array):
+            dev_batch = batch
+        else:
+            dev_batch = self.put_batch(batch)
         self.params, self.momentum_state, self.aux, loss = self._step_fn(
             self.params, self.momentum_state, self.aux, dev_batch, sub)
         self._step_count += 1
