@@ -1,0 +1,60 @@
+"""Inference throughput benchmark.
+
+Reference: ``example/image-classification/benchmark_score.py`` — img/s over
+the model zoo at batch sizes 1..32 (the numbers in perf.md:40-147).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+logging.basicConfig(level=logging.INFO)
+
+
+def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
+          dev=None):
+    net = models.get_model(network, num_classes=1000,
+                           image_shape=",".join(map(str, image_shape)))
+    data_shape = (batch_size,) + image_shape
+    ex = net.simple_bind(dev or mx.current_context(), grad_req="null",
+                         data=data_shape,
+                         softmax_label=(batch_size,))
+    init = mx.initializer.Xavier()
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            init(k, v)
+    for k, v in ex.aux_dict.items():
+        if k.endswith("moving_var"):
+            v[:] = 1.0
+    x = np.random.rand(*data_shape).astype(np.float32)
+    ex.forward(is_train=False, data=x)
+    float(ex.outputs[0].asnumpy().sum())  # warm compile
+    tic = time.time()
+    for _ in range(num_batches):
+        out = ex.forward(is_train=False)
+    float(out[0].asnumpy().sum())  # value fetch closes the chain
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="score inference speed")
+    parser.add_argument("--networks", type=str,
+                        default="alexnet,vgg16,inception_bn,resnet50")
+    parser.add_argument("--batch-sizes", type=str, default="1,2,4,8,16,32")
+    args = parser.parse_args()
+    for net in args.networks.split(","):
+        shape = (3, 299, 299) if net == "inception_v3" else (3, 224, 224)
+        logging.info("network: %s", net)
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            speed = score(net, b, image_shape=shape)
+            logging.info("batch size %2d, image/sec: %f", b, speed)

@@ -1,0 +1,40 @@
+"""RNN checkpoint helpers (reference: python/mxnet/rnn/rnn.py) —
+save/load with cell-aware weight (un)packing so fused and unfused layouts
+interop on disk."""
+from __future__ import annotations
+
+from ..model import save_checkpoint, load_checkpoint
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint",
+           "do_rnn_checkpoint"]
+
+
+def _as_list(cells):
+    return cells if isinstance(cells, (list, tuple)) else [cells]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Unpack fused weights before saving (reference rnn.py)."""
+    args = dict(arg_params)
+    for cell in _as_list(cells):
+        args = cell.unpack_weights(args)
+    save_checkpoint(prefix, epoch, symbol, args, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load and re-pack weights for the given cells."""
+    sym, arg, aux = load_checkpoint(prefix, epoch)
+    for cell in _as_list(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback (reference rnn.do_rnn_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

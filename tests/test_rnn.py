@@ -160,3 +160,37 @@ def test_bucket_sentence_iter():
         assert batch.data[0].shape[1] == batch.bucket_key
         seen.add(batch.bucket_key)
     assert seen
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    import os
+    cell = mx.rnn.LSTMCell(8, prefix="lstm_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    net = mx.sym.Group(outputs)
+    rng = np.random.RandomState(0)
+    arg_params = {
+        "lstm_i2h_weight": mx.nd.array(rng.rand(32, 4).astype(np.float32)),
+        "lstm_i2h_bias": mx.nd.array(rng.rand(32).astype(np.float32)),
+        "lstm_h2h_weight": mx.nd.array(rng.rand(32, 8).astype(np.float32)),
+        "lstm_h2h_bias": mx.nd.array(rng.rand(32).astype(np.float32)),
+    }
+    prefix = os.path.join(str(tmp_path), "rnn")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 1, net, arg_params, {})
+    _, arg2, _ = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
+    for k in arg_params:
+        np.testing.assert_allclose(arg2[k].asnumpy(),
+                                   arg_params[k].asnumpy(), rtol=1e-6)
+
+
+def test_dist_kvstore_single_process():
+    """dist_sync facade with one process behaves like local
+    (reference tests/nightly/dist_sync_kvstore.py single-worker case)."""
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.init(0, mx.nd.ones((3,)))
+    kv.push(0, [mx.nd.ones((3,))] * 2)
+    out = mx.nd.zeros((3,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 2.0))
+    kv.barrier()
