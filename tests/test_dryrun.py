@@ -1,0 +1,25 @@
+"""CI guard for the driver's multichip gate.
+
+The driver validates multi-chip sharding by calling
+``__graft_entry__.dryrun_multichip(N)`` with N virtual CPU devices
+(``xla_force_host_platform_device_count``, SURVEY §4.2's CPU-impersonation
+pattern).  This test runs the exact same entry point on the 8-device CPU
+mesh so a regression there is caught before the driver sees it.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_entry_compiles():
+    import jax
+    import __graft_entry__
+    fn, example_args = __graft_entry__.entry()
+    out = jax.jit(fn).lower(*example_args).compile()
+    assert out is not None
