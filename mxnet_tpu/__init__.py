@@ -34,6 +34,7 @@ ndarray._register_op_functions(ops.generate_nd_functions())
 # et al., which only exist once the codegen above has run)
 from . import registry
 from . import initializer
+from . import initializer as init  # reference alias (python/mxnet/__init__.py)
 from .initializer import InitDesc
 from . import lr_scheduler
 from . import optimizer
@@ -44,6 +45,7 @@ from . import kvstore
 from . import kvstore as kv
 from . import model
 from . import module
+from . import module as mod  # reference alias (python/mxnet/__init__.py)
 from .module import Module
 from . import rnn
 from . import profiler

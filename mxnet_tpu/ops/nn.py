@@ -26,6 +26,61 @@ def _pair(v, n=2):
     return (int(v),) * n
 
 
+# ----------------------------------------------------------------- layout
+# Global image-layout mode for the conv/pool/batchnorm family.  The symbol
+# graphs are written against the reference's NCHW convention; on TPU the
+# MXU/vector units want the channel dim minor (NHWC), so the performant
+# path (ShardedTrainer(layout="NHWC")) activates this flag *at trace time*
+# and feeds NHWC activations end-to-end instead of paying per-op
+# transposes.  Weights keep the reference OIHW layout (cheap per-step
+# transpose, preserves checkpoint compatibility).
+_IMAGE_LAYOUT = "NCHW"
+
+
+class image_layout:
+    """Context manager selecting the activation layout ('NCHW'/'NHWC')
+    seen by Convolution/Pooling/BatchNorm during tracing."""
+
+    def __init__(self, layout):
+        if layout not in ("NCHW", "NHWC"):
+            raise MXNetError("unsupported image layout %r" % (layout,))
+        self.layout = layout
+
+    def __enter__(self):
+        global _IMAGE_LAYOUT
+        self._prev = _IMAGE_LAYOUT
+        _IMAGE_LAYOUT = self.layout
+        return self
+
+    def __exit__(self, *exc):
+        global _IMAGE_LAYOUT
+        _IMAGE_LAYOUT = self._prev
+        return False
+
+
+def current_image_layout():
+    return _IMAGE_LAYOUT
+
+
+def _is_nhwc(data):
+    """True when a 4-d activation flows channel-minor (trainer NHWC mode)."""
+    return data.ndim == 4 and _IMAGE_LAYOUT == "NHWC"
+
+
+def _ch_axis(data):
+    return 3 if _is_nhwc(data) else 1
+
+
+# Ops that index the channel axis but have no NHWC adaptation; a trainer in
+# NHWC mode refuses graphs containing them rather than silently computing on
+# the wrong axis.  Extend this list when adding channel-sensitive ops.
+NHWC_UNAWARE_OPS = frozenset({
+    "SwapAxis", "SpatialTransformer", "BilinearSampler", "GridGenerator",
+    "ROIPooling", "Correlation", "Proposal", "MultiBoxPrior",
+    "MultiBoxTarget", "MultiBoxDetection",
+})
+
+
 # --------------------------------------------------------------------- dense
 @register("FullyConnected", arg_names=lambda a: ("data", "weight") if a["no_bias"]
           else ("data", "weight", "bias"),
@@ -64,6 +119,27 @@ def convolution(attrs, ctx, data, weight, bias=None):
     stride = tuple(attrs["stride"]) or (1,) * nd
     dilate = tuple(attrs["dilate"]) or (1,) * nd
     pad = tuple(attrs["pad"]) or (0,) * nd
+    layout = attrs.get("layout") or _IMAGE_LAYOUT
+    if attrs.get("layout") and _IMAGE_LAYOUT == "NHWC" \
+            and attrs["layout"] != "NHWC":
+        raise MXNetError(
+            "Convolution node pins layout=%r but the trainer runs "
+            "image_layout('NHWC'); drop the explicit layout attr or train "
+            "in NCHW" % (attrs["layout"],))
+    if nd == 2 and layout == "NHWC":
+        # activations NHWC, weight kept reference-OIHW -> HWIO view
+        dn = lax.conv_dimension_numbers(
+            data.shape, weight.shape[2:] + weight.shape[1:2] + weight.shape[:1],
+            ("NHWC", "HWIO", "NHWC"))
+        w = jnp.transpose(weight, (2, 3, 1, 0))
+        y = lax.conv_general_dilated(
+            data, w, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=int(attrs["num_group"]))
+        if bias is not None:
+            y = y + bias
+        return y.astype(data.dtype)
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if nd == 2 else
@@ -103,12 +179,24 @@ def deconvolution(attrs, ctx, data, weight, bias=None):
         ci, co = weight.shape[0], weight.shape[1]
         w = w.reshape((groups, ci // groups, co) + kernel)
         w = jnp.swapaxes(w, 1, 2).reshape((groups * co, ci // groups) + kernel)
+    padding = [(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
+               for i in range(nd)]
+    if nd == 2 and _is_nhwc(data):
+        dn = lax.conv_dimension_numbers(
+            data.shape, w.shape[2:] + w.shape[1:2] + w.shape[:1],
+            ("NHWC", "HWIO", "NHWC"))
+        y = lax.conv_general_dilated(
+            data, jnp.transpose(w, (2, 3, 1, 0)),
+            window_strides=(1, 1), padding=padding,
+            lhs_dilation=stride, dimension_numbers=dn,
+            feature_group_count=groups)
+        if bias is not None:
+            y = y + bias
+        return y.astype(data.dtype)
     dn = lax.conv_dimension_numbers(
         data.shape, w.shape,
         ("NCHW", "OIHW", "NCHW") if nd == 2 else
         ("NCW", "OIW", "NCW") if nd == 1 else ("NCDHW", "OIDHW", "NCDHW"))
-    padding = [(kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i])
-               for i in range(nd)]
     y = lax.conv_general_dilated(
         data, w, window_strides=(1,) * nd, padding=padding,
         lhs_dilation=stride, dimension_numbers=dn,
@@ -130,8 +218,10 @@ def pooling(attrs, ctx, data):
     Reference: src/operator/pooling-inl.h (+pooling.cc registration).
     """
     nd = data.ndim - 2
+    nhwc = nd == 2 and _IMAGE_LAYOUT == "NHWC"
+    sp0 = 1 if nhwc else 2  # first spatial axis
     if attrs["global_pool"]:
-        kernel = data.shape[2:]
+        kernel = data.shape[sp0:sp0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -139,19 +229,25 @@ def pooling(attrs, ctx, data):
         # reference defaults stride to 1 (pooling-inl.h), NOT to the kernel
         stride = tuple(attrs["stride"]) or (1,) * nd
         pad = tuple(attrs["pad"]) or (0,) * nd
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
     conv = attrs.get("pooling_convention", "valid")
-    padding = [(0, 0), (0, 0)]
+    spatial_pad = []
     for i in range(nd):
         lo = hi = pad[i]
         if conv == "full":
             # ceil division convention: pad extra on the high side as needed
-            in_sz = data.shape[2 + i] + 2 * pad[i]
+            in_sz = data.shape[sp0 + i] + 2 * pad[i]
             rem = (in_sz - kernel[i]) % stride[i]
             if rem:
                 hi += stride[i] - rem
-        padding.append((lo, hi))
+        spatial_pad.append((lo, hi))
+    if nhwc:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        padding = [(0, 0)] + spatial_pad + [(0, 0)]
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        padding = [(0, 0), (0, 0)] + spatial_pad
     ptype = attrs["pool_type"]
     # init values must be python literals (the identity element) so JAX's
     # reduce_window autodiff monoid pattern-match fires
@@ -175,6 +271,104 @@ def pooling(attrs, ctx, data):
 
 
 # ---------------------------------------------------------------- batch norm
+@functools.lru_cache(maxsize=None)
+def _bn_core(eps, momentum, train_stats, bshape_key):
+    """Hand-scheduled BatchNorm fwd/bwd (custom_vjp).
+
+    BN statistics are the #1 non-MXU cost in conv nets (they tie the convs
+    in the ResNet-50 step profile), so the pass structure is explicit:
+      fwd: ONE fused stats pass (sum, sum of squares -> mean, biased var),
+           then one normalize pass as a single multiply-add per element.
+      bwd: ONE fused reduce pass (sum dy, sum dy*x), then one dx pass
+           (dx = a*dy + c*x + d with per-channel scalars).
+    The jax-autodiff formulation of mean/var costs roughly twice these
+    memory passes.  Reference kernel: src/operator/batch_norm-inl.h.
+    """
+    import jax as _jax
+
+    bshape = tuple(bshape_key)
+    red = tuple(i for i, s in enumerate(bshape) if s == 1)
+
+    def fwd_math(x, gamma, beta, mm, mv):
+        xf = x.astype(jnp.float32)
+        if train_stats:
+            n = 1
+            for i in red:
+                n *= x.shape[i]
+            # single-pass sum/sum² stats, SHIFTED by the moving mean: for
+            # any constant c, var = E[(x-c)²] - E[x-c]².  With c ≈ the true
+            # mean (which the moving mean approaches) this avoids the
+            # catastrophic f32 cancellation of the raw E[x²]-E[x]² form on
+            # large-mean channels, while keeping one fused read of x.
+            c = lax.stop_gradient(mm.astype(jnp.float32))
+            xs = xf - c.reshape(bshape)
+            s1 = jnp.sum(xs, axis=red)
+            s2 = jnp.sum(jnp.square(xs), axis=red)
+            meanc = s1 / n
+            var = jnp.maximum(s2 / n - jnp.square(meanc), 0.0)
+            mean = meanc + c
+            new_mm = mm * momentum + mean * (1 - momentum)
+            new_mv = mv * momentum + var * (1 - momentum)
+        else:
+            mean, var = mm.astype(jnp.float32), mv.astype(jnp.float32)
+            new_mm, new_mv = mm, mv
+        inv = lax.rsqrt(var + eps)
+        scale = gamma.astype(jnp.float32) * inv
+        shift = beta.astype(jnp.float32) - mean * scale
+        out = (xf * scale.reshape(bshape) + shift.reshape(bshape))
+        return (out.astype(x.dtype), mean, var, new_mm, new_mv), \
+            (mean, inv, mm)
+
+    @_jax.custom_vjp
+    def bn(x, gamma, beta, mm, mv):
+        return fwd_math(x, gamma, beta, mm, mv)[0]
+
+    def bn_fwd(x, gamma, beta, mm, mv):
+        outs, (mean, inv, mm_res) = fwd_math(x, gamma, beta, mm, mv)
+        return outs, (x, gamma, mean, inv, mm_res)
+
+    def bn_bwd(res, cots):
+        x, gamma, mean, inv, mm = res
+        dy, dmean_o, dvar_o, dmm_o, dmv_o = cots
+        n = 1
+        for i in red:
+            n *= x.shape[i]
+        dyf = dy.astype(jnp.float32)
+        # same shifted formulation as forward (avoids cancellation in the
+        # sum(dy*x) - mean*sum(dy) difference on large-mean channels)
+        c = lax.stop_gradient(mm.astype(jnp.float32))
+        xs = x.astype(jnp.float32) - c.reshape(bshape)
+        meanc = mean - c
+        dbeta = jnp.sum(dyf, axis=red)
+        sdyxs = jnp.sum(dyf * xs, axis=red)
+        dgamma = (sdyxs - meanc * dbeta) * inv  # = sum(dy * xhat)
+        a = gamma.astype(jnp.float32) * inv
+        if train_stats:
+            # dx = (a/n)(n*dy - sum(dy) - xhat*sum(dy*xhat)), written as
+            # a*dy + K*(x - mean) + const, plus the cotangent paths of the
+            # explicit mean/var/moving outputs
+            dmean = dmean_o + (1 - momentum) * dmm_o
+            dvar = dvar_o + (1 - momentum) * dmv_o
+            k = (-a * inv * dgamma + 2.0 * dvar) * (1.0 / n)
+            d = -k * meanc - a * dbeta * (1.0 / n) + dmean * (1.0 / n)
+            dx = (dyf * a.reshape(bshape) + xs * k.reshape(bshape)
+                  + d.reshape(bshape))
+            dmm = momentum * dmm_o
+            dmv = momentum * dmv_o
+        else:
+            # eval/global-stats: moving stats are aux constants; the
+            # normalize path into them is not differentiated (the
+            # reference never backprops into moving stats)
+            dx = dyf * a.reshape(bshape)
+            dmm = dmm_o + dmean_o
+            dmv = dmv_o + dvar_o
+        return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+                dbeta.astype(gamma.dtype), dmm, dmv)
+
+    bn.defvjp(bn_fwd, bn_bwd)
+    return bn
+
+
 @register("BatchNorm",
           arg_names=("data", "gamma", "beta"),
           aux_names=("moving_mean", "moving_var"),
@@ -193,40 +387,35 @@ def batch_norm(attrs, ctx, data, gamma, beta, moving_mean, moving_var):
     Returns (out[, mean, var], new_moving_mean, new_moving_var).
     """
     axis = int(attrs["axis"])
+    if axis == 1 and data.ndim == 4 and _IMAGE_LAYOUT == "NHWC":
+        axis = 3  # NHWC mode: symbols declare the reference NCHW channel axis
     eps = float(attrs["eps"])
     momentum = float(attrs["momentum"])
-    red = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(1 if i != axis else data.shape[axis]
                    for i in range(data.ndim))
     if attrs["fix_gamma"]:
-        gamma = jnp.ones_like(gamma)
-    xf = data.astype(jnp.float32)
-    if ctx.is_train and not attrs["use_global_stats"]:
-        mean = jnp.mean(xf, axis=red)
-        var = jnp.var(xf, axis=red)
-        new_mean = moving_mean * momentum + mean * (1 - momentum)
-        new_var = moving_var * momentum + var * (1 - momentum)
-    else:
-        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
-        new_mean, new_var = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps)
-    out = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
-    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
-    out = out.astype(data.dtype)
+        gamma = lax.stop_gradient(jnp.ones_like(gamma))
+    train_stats = bool(ctx.is_train and not attrs["use_global_stats"])
+    bn = _bn_core(eps, momentum, train_stats, bshape)
+    out, mean, var, new_mm, new_mv = bn(data, gamma, beta,
+                                        moving_mean.astype(jnp.float32),
+                                        moving_var.astype(jnp.float32))
+    new_mm = new_mm.astype(moving_mean.dtype)
+    new_mv = new_mv.astype(moving_var.dtype)
     if attrs.get("output_mean_var"):
-        return (out, mean, var,
-                new_mean.astype(moving_mean.dtype), new_var.astype(moving_var.dtype))
-    return (out, new_mean.astype(moving_mean.dtype), new_var.astype(moving_var.dtype))
+        return out, mean, var, new_mm, new_mv
+    return out, new_mm, new_mv
 
 
 @register("InstanceNorm", arg_names=("data", "gamma", "beta"),
           params={"eps": 1e-3})
 def instance_norm(attrs, ctx, data, gamma, beta):
     """Reference: src/operator/instance_norm-inl.h."""
-    red = tuple(range(2, data.ndim))
+    ch = _ch_axis(data)
+    red = tuple(i for i in range(1, data.ndim) if i != ch)
     mean = jnp.mean(data, axis=red, keepdims=True)
     var = jnp.var(data, axis=red, keepdims=True)
-    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    bshape = tuple(-1 if i == ch else 1 for i in range(data.ndim))
     out = (data - mean) * lax.rsqrt(var + attrs["eps"])
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
 
@@ -253,11 +442,14 @@ def l2_normalization(attrs, ctx, data):
 def lrn(attrs, ctx, data):
     """Local response norm across channels.  Reference: src/operator/lrn-inl.h."""
     nsize = int(attrs["nsize"])
+    ch = _ch_axis(data)
     sq = jnp.square(data.astype(jnp.float32))
     pre = nsize // 2
     post = nsize - pre - 1
-    padded = jnp.pad(sq, [(0, 0), (pre, post)] + [(0, 0)] * (data.ndim - 2))
-    acc = sum(lax.slice_in_dim(padded, i, i + data.shape[1], axis=1)
+    pads = [(0, 0)] * data.ndim
+    pads[ch] = (pre, post)
+    padded = jnp.pad(sq, pads)
+    acc = sum(lax.slice_in_dim(padded, i, i + data.shape[ch], axis=ch)
               for i in range(nsize))
     scale = attrs["knorm"] + (attrs["alpha"] / nsize) * acc
     return (data * scale ** (-attrs["beta"])).astype(data.dtype)
@@ -292,7 +484,9 @@ def leaky_relu(attrs, ctx, data, gamma=None):
     if t == "leaky":
         return jnp.where(data > 0, data, data * attrs["slope"])
     if t == "prelu":
-        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        ch = _ch_axis(data)
+        g = gamma.reshape(tuple(-1 if i == ch else 1
+                                for i in range(data.ndim)))
         return jnp.where(data > 0, data, data * g)
     if t == "elu":
         return jnp.where(data > 0, data, attrs["slope"] * (jnp.exp(data) - 1))
@@ -343,7 +537,7 @@ def log_softmax_op(attrs, ctx, data):
 def softmax_activation(attrs, ctx, data):
     """Reference: src/operator/softmax_activation-inl.h."""
     if attrs["mode"] == "channel":
-        return _softmax(data, 1)
+        return _softmax(data, _ch_axis(data))
     return _softmax(data.reshape((data.shape[0], -1)), -1).reshape(data.shape)
 
 
@@ -512,7 +706,10 @@ def flatten_op(attrs, ctx, data):
           aliases=("concat",))
 def concat(attrs, ctx, *args):
     """Reference: src/operator/concat-inl.h."""
-    return jnp.concatenate(args, axis=int(attrs["dim"]))
+    dim = int(attrs["dim"])
+    if dim == 1 and all(_is_nhwc(a) for a in args):
+        dim = 3  # channel concat under the trainer's NHWC activation mode
+    return jnp.concatenate(args, axis=dim)
 
 
 @register("SliceChannel",
@@ -521,9 +718,12 @@ def concat(attrs, ctx, *args):
           aliases=("split",))
 def slice_channel(attrs, ctx, data):
     """Reference: src/operator/slice_channel-inl.h."""
-    parts = jnp.split(data, int(attrs["num_outputs"]), axis=int(attrs["axis"]))
+    axis = int(attrs["axis"])
+    if axis == 1 and _is_nhwc(data):
+        axis = 3
+    parts = jnp.split(data, int(attrs["num_outputs"]), axis=axis)
     if attrs["squeeze_axis"]:
-        parts = [jnp.squeeze(p, axis=int(attrs["axis"])) for p in parts]
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
     return tuple(parts)
 
 
@@ -536,9 +736,12 @@ def embedding(attrs, ctx, data, weight):
 
 @register("Pad", params={"mode": "constant", "pad_width": (), "constant_value": 0.0})
 def pad_op(attrs, ctx, data):
-    """Reference: src/operator/pad-inl.h."""
+    """Reference: src/operator/pad-inl.h (pad_width is declared in the
+    reference NCHW axis order; permuted here when activations are NHWC)."""
     pw = tuple(attrs["pad_width"])
     pairs = [(int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(len(pw) // 2)]
+    if len(pairs) == 4 and _is_nhwc(data):
+        pairs = [pairs[0], pairs[2], pairs[3], pairs[1]]
     mode = attrs["mode"]
     if mode == "constant":
         return jnp.pad(data, pairs, constant_values=attrs["constant_value"])
@@ -557,17 +760,18 @@ def pad_op(attrs, ctx, data):
 def upsampling(attrs, ctx, *args):
     """Nearest-neighbour upsampling.  Reference: src/operator/upsampling-inl.h."""
     scale = int(attrs["scale"])
+    h_ax = 1 if _is_nhwc(args[0]) else 2
     outs = []
-    target = args[0].shape[2] * scale
+    target = args[0].shape[h_ax] * scale
     for a in args:
-        s = target // a.shape[2]
-        out = jnp.repeat(jnp.repeat(a, s, axis=2), s, axis=3)
+        s = target // a.shape[h_ax]
+        out = jnp.repeat(jnp.repeat(a, s, axis=h_ax), s, axis=h_ax + 1)
         outs.append(out)
     if len(outs) == 1:
         return outs[0]
     if attrs["multi_input_mode"] == "sum":
         return sum(outs)
-    return jnp.concatenate(outs, axis=1)
+    return jnp.concatenate(outs, axis=3 if _is_nhwc(args[0]) else 1)
 
 
 @register("Crop", arg_names=lambda a: tuple(f"arg{i}" for i in range(int(a["num_args"]))),
@@ -577,15 +781,20 @@ def upsampling(attrs, ctx, *args):
 def crop(attrs, ctx, *args):
     """Reference: src/operator/crop-inl.h."""
     data = args[0]
+    nhwc = _is_nhwc(data)
+    h_ax = 1 if nhwc else 2
     if len(args) == 2:
-        h, w = args[1].shape[2], args[1].shape[3]
+        h, w = args[1].shape[h_ax], args[1].shape[h_ax + 1]
     else:
         h, w = attrs["h_w"]
     if attrs["center_crop"]:
-        oh = (data.shape[2] - h) // 2
-        ow = (data.shape[3] - w) // 2
+        oh = (data.shape[h_ax] - h) // 2
+        ow = (data.shape[h_ax + 1] - w) // 2
     else:
         oh, ow = attrs["offset"]
+    if nhwc:
+        return lax.dynamic_slice(data, (0, oh, ow, 0),
+                                 (data.shape[0], h, w, data.shape[3]))
     return lax.dynamic_slice(data, (0, 0, oh, ow),
                              (data.shape[0], data.shape[1], h, w))
 

@@ -14,8 +14,18 @@ shapes.  A hook may return only what it can infer.
 from __future__ import annotations
 
 from .rnn import rnn_param_size
+from .nn import current_image_layout
 
 _PARAM_SHAPE_HOOKS = {}
+
+
+def _channels(data, attrs=None):
+    """Channel count of an activation under the active image layout.
+    Weights always keep the reference (channel-major) layout; only 4-d
+    activations move to NHWC under ``image_layout('NHWC')``."""
+    if len(data) == 4 and current_image_layout() == "NHWC":
+        return int(data[3])
+    return int(data[1])
 
 
 def register_param_shapes(op_name):
@@ -57,7 +67,7 @@ def _conv(attrs, known):
     kernel = tuple(int(k) for k in attrs["kernel"])
     num_filter = int(attrs["num_filter"])
     group = int(attrs["num_group"])
-    out = {"weight": (num_filter, int(data[1]) // group) + kernel}
+    out = {"weight": (num_filter, _channels(data) // group) + kernel}
     if not attrs["no_bias"]:
         out["bias"] = (num_filter,)
     return out
@@ -73,7 +83,7 @@ def _deconv(attrs, known):
     group = int(attrs["num_group"])
     # reference: weight shape (C, num_filter/group, *kernel)
     # (src/operator/deconvolution-inl.h InferShape)
-    out = {"weight": (int(data[1]), num_filter // group) + kernel}
+    out = {"weight": (_channels(data), num_filter // group) + kernel}
     if not attrs["no_bias"]:
         out["bias"] = (num_filter,)
     return out
@@ -84,7 +94,8 @@ def _bn(attrs, known):
     data = known.get("data")
     if data is None:
         return {}
-    c = (int(data[int(attrs.get("axis", 1))]),)
+    axis = int(attrs.get("axis", 1))
+    c = (_channels(data) if axis == 1 else int(data[axis]),)
     return {"gamma": c, "beta": c, "moving_mean": c, "moving_var": c}
 
 
@@ -93,7 +104,7 @@ def _in(attrs, known):
     data = known.get("data")
     if data is None:
         return {}
-    c = (int(data[1]),)
+    c = (_channels(data),)
     return {"gamma": c, "beta": c}
 
 
@@ -102,7 +113,7 @@ def _prelu(attrs, known):
     data = known.get("data")
     if data is None or attrs["act_type"] != "prelu":
         return {}
-    return {"gamma": (int(data[1]),)}
+    return {"gamma": (_channels(data),)}
 
 
 @register_param_shapes("Embedding")

@@ -12,6 +12,25 @@ jit-compiled XLA program over a device mesh:
   parallelism (absent in the reference, SURVEY §2.4);
 * parameters are donated, so updates are in-place in HBM.
 
+Mixed precision follows TPU practice rather than the reference's fp16
+path: master weights live permanently in float32, activations/grads run
+in ``dtype`` (bfloat16 on the MXU), and the optimizer updates the f32
+masters.  ``layout="NHWC"`` feeds channel-minor activations end-to-end —
+the layout XLA:TPU wants for convs — while weights keep the reference
+OIHW layout (see ops/nn.py `image_layout`).
+
+(Design note: a flat-packed fused optimizer — all masters concatenated
+into one vector per hyperparameter group — was tried and measured SLOWER
+on ResNet-50/v5e than per-parameter updates: the gradient concat and
+unpack relayouts cost more than the small-op overhead they remove.  XLA
+already fuses per-parameter updates adequately.)
+
+The optimizer is pluggable: any name registered in
+``mxnet_tpu.optimizer`` whose update rule has a fused formulation below
+(sgd/nag/ccsgd/adam/adagrad/rmsprop/adadelta), with the reference's
+lr_mult/wd_mult semantics (`python/mxnet/optimizer.py` _get_lr/_get_wd;
+wd_mult defaults to 0 for params not ending in _weight/_gamma).
+
 Module/Executor remain the API-parity path; bench.py and the pod-scale
 training scripts use this.
 """
@@ -22,35 +41,137 @@ import numpy as np
 from ..base import MXNetError
 from ..symbol import eval_graph, _classify_vars
 from ..initializer import Xavier, InitDesc
+from ..ops.nn import image_layout
+from .. import optimizer as _opt_mod
 
 __all__ = ["ShardedTrainer"]
 
 
+def _make_update_rule(opt):
+    """(n_state_slots, rule) for a fused, functional optimizer update.
+
+    ``rule(w, g, slots, lr, wd, t) -> (new_w, new_slots)`` over f32 master
+    weights; mirrors the semantics of the corresponding
+    ``mxnet_tpu.optimizer`` classes (themselves mirroring the reference's
+    fused update kernels, src/operator/optimizer_op.cc:18-161).
+    ``t`` is the 1-based update count (traced scalar, adam bias correction).
+    """
+    import jax.numpy as jnp
+
+    clip = opt.clip_gradient
+
+    def prep(g, w, wd):
+        if clip is not None and clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        return g + wd * w
+
+    name = type(opt).__name__.lower()
+
+    if name in ("sgd", "ccsgd"):
+        momentum = opt.momentum
+        if momentum == 0.0:
+            return 0, lambda w, g, s, lr, wd, t: (w - lr * prep(g, w, wd), s)
+
+        def sgd_rule(w, g, s, lr, wd, t):
+            m = momentum * s[0] - lr * prep(g, w, wd)
+            return w + m, [m]
+        return 1, sgd_rule
+
+    if name == "nag":
+        momentum = opt.momentum
+
+        def nag_rule(w, g, s, lr, wd, t):
+            g = prep(g, w, wd)
+            m = momentum * s[0] + g
+            return w - lr * (g + momentum * m), [m]
+        return 1, nag_rule
+
+    if name == "adam":
+        b1, b2, eps = opt.beta1, opt.beta2, opt.epsilon
+
+        def adam_rule(w, g, s, lr, wd, t):
+            g = prep(g, w, wd)
+            m = b1 * s[0] + (1 - b1) * g
+            v = b2 * s[1] + (1 - b2) * jnp.square(g)
+            lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+            return w - lr_t * m / (jnp.sqrt(v) + eps), [m, v]
+        return 2, adam_rule
+
+    if name == "adagrad":
+        eps = opt.float_stable_eps
+
+        def adagrad_rule(w, g, s, lr, wd, t):
+            if clip is not None and clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            h = s[0] + jnp.square(g)
+            return w - lr * (g / jnp.sqrt(h + eps) + wd * w), [h]
+        return 1, adagrad_rule
+
+    if name == "rmsprop" and not getattr(opt, "centered", False):
+        g1, eps = opt.gamma1, opt.epsilon
+
+        def rmsprop_rule(w, g, s, lr, wd, t):
+            g = prep(g, w, wd)
+            n = (1 - g1) * jnp.square(g) + g1 * s[0]
+            return w - lr * g / jnp.sqrt(n + eps), [n]
+        return 1, rmsprop_rule
+
+    if name == "adadelta":
+        rho, eps = opt.rho, opt.epsilon
+
+        def adadelta_rule(w, g, s, lr, wd, t):
+            if clip is not None and clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            acc_g = rho * s[0] + (1 - rho) * jnp.square(g)
+            delta = jnp.sqrt(s[1] + eps) / jnp.sqrt(acc_g + eps) * g
+            acc_d = rho * s[1] + (1 - rho) * jnp.square(delta)
+            return w - delta - wd * w, [acc_g, acc_d]
+        return 2, adadelta_rule
+
+    raise MXNetError(
+        "optimizer %r has no fused ShardedTrainer formulation; supported: "
+        "sgd, ccsgd, nag, adam, adagrad, rmsprop (non-centered), adadelta"
+        % name)
+
+
 class ShardedTrainer:
     def __init__(self, symbol, mesh, data_shapes, label_shapes=(),
-                 optimizer="sgd", learning_rate=0.05, momentum=0.9,
-                 weight_decay=0.0, initializer=None, dtype="float32",
-                 tp_rules=None, seed=0):
+                 optimizer="sgd", optimizer_params=None, learning_rate=0.05,
+                 momentum=0.9, weight_decay=0.0, initializer=None,
+                 dtype="float32", tp_rules=None, seed=0, layout=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
-        data_shapes/label_shapes: dict name -> GLOBAL shape (batch dim 0).
+        data_shapes/label_shapes: dict name -> GLOBAL shape (batch dim 0),
+            in the reference NCHW convention regardless of ``layout``.
+        optimizer: registry name (or an Optimizer instance) — see
+            `_make_update_rule` for the fused set.  ``learning_rate`` /
+            ``momentum`` / ``weight_decay`` are convenience defaults merged
+            into ``optimizer_params``.
+        dtype: compute dtype for activations/grads (master weights stay f32).
         tp_rules: {param_name: axis_index} — weight dims to shard over the
             'model' axis.  Default: classifier-style FullyConnected weights
             whose output dim divides the tp size.
+        layout: None (reference NCHW) or "NHWC" (TPU-preferred channel-minor
+            activations; host batches are transposed on ingest).  Weights
+            keep reference layouts, so NHWC parameters are interchangeable
+            with NCHW checkpoints whenever Flatten only ever sees 1x1
+            spatial maps (global-pool-then-FC nets like ResNet/Inception);
+            an MLP-style Flatten of a WxH map permutes the FC input order.
         """
         import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self.symbol = symbol
         self.mesh = mesh
-        self.lr = learning_rate
-        self.momentum = momentum
-        self.wd = weight_decay
         self.dtype = dtype
+        if layout not in (None, "NCHW", "NHWC"):
+            raise MXNetError("unsupported layout %r" % (layout,))
+        self._layout = layout or "NCHW"
 
         self._topo = symbol._topo()
+        if self._layout == "NHWC":
+            self._check_nhwc_safe()
         arg_nodes, aux_nodes = _classify_vars(self._topo)
         self._arg_nodes, self._aux_nodes = arg_nodes, aux_nodes
         arg_names = [n.name for n in arg_nodes]
@@ -59,31 +180,66 @@ class ShardedTrainer:
                              if n not in self._input_names]
         self._aux_names = [n.name for n in aux_nodes]
 
-        shapes = dict(data_shapes)
-        shapes.update(label_shapes or {})
-        arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+        # inputs whose activations move to channel-minor under NHWC
+        self._nhwc_inputs = set()
+        if self._layout == "NHWC":
+            self._nhwc_inputs = {n for n, s in data_shapes.items()
+                                 if len(s) == 4}
+
+        def to_layout(name, shape):
+            if name in self._nhwc_inputs:
+                n, c, h, w = shape
+                return (n, h, w, c)
+            return tuple(shape)
+
+        shapes = {n: to_layout(n, s) for n, s in data_shapes.items()}
+        for n, s in (label_shapes or {}).items():
+            shapes[n] = tuple(s)
+        self._input_shapes = shapes
+        with image_layout(self._layout):
+            arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
         self._arg_shapes = dict(zip(arg_names, arg_shapes))
         self._aux_shapes = dict(zip(self._aux_names, aux_shapes))
-        batch_axis_size = next(iter(data_shapes.values()))[0]
-        self._rescale = 1.0 / batch_axis_size
+        global_batch = next(iter(data_shapes.values()))[0]
 
-        # ---- init params on host, then device_put with shardings
+        # ---- optimizer: registry-created, reference mult semantics
+        if isinstance(optimizer, str):
+            kw = dict(optimizer_params or {})
+            kw.setdefault("learning_rate", learning_rate)
+            kw.setdefault("wd", weight_decay)
+            if optimizer.lower() in ("sgd", "ccsgd", "nag", "dcasgd"):
+                kw.setdefault("momentum", momentum)
+            kw.setdefault("rescale_grad", 1.0 / global_batch)
+            kw.setdefault("param_idx2name",
+                          {n: n for n in self._param_names})
+            optimizer = _opt_mod.create(optimizer, **kw)
+        else:
+            # instance path: mirror Module.init_optimizer (reference
+            # module.py:461-463) — default rescale to gradient averaging
+            # and give the wd_mult/lr_mult machinery the param names
+            if optimizer.rescale_grad == 1.0:
+                optimizer.rescale_grad = 1.0 / global_batch
+            if not optimizer.idx2name:
+                optimizer.idx2name = {n: n for n in self._param_names}
+                optimizer.set_lr_mult({})
+                optimizer.set_wd_mult({})
+        self.optimizer = optimizer
+        self._rescale = optimizer.rescale_grad
+        self._n_slots, self._update_rule = _make_update_rule(optimizer)
+
+        # ---- init params on host (f32 masters), device_put with shardings.
+        # Initializer errors propagate: a wrong-shape bug must not silently
+        # become a different init.
         init = initializer or Xavier(rnd_type="gaussian", factor_type="in",
                                      magnitude=2)
-        rng = np.random.RandomState(seed)
         host_params = {}
         for name in self._param_names:
-            arr = _HostArray(np.zeros(self._arg_shapes[name],
-                                      np.dtype(dtype)))
-            try:
-                init(InitDesc(name), arr)
-            except Exception:
-                arr.data[...] = rng.normal(
-                    0, 0.01, self._arg_shapes[name]).astype(dtype)
+            arr = _HostArray(np.zeros(self._arg_shapes[name], np.float32))
+            init(InitDesc(name), arr)
             host_params[name] = arr.data
         host_aux = {}
         for name in self._aux_names:
-            v = np.zeros(self._aux_shapes[name], np.dtype(dtype))
+            v = np.zeros(self._aux_shapes[name], np.float32)
             if name.endswith("moving_var"):
                 v[...] = 1.0
             host_aux[name] = v
@@ -124,17 +280,72 @@ class ShardedTrainer:
             self.aux = {n: jax.device_put(host_aux[n],
                                           self._aux_sharding[n])
                         for n in self._aux_names}
-            self.momentum_state = {
-                n: jax.device_put(np.zeros_like(host_params[n]),
-                                  self._param_sharding[n])
+            self.opt_state = {
+                n: [jax.device_put(np.zeros_like(host_params[n]),
+                                   self._param_sharding[n])
+                    for _ in range(self._n_slots)]
                 for n in self._param_names}
 
         self._step_fn = self._build_step()
         self._fwd_fn = None
         self._step_count = 0
         self._key = jax.random.PRNGKey(seed)
+        self._hyper_snapshot = self._hyper_state()
+
+    def _hyper_state(self):
+        """Optimizer hyperparameters baked into the compiled step."""
+        opt = self.optimizer
+        rule_attrs = tuple(
+            (a, getattr(opt, a)) for a in
+            ("momentum", "beta1", "beta2", "epsilon", "gamma1", "gamma2",
+             "rho", "float_stable_eps") if hasattr(opt, a))
+        return (dict(opt.lr_mult), dict(opt.wd_mult), opt.wd,
+                opt.rescale_grad, opt.clip_gradient, rule_attrs)
 
     # ------------------------------------------------------------ builders
+    # ops adapted to NHWC activations (ops/nn.py) — their axis attrs are
+    # remapped at trace time, so an explicit channel-ish axis is fine
+    _NHWC_ADAPTED = frozenset({
+        "Convolution", "Deconvolution", "Pooling", "BatchNorm", "Concat",
+        "SliceChannel", "LRN", "InstanceNorm", "LeakyReLU", "UpSampling",
+        "Crop", "Pad", "SoftmaxActivation", "Flatten", "FullyConnected",
+        "Activation", "Dropout", "SoftmaxOutput",
+    })
+
+    def _check_nhwc_safe(self):
+        """Refuse NHWC mode for graphs whose ops would silently index the
+        wrong axis.  Two classes: known channel-axis ops with no NHWC
+        adaptation, and generic tensor ops pinning an explicit axis that
+        could be spatial/channel (axis semantics are written against the
+        reference NCHW convention)."""
+        from ..ops.nn import NHWC_UNAWARE_OPS
+        bad = set()
+        for node in self._topo:
+            if node.op is None:
+                continue
+            name = node.op.name
+            if name in NHWC_UNAWARE_OPS:
+                bad.add(name)
+                continue
+            if name in self._NHWC_ADAPTED:
+                continue
+            if name == "transpose" and not node.attrs.get("axes"):
+                bad.add("transpose()")  # default axes reverse all dims
+                continue
+            for key in ("axis", "dim", "axes", "begin", "end"):
+                v = node.attrs.get(key)
+                vals = v if isinstance(v, (tuple, list)) else (v,)
+                if any(isinstance(x, int) and
+                       (1 <= x <= 3 or -3 <= x <= -1) for x in vals):
+                    bad.add("%s(%s=%r)" % (name, key, v))
+                    break
+        if bad:
+            raise MXNetError(
+                "layout='NHWC' is not supported for graphs containing "
+                "%s — these index axes in the reference NCHW convention "
+                "and have no NHWC adaptation; use the default NCHW "
+                "layout" % ", ".join(sorted(bad)))
+
     def _node_value_map(self, params, batch, aux):
         vals = {}
         for node in self._arg_nodes:
@@ -146,6 +357,13 @@ class ShardedTrainer:
             vals[id(node)] = aux[node.name]
         return vals
 
+    def _per_param_hyper(self, name):
+        """Static (lr_mult, effective_wd) for one param, ref semantics."""
+        opt = self.optimizer
+        lr_mult = opt.lr_mult.get(name, 1.0)
+        wd_mult = opt.wd_mult.get(name, 1.0)
+        return lr_mult, wd_mult * opt.wd
+
     def _build_step(self):
         import jax
         import jax.numpy as jnp
@@ -153,16 +371,23 @@ class ShardedTrainer:
         topo, entries = self._topo, self.symbol._entries
         head_is_loss = [bool(n.op is not None and n.op.is_loss)
                         for (n, _i) in entries]
-        lr, mom, wd, rescale = self.lr, self.momentum, self.wd, self._rescale
+        rescale = self._rescale
+        compute_dtype = jnp.dtype(self.dtype)
+        layout, rule = self._layout, self._update_rule
+        hyper = {k: self._per_param_hyper(k) for k in self._param_names}
 
-        def step(params, mom_state, aux, batch, key):
+        def step(params, opt_state, aux, batch, key, lr, t):
             bsz = next(iter(batch.values())).shape[0]
 
-            def fwd(p):
-                var_values = self._node_value_map(p, batch, aux)
-                heads, aux_upd = eval_graph(topo, entries, var_values,
-                                            is_train=True, key=key,
-                                            batch_size=bsz)
+            def fwd(p32):
+                # compute-precision copies of the f32 masters; the astype
+                # vjp returns f32 grads automatically
+                p = {k: v.astype(compute_dtype) for k, v in p32.items()}
+                with image_layout(layout):
+                    var_values = self._node_value_map(p, batch, aux)
+                    heads, aux_upd = eval_graph(topo, entries, var_values,
+                                                is_train=True, key=key,
+                                                batch_size=bsz)
                 return heads, aux_upd
 
             heads, vjp, aux_upd = jax.vjp(fwd, params, has_aux=True)
@@ -170,18 +395,17 @@ class ShardedTrainer:
                    for h, il in zip(heads, head_is_loss)]
             (grads,) = vjp(list(cot))
 
-            new_params, new_mom = {}, {}
+            new_params, new_state = {}, {}
             for k, w in params.items():
-                g = grads[k].astype(jnp.float32) * rescale + \
-                    wd * w.astype(jnp.float32)
-                m = mom * mom_state[k].astype(jnp.float32) - lr * g
-                new_mom[k] = m.astype(w.dtype)
-                new_params[k] = (w.astype(jnp.float32) + m).astype(w.dtype)
+                lr_mult, wd_eff = hyper[k]
+                g = grads[k].astype(jnp.float32) * rescale
+                new_params[k], new_state[k] = rule(
+                    w, g, opt_state[k], lr * lr_mult, wd_eff, t)
 
             new_aux = {}
-            aux_by_id = {id(n): n.name for n in self._aux_nodes}
             for n in self._aux_nodes:
-                new_aux[n.name] = aux_upd.get(id(n), aux[n.name])
+                upd = aux_upd.get(id(n), aux[n.name])
+                new_aux[n.name] = upd.astype(jnp.float32)
 
             # monitoring loss: mean -log p(label) from the softmax head
             loss = jnp.float32(0)
@@ -192,27 +416,32 @@ class ShardedTrainer:
             if label is not None and head_is_loss[0]:
                 probs = heads[0]
                 if probs.ndim == 2 and label.ndim == 1:
-                    idx = label.astype(jnp.int32)
-                    p = probs[jnp.arange(probs.shape[0]), idx]
+                    idx = label.astype(jnp.int32).reshape((-1, 1))
+                    p = jnp.take_along_axis(
+                        probs.astype(jnp.float32), idx, axis=1)[:, 0]
                     loss = -jnp.mean(jnp.log(jnp.maximum(p, 1e-10)))
-            return new_params, new_mom, new_aux, loss
+            return new_params, new_state, new_aux, loss
 
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        in_shardings = (self._param_sharding, self._param_sharding,
-                        self._aux_sharding, self._batch_sharding, None)
-        out_shardings = (self._param_sharding, self._param_sharding,
+        state_sharding = {n: [self._param_sharding[n]] * self._n_slots
+                          for n in self._param_names}
+        in_shardings = (self._param_sharding, state_sharding,
+                        self._aux_sharding, self._batch_sharding,
+                        None, None, None)
+        out_shardings = (self._param_sharding, state_sharding,
                          self._aux_sharding, None)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings,
-                       donate_argnums=(0, 1))
+                       donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------ api
     def _cast_batch(self, batch):
-        """Data inputs follow the compute dtype (bf16 training); labels
-        keep their own dtype."""
+        """Data inputs follow the compute dtype (bf16 training) and the
+        active layout; labels keep their own dtype."""
         out = {}
         for k, v in batch.items():
             v = np.asarray(v)
+            if k in self._nhwc_inputs and v.ndim == 4:
+                v = np.ascontiguousarray(v.transpose(0, 2, 3, 1))
             if "label" not in k and v.dtype.kind == "f":
                 v = v.astype(self.dtype)
             out[k] = v
@@ -231,15 +460,41 @@ class ShardedTrainer:
         with GLOBAL batch dim (or a dict from :meth:`put_batch`).
         Returns the (device) loss scalar."""
         import jax
+        import jax.numpy as jnp
         self._key, sub = jax.random.split(self._key)
         first = next(iter(batch.values()))
         if isinstance(first, jax.Array):
             dev_batch = batch
         else:
             dev_batch = self.put_batch(batch)
-        self.params, self.momentum_state, self.aux, loss = self._step_fn(
-            self.params, self.momentum_state, self.aux, dev_batch, sub)
+        opt = self.optimizer
+        # reference Optimizer reads lr_mult/wd_mult/rescale on every update;
+        # they are baked into the compiled step here, so honor post-build
+        # set_lr_mult()/set_wd_mult()/rescale changes by recompiling
+        if self._hyper_state() != self._hyper_snapshot:
+            self._rescale = opt.rescale_grad
+            old_slots = self._n_slots
+            self._n_slots, self._update_rule = _make_update_rule(opt)
+            if self._n_slots != old_slots:
+                with self.mesh:
+                    self.opt_state = {
+                        n: [jax.device_put(
+                                np.zeros(self._arg_shapes[n], np.float32),
+                                self._param_sharding[n])
+                            for _ in range(self._n_slots)]
+                        for n in self._param_names}
+            self._step_fn = self._build_step()
+            self._hyper_snapshot = self._hyper_state()
         self._step_count += 1
+        # num_update honors begin_num_update so lr schedule AND adam bias
+        # correction continue consistently across resume
+        opt.num_update = max(opt.num_update, opt.begin_num_update
+                             + self._step_count)
+        lr = (opt.lr_scheduler(opt.num_update)
+              if opt.lr_scheduler is not None else opt.lr)
+        self.params, self.opt_state, self.aux, loss = self._step_fn(
+            self.params, self.opt_state, self.aux, dev_batch, sub,
+            jnp.float32(lr), jnp.float32(opt.num_update))
         return loss
 
     def forward(self, batch, is_train=False):
@@ -247,19 +502,28 @@ class ShardedTrainer:
         import jax
         if self._fwd_fn is None:
             topo, entries = self._topo, self.symbol._entries
+            layout = self._layout
+            import jax.numpy as jnp
+            compute_dtype = jnp.dtype(self.dtype)
 
             def fwd(params, aux, batch):
-                var_values = self._node_value_map(params, batch, aux)
-                heads, _ = eval_graph(topo, entries, var_values,
-                                      is_train=False, key=None,
-                                      batch_size=next(
-                                          iter(batch.values())).shape[0])
+                p = {k: v.astype(compute_dtype) for k, v in params.items()}
+                with image_layout(layout):
+                    var_values = self._node_value_map(p, batch, aux)
+                    heads, _ = eval_graph(topo, entries, var_values,
+                                          is_train=False, key=None,
+                                          batch_size=next(
+                                              iter(batch.values())).shape[0])
                 return heads
             self._fwd_fn = jax.jit(fwd, in_shardings=(
                 self._param_sharding, self._aux_sharding,
                 self._batch_sharding))
-        dev_batch = {k: jax.device_put(v, self._batch_sharding[k])
-                     for k, v in self._cast_batch(batch).items()}
+        first = next(iter(batch.values()))
+        if isinstance(first, jax.Array):
+            dev_batch = batch  # already staged via put_batch
+        else:
+            dev_batch = {k: jax.device_put(v, self._batch_sharding[k])
+                         for k, v in self._cast_batch(batch).items()}
         return self._fwd_fn(self.params, self.aux, dev_batch)
 
 

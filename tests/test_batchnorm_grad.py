@@ -1,0 +1,123 @@
+"""BatchNorm hand-written backward vs JAX autodiff of the textbook formula.
+
+Regression guard for the fused BN kernel (ops/nn.py `_bn_core`): the
+round-2 code review caught an extra factor of `inv` in dx that standard
+unit-variance test data could not expose (inv ~ 1 hides scale errors),
+so every check here uses data with std far from 1.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.registry import OpContext
+from mxnet_tpu.ops.nn import batch_norm
+
+
+def _ref_bn_train(x, gamma, beta, eps):
+    """Plain autodiff-able BN with batch stats (biased var)."""
+    red = (0, 2, 3)
+    mean = jnp.mean(x, axis=red)
+    var = jnp.mean(jnp.square(x), axis=red) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + eps)
+    b = (1, -1, 1, 1)
+    return ((x - mean.reshape(b)) * inv.reshape(b) * gamma.reshape(b)
+            + beta.reshape(b))
+
+
+ATTRS = {"eps": 1e-3, "momentum": 0.9, "fix_gamma": False,
+         "use_global_stats": False, "output_mean_var": False, "axis": 1,
+         "cudnn_off": False}
+
+
+def _fused(x, gamma, beta, mm, mv, attrs=ATTRS, is_train=True):
+    ctx = OpContext(is_train=is_train, key=None)
+    return batch_norm(dict(attrs), ctx, x, gamma, beta, mm, mv)
+
+
+@pytest.mark.parametrize("scale,shift", [(3.0, 0.0), (0.25, 5.0)])
+def test_bn_dx_dgamma_dbeta_match_autodiff(scale, shift):
+    rng = np.random.RandomState(0)
+    x = (rng.randn(4, 6, 5, 5) * scale + shift).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, 6).astype(np.float32)
+    beta = rng.uniform(-1, 1, 6).astype(np.float32)
+    mm = np.zeros(6, np.float32)
+    mv = np.ones(6, np.float32)
+    cot = rng.randn(4, 6, 5, 5).astype(np.float32)
+
+    def loss_fused(x, gamma, beta):
+        out = _fused(x, gamma, beta, mm, mv)[0]
+        return jnp.sum(out * cot)
+
+    def loss_ref(x, gamma, beta):
+        return jnp.sum(_ref_bn_train(x, gamma, beta, 1e-3) * cot)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b, name in zip(gf, gr, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_bn_eval_mode_grad():
+    rng = np.random.RandomState(1)
+    x = (rng.randn(3, 4, 2, 2) * 2.5).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, 4).astype(np.float32)
+    beta = np.zeros(4, np.float32)
+    mm = rng.randn(4).astype(np.float32)
+    mv = rng.uniform(0.5, 4.0, 4).astype(np.float32)
+    eps = 1e-3
+
+    def loss(x):
+        out = _fused(x, gamma, beta, mm, mv, is_train=False)[0]
+        return jnp.sum(jnp.square(out))
+
+    g = jax.grad(loss)(x)
+    # analytic: d/dx sum((x-mm)*inv*gamma)^2 = 2*out*gamma*inv
+    inv = 1.0 / np.sqrt(mv + eps)
+    out = (x - mm.reshape(1, -1, 1, 1)) * (gamma * inv).reshape(1, -1, 1, 1)
+    expect = 2 * out * (gamma * inv).reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=2e-3, atol=2e-4)
+
+
+def test_bn_output_mean_var_cotangents_flow():
+    """A loss through the mean/var heads must reach x (review finding #4)."""
+    rng = np.random.RandomState(2)
+    x = (rng.randn(4, 3, 4, 4) * 2.0 + 1.0).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    attrs = dict(ATTRS, output_mean_var=True)
+
+    def loss_fused(x):
+        out, mean, var, _, _ = _fused(x, gamma, beta, mm, mv, attrs=attrs)
+        return jnp.sum(jnp.square(mean)) + jnp.sum(var)
+
+    def loss_ref(x):
+        red = (0, 2, 3)
+        mean = jnp.mean(x, axis=red)
+        var = jnp.mean(jnp.square(x), axis=red) - jnp.square(mean)
+        return jnp.sum(jnp.square(mean)) + jnp.sum(var)
+
+    gf = jax.grad(loss_fused)(x)
+    gr = jax.grad(loss_ref)(x)
+    assert float(jnp.max(jnp.abs(gf))) > 0
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_fix_gamma_zero_grad():
+    rng = np.random.RandomState(3)
+    x = (rng.randn(2, 3, 4, 4) * 4).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    attrs = dict(ATTRS, fix_gamma=True)
+
+    def loss(gamma):
+        out = _fused(x, gamma, np.zeros(3, np.float32),
+                     np.zeros(3, np.float32), np.ones(3, np.float32),
+                     attrs=attrs)[0]
+        return jnp.sum(jnp.square(out))
+
+    g = jax.grad(loss)(gamma)
+    np.testing.assert_allclose(np.asarray(g), np.zeros(3), atol=1e-6)

@@ -1,0 +1,124 @@
+"""Dissect the fused train step: dispatch overhead vs device compute.
+
+Runs the ShardedTrainer step three ways and prints a small report:
+  1. async-pipelined python loop (what bench.py measures),
+  2. fully-blocked loop (per-step latency incl. round-trip),
+  3. K steps fused into one jitted lax.scan program (pure device time).
+Also prints XLA's own cost analysis (FLOPs/step) and the implied MFU.
+
+Usage: python tools/profile_step.py [--batch 128] [--layers 50] [--scan 8]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--layers", type=int, default=50)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--scan", type=int, default=8)
+    ap.add_argument("--layout", default="NHWC")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--peak-tflops", type=float, default=197.0,
+                    help="chip peak bf16 TFLOP/s for MFU (v5e: 197)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    batch, image = args.batch, args.image
+    net = models.get_model("resnet%d" % args.layers, num_classes=1000,
+                           image_shape="3,%d,%d" % (image, image))
+    mesh = build_mesh(tp=1)
+    trainer = ShardedTrainer(
+        net, mesh,
+        data_shapes={"data": (batch, 3, image, image)},
+        label_shapes={"softmax_label": (batch,)},
+        learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+        dtype=args.dtype, layout=args.layout or None)
+
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)
+    y = rng.randint(0, 1000, batch).astype(np.float32)
+    staged = trainer.put_batch({"data": x, "softmax_label": y})
+
+    # warmup/compile
+    float(trainer.step(staged))
+    float(trainer.step(staged))
+
+    # --- 1. async-pipelined loop (bench.py methodology)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = trainer.step(staged)
+    float(loss)
+    t_async = (time.perf_counter() - t0) / args.steps
+
+    # --- 2. blocked loop: per-step wall latency incl. dispatch round-trip
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        float(trainer.step(staged))
+    t_block = (time.perf_counter() - t0) / args.steps
+
+    # --- 3. K fused steps in one program (pure device throughput)
+    k = args.scan
+    step_fn = trainer._step_fn
+
+    def multi(params, opt_state, aux, b, key, lr, t):
+        def body(carry, _):
+            p, s, a = carry
+            p, s, a, loss = step_fn(p, s, a, b, key, lr, t)
+            return (p, s, a), loss
+        (p, s, a), losses = jax.lax.scan(body, (params, opt_state, aux),
+                                         None, length=k)
+        return p, s, a, losses[-1]
+
+    multi_j = jax.jit(multi, donate_argnums=(0, 1, 2))
+    lr = jnp.float32(0.1)
+    tt = jnp.float32(1.0)
+    kk = jax.random.PRNGKey(0)
+    p, s, a, loss = multi_j(trainer.params, trainer.opt_state, trainer.aux,
+                            staged, kk, lr, tt)
+    float(loss)  # compile+run once
+    t0 = time.perf_counter()
+    p, s, a, loss = multi_j(p, s, a, staged, kk, lr, tt)
+    float(loss)
+    t_scan = (time.perf_counter() - t0) / k
+
+    # --- cost analysis
+    try:
+        lowered = step_fn.lower(trainer.params, trainer.opt_state,
+                                trainer.aux, staged, kk, lr, tt)
+        cost = lowered.compile().cost_analysis()
+        flops = cost.get("flops", float("nan"))
+    except Exception as e:  # cost analysis can be backend-dependent
+        print("cost_analysis unavailable:", e)
+        flops = float("nan")
+
+    def report(name, dt):
+        ips = batch / dt
+        mfu = (flops / dt) / (args.peak_tflops * 1e12) * 100 \
+            if flops == flops else float("nan")
+        print("%-22s %8.2f ms/step  %9.1f img/s  MFU %5.1f%%"
+              % (name, dt * 1e3, ips, mfu))
+
+    print("batch=%d image=%d layout=%s dtype=%s  flops/step=%.3g"
+          % (batch, image, args.layout, args.dtype, flops))
+    report("async loop", t_async)
+    report("blocked loop", t_block)
+    report("fused scan x%d" % k, t_scan)
+
+
+if __name__ == "__main__":
+    main()
