@@ -417,8 +417,11 @@ class ShardedTrainer:
                 probs = heads[0]
                 if probs.ndim == 2 and label.ndim == 1:
                     idx = label.astype(jnp.int32).reshape((-1, 1))
+                    # mode="clip": jit's default fill mode turns an
+                    # out-of-range label into NaN and poisons the metric
                     p = jnp.take_along_axis(
-                        probs.astype(jnp.float32), idx, axis=1)[:, 0]
+                        probs.astype(jnp.float32), idx, axis=1,
+                        mode="clip")[:, 0]
                     loss = -jnp.mean(jnp.log(jnp.maximum(p, 1e-10)))
             return new_params, new_state, new_aux, loss
 

@@ -183,7 +183,7 @@ def test_nhwc_deconv_builds():
     net = mx.sym.Deconvolution(net, kernel=(2, 2), stride=(2, 2),
                                num_filter=4, name="d1")
     net = mx.sym.Pooling(net, global_pool=True, pool_type="avg")
-    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=2, name="fc")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10, name="fc")
     net = mx.sym.SoftmaxOutput(net, name="softmax")
     mesh = build_mesh(tp=1)
     t = ShardedTrainer(net, mesh, data_shapes={"data": (8, 3, 8, 8)},
