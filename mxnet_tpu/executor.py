@@ -399,7 +399,7 @@ class Executor:
                 if n in self.grad_dict:
                     new_grads[n] = self.grad_dict[n]
             else:
-                if not (partial_shaping or n in kwargs or True):
+                if not (partial_shaping or n in kwargs):
                     raise MXNetError("unexpected shape change for %r" % n)
                 new_args[n] = _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
                 if self._grad_req.get(n, "null") != "null":

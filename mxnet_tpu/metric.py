@@ -241,13 +241,15 @@ class Perplexity(EvalMetric):
                 probs = probs * (1 - ignore) + ignore
             loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
             num += lab.size
-        self.sum_metric += numpy.exp(loss / num) * num
+        # reference metric.py Perplexity accumulates raw (loss, count) and
+        # applies exp once in get() — corpus perplexity over all tokens
+        self.sum_metric += loss
         self.num_inst += num
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, float(numpy.exp(self.sum_metric / self.num_inst)))
 
 
 @_register

@@ -130,6 +130,10 @@ def flash_attention_op(attrs, ctx, q, k, v):
     Pallas kernel on TPU, jnp fallback elsewhere.
     """
     causal = bool(attrs["causal"])
-    if _on_tpu():
+    t = q.shape[1]
+    block_q = min(_BLOCK_Q, t)
+    if _on_tpu() and t > 0 and t % block_q == 0 and k.shape[1] == t:
         return flash_attention(q, k, v, causal)
+    # ragged tails (seq not a multiple of the Q block) and cross-attention
+    # (tk != tq) take the jnp path rather than failing; XLA still fuses it
     return _attention_jnp(q, k, v, causal)

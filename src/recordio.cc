@@ -109,6 +109,11 @@ class RecordIOReader {
     return n;
   }
 
+  // Size of a record already stashed by a peek, or -1 if none.
+  int64_t PendingSize() const {
+    return has_pending_ ? static_cast<int64_t>(pending_.data.size()) : -1;
+  }
+
  private:
   void Run() {
     std::vector<uint8_t> header(8);
@@ -175,14 +180,39 @@ int64_t MXTPURecordIOReadFloatBatch(void* handle, float* labels,
   auto* r = static_cast<RecordIOReader*>(handle);
   std::vector<uint8_t> buf(24 + record_floats * 4);
   int64_t i = 0;
-  for (; i < batch; ++i) {
-    int64_t n = r->Next(buf.data(), static_cast<int64_t>(buf.size()));
+  while (i < batch) {
+    // two-phase: peek the size so flag>0 extra-label records never
+    // overflow or truncate regardless of label count; honor a record a
+    // caller already stashed via a bare-peek MXTPURecordIOReaderNext
+    int64_t n = r->PendingSize();
+    if (n < 0) n = r->Next(nullptr, 0);
     if (n <= 0) break;
-    // IRHeader: uint32 flag, float label, uint64 id, uint64 id2 (24 B)
-    std::memcpy(&labels[i], buf.data() + 4, 4);
+    if (n > static_cast<int64_t>(buf.size())) buf.resize(n);
+    n = r->TakePending(buf.data(), static_cast<int64_t>(buf.size()));
+    if (n <= 0) break;
+    // IRHeader: uint32 flag, float label, uint64 id, uint64 id2 (24 B).
+    // flag > 0 means `flag` label floats follow the header before the
+    // data payload (image_recordio.h:68-73 layout).
+    if (n < 24) continue;  // truncated / non-IRHeader record: skip
+    int64_t avail = std::min<int64_t>(n, static_cast<int64_t>(buf.size()));
+    uint32_t flag;
+    std::memcpy(&flag, buf.data(), 4);
+    int64_t data_off = 24;
+    if (flag > 0) {
+      data_off = 24 + static_cast<int64_t>(flag) * 4;
+      if (data_off > avail) continue;  // header claims more labels than bytes
+      std::memcpy(&labels[i], buf.data() + 24, 4);
+    } else {
+      std::memcpy(&labels[i], buf.data() + 4, 4);
+    }
     int64_t nfloats =
-        std::min<int64_t>(record_floats, (n - 24) / 4);
-    std::memcpy(data + i * record_floats, buf.data() + 24, nfloats * 4);
+        std::min<int64_t>(record_floats,
+                          std::max<int64_t>(0, (avail - data_off) / 4));
+    if (nfloats > 0) {
+      std::memcpy(data + i * record_floats, buf.data() + data_off,
+                  nfloats * 4);
+    }
+    ++i;
   }
   return i;
 }

@@ -182,3 +182,30 @@ def test_native_float_batch():
         np.testing.assert_allclose(labels, np.arange(8, dtype=np.float32))
         np.testing.assert_allclose(data[3], np.arange(4, dtype=np.float32) + 3)
         r.close()
+
+
+def test_native_float_batch_malformed_and_multilabel():
+    """Truncated records are skipped (no overflow) and IRHeader.flag>0
+    multi-label records are parsed at the right data offset
+    (image_recordio.h:68-73 layout)."""
+    from mxnet_tpu import io_native
+    if not io_native.available():
+        pytest.skip("no native toolchain")
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "mixed.rec")
+        w = mx.recordio.MXRecordIO(path, "w")
+        w.write(b"short")                                   # < 24 B: skip
+        payload = np.arange(4, dtype=np.float32) + 100.0
+        w.write(mx.recordio.pack(                           # flag=0
+            mx.recordio.IRHeader(0, 7.0, 0, 0), payload.tobytes()))
+        w.write(mx.recordio.pack(                           # flag=2
+            mx.recordio.IRHeader(2, np.array([5.0, 6.0], np.float32), 1, 0),
+            (payload + 1).tobytes()))
+        w.close()
+        r = io_native.NativeRecordIOReader(path)
+        n, labels, data = r.read_float_batch(4, 4)
+        assert n == 2
+        np.testing.assert_allclose(labels[:2], [7.0, 5.0])
+        np.testing.assert_allclose(data[0], payload)
+        np.testing.assert_allclose(data[1], payload + 1)
+        r.close()

@@ -193,6 +193,22 @@ def test_nhwc_deconv_builds():
     assert np.isfinite(loss)
 
 
+def test_out_of_range_label_finite_loss():
+    """Monitoring loss stays finite when a label exceeds the class count
+    (take_along_axis must clip, not NaN-fill, under jit)."""
+    np.random.seed(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mesh = build_mesh(tp=1)
+    t = ShardedTrainer(net, mesh, data_shapes={"data": (8, 4)},
+                       label_shapes={"softmax_label": (8,)})
+    labels = np.arange(8, dtype=np.float32) % 5  # values up to 4 >= 2 classes
+    loss = float(t.step({"data": np.random.randn(8, 4).astype(np.float32),
+                         "softmax_label": labels}))
+    assert np.isfinite(loss)
+
+
 def test_bench_script_cpu_smoke(monkeypatch, capsys):
     """bench.py end-to-end on the CPU mesh (tiny config)."""
     import importlib
