@@ -401,9 +401,34 @@ class Executor:
             else:
                 if not (partial_shaping or n in kwargs):
                     raise MXNetError("unexpected shape change for %r" % n)
-                new_args[n] = _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
-                if self._grad_req.get(n, "null") != "null":
-                    new_grads[n] = _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
+                old_size = 1
+                for d in cur.shape:
+                    old_size *= d
+                new_size = 1
+                for d in s:
+                    new_size *= d
+                if new_size > old_size:
+                    # reference executor.py:402-407: growing an array needs
+                    # an explicit opt-in (fresh allocation, values lost)
+                    if not allow_up_sizing:
+                        raise MXNetError(
+                            "new shape of arg %r larger than original; set "
+                            "allow_up_sizing=True to allocate new arrays" % n)
+                    new_args[n] = _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
+                    if self._grad_req.get(n, "null") != "null":
+                        new_grads[n] = _nd_zeros(s, ctx=self._ctx,
+                                                 dtype=cur.dtype)
+                else:
+                    # same-or-smaller: reinterpret the existing storage
+                    # (reference keeps memory shared via arr.reshape)
+                    new_args[n] = cur.reshape(s) if new_size == old_size \
+                        else _nd_zeros(s, ctx=self._ctx, dtype=cur.dtype)
+                    if self._grad_req.get(n, "null") != "null":
+                        g = self.grad_dict.get(n)
+                        new_grads[n] = (g.reshape(s)
+                                        if g is not None and new_size == old_size
+                                        else _nd_zeros(s, ctx=self._ctx,
+                                                       dtype=cur.dtype))
         for n, s in zip(self._aux_names, aux_shapes):
             cur = self.aux_dict[n]
             new_aux[n] = cur if tuple(cur.shape) == tuple(s) else \

@@ -21,6 +21,7 @@ from . import optimizer_ops as _opt  # noqa: F401
 from . import rnn as _rnn            # noqa: F401
 from . import contrib as _contrib    # noqa: F401
 from . import pallas_kernels as _pk  # noqa: F401
+from . import spatial as _spatial    # noqa: F401
 
 __all__ = ["OpContext", "Operator", "register", "get_op", "has_op",
            "list_ops", "imperative_invoke"]

@@ -1,0 +1,418 @@
+"""Spatial-warp and detection operators.
+
+Reference registration sites (SURVEY §2.1 operator corpus):
+  * GridGenerator       — src/operator/grid_generator-inl.h (affine | warp)
+  * BilinearSampler     — src/operator/bilinear_sampler-inl.h / .cc
+  * SpatialTransformer  — src/operator/spatial_transformer-inl.h / .cc
+  * ROIPooling          — src/operator/roi_pooling-inl.h / .cc
+  * Correlation         — src/operator/correlation-inl.h / .cc
+  * _contrib_Proposal   — src/operator/contrib/proposal-inl.h / .cc
+
+TPU-native design: every op is a vectorized jnp/lax program — bilinear
+sampling is four masked XLA gathers, ROI pooling is a separable masked max
+(no per-ROI scalar loops), correlation is a displacement-unrolled
+box-filter sum, and Proposal's greedy NMS is a `lax.fori_loop` over a
+precomputed pairwise-IoU matrix.  Everything is static-shaped and jittable;
+gradients come from jax autodiff (max-subgradient for ROI pooling matches
+the reference's argmax routing away from ties).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+# ---------------------------------------------------------------- sampling
+
+def _bilinear_sample(data, x_real, y_real, padding="zero"):
+    """Bilinear sampling core.
+
+    data: (N, C, H, W); x_real/y_real: (N, Ho, Wo) in input-pixel coords.
+    Returns (N, C, Ho, Wo).
+
+    padding="zero": corners outside [0, W-1]x[0, H-1] contribute 0
+    (BilinearSamplerForward, bilinear_sampler.cc:16-67).
+    padding="border": sample coords are clamped to the image rectangle
+    first, so out-of-range grids return edge values.  This is the
+    SpatialTransformer behavior for in-range grids
+    (spatial_transformer.cc:9-53); for out-of-range grids the reference's
+    index clamp produces extrapolation weights > 1 over out-of-bounds
+    reads (undefined), where this well-defined clamp diverges.
+    """
+    n, c, h, w = data.shape
+    if padding == "border":
+        x_real = jnp.clip(x_real, 0.0, w - 1.0)
+        y_real = jnp.clip(y_real, 0.0, h - 1.0)
+    tl_x = jnp.floor(x_real)
+    tl_y = jnp.floor(y_real)
+    wx = 1.0 - (x_real - tl_x)          # weight of the left column
+    wy = 1.0 - (y_real - tl_y)          # weight of the top row
+    tl_xi = tl_x.astype(jnp.int32)
+    tl_yi = tl_y.astype(jnp.int32)
+
+    batch = jnp.arange(n, dtype=jnp.int32)[:, None, None]
+
+    def corner(xi, yi):
+        valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+        xc = jnp.clip(xi, 0, w - 1)
+        yc = jnp.clip(yi, 0, h - 1)
+        v = data[batch, :, yc, xc]               # (N, Ho, Wo, C)
+        return jnp.where(valid[..., None], v, 0.0)
+
+    out = (corner(tl_xi, tl_yi) * (wy * wx)[..., None]
+           + corner(tl_xi + 1, tl_yi) * (wy * (1 - wx))[..., None]
+           + corner(tl_xi, tl_yi + 1) * ((1 - wy) * wx)[..., None]
+           + corner(tl_xi + 1, tl_yi + 1) * ((1 - wy) * (1 - wx))[..., None])
+    return out.transpose(0, 3, 1, 2)
+
+
+def _sample_normalized(data, grid, padding):
+    """Unnormalize a (N, 2, Ho, Wo) grid from [-1, 1] to pixel coords of
+    ``data`` and bilinear-sample (shared by BilinearSampler and
+    SpatialTransformer)."""
+    _, _, h, w = data.shape
+    x_real = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    y_real = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    return _bilinear_sample(data, x_real, y_real, padding)
+
+
+def _affine_grid(loc, target_shape):
+    """Normalized sampling grid from (N, 6) affine params
+    (GridGeneratorOp::Forward affine branch, grid_generator-inl.h:73-108;
+    same recipe as spatial_transformer-inl.h:81-94).
+
+    Returns (N, 2, H, W): channel 0 = x', channel 1 = y', both in [-1, 1]
+    target-normalized coordinates mapped through the affine matrix.
+    """
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    xs = -1.0 + jnp.arange(tw, dtype=loc.dtype) * (2.0 / (tw - 1)) \
+        if tw > 1 else jnp.zeros((1,), loc.dtype) - 1.0
+    ys = -1.0 + jnp.arange(th, dtype=loc.dtype) * (2.0 / (th - 1)) \
+        if th > 1 else jnp.zeros((1,), loc.dtype) - 1.0
+    gx = jnp.broadcast_to(xs[None, :], (th, tw)).reshape(-1)
+    gy = jnp.broadcast_to(ys[:, None], (th, tw)).reshape(-1)
+    grid_dst = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=0)  # (3, H*W)
+    theta = loc.reshape(-1, 2, 3)
+    src = jnp.einsum("nij,jk->nik", theta, grid_dst)           # (N, 2, H*W)
+    return src.reshape(-1, 2, th, tw)
+
+
+@register("GridGenerator", arg_names=("data",),
+          params={"transform_type": "affine", "target_shape": (0, 0)})
+def grid_generator(attrs, ctx, data):
+    """Sampling-grid generation (grid_generator-inl.h:56-140).
+
+    affine: data (N, 6) affine matrices -> (N, 2, H, W) normalized grid.
+    warp:   data (N, 2, H, W) optical flow -> normalized (flow + identity).
+    """
+    if attrs["transform_type"] == "affine":
+        return _affine_grid(data, attrs["target_shape"])
+    # warp (grid_generator-inl.h:110-139): grid_src = (flow + grid_dst)
+    # normalized by ((W-1)/2, (H-1)/2) then shifted by -1
+    n, two, h, w = data.shape
+    gx = jnp.broadcast_to(jnp.arange(w, dtype=data.dtype)[None, :], (h, w))
+    gy = jnp.broadcast_to(jnp.arange(h, dtype=data.dtype)[:, None], (h, w))
+    ident = jnp.stack([gx, gy], axis=0)                       # (2, H, W)
+    denom = jnp.array([(w - 1.0) / 2.0, (h - 1.0) / 2.0],
+                      dtype=data.dtype).reshape(1, 2, 1, 1)
+    return (data + ident[None]) / denom - 1.0
+
+
+@register("BilinearSampler", arg_names=("data", "grid"))
+def bilinear_sampler(attrs, ctx, data, grid):
+    """Bilinear sampling of ``data`` at normalized ``grid`` coords
+    (bilinear_sampler-inl.h + .cc:16-67).
+
+    data (N, C, H, W); grid (N, 2, Ho, Wo) with channel 0 = x, 1 = y in
+    [-1, 1].  Out-of-boundary samples are zero; gradients flow to both
+    data and grid (BilinearSamplerBackward).
+    """
+    return _sample_normalized(data, grid, padding="zero")
+
+
+@register("SpatialTransformer", arg_names=("data", "loc"),
+          params={"target_shape": (0, 0), "transform_type": "affine",
+                  "sampler_type": "bilinear"})
+def spatial_transformer(attrs, ctx, data, loc):
+    """Affine spatial transformer (spatial_transformer-inl.h:59-100):
+    grid = affine(loc), output = bilinear_sample(data, grid).
+
+    ``loc`` is the (N, 6) localization-network output; ``target_shape``
+    sets the output (H, W).
+    """
+    assert attrs["transform_type"] == "affine", "only affine is supported"
+    assert attrs["sampler_type"] == "bilinear", "only bilinear is supported"
+    grid = _affine_grid(loc, attrs["target_shape"])
+    return _sample_normalized(data, grid, padding="border")
+
+
+# ---------------------------------------------------------------- ROI pool
+
+@register("ROIPooling", arg_names=("data", "rois"),
+          params={"pooled_size": (0, 0), "spatial_scale": 1.0})
+def roi_pooling(attrs, ctx, data, rois):
+    """Fast-RCNN ROI max pooling (roi_pooling.cc ROIPoolForward:21-100).
+
+    data (N, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coords (scaled by ``spatial_scale`` onto the feature map).
+    Output (R, C, ph, pw).  TPU formulation: per-bin membership masks over
+    each spatial axis, then a separable masked max (h then w) — one fused
+    XLA program, no per-ROI loops.  Empty bins yield 0; rois get zero
+    gradient (index arithmetic only), matching the reference.
+    """
+    ph, pw = (int(s) for s in attrs["pooled_size"])
+    scale = float(attrs["spatial_scale"])
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+
+    batch_ind = rois[:, 0].astype(jnp.int32)
+    start_w = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    start_h = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    end_w = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    end_h = jnp.round(rois[:, 4] * scale).astype(jnp.int32)
+    # malformed ROIs become 1x1 (roi_pooling.cc:50-51)
+    roi_h = jnp.maximum(end_h - start_h + 1, 1).astype(data.dtype)
+    roi_w = jnp.maximum(end_w - start_w + 1, 1).astype(data.dtype)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    def axis_masks(start, bin_size, pooled, size):
+        # (R, pooled, size) bool: does pixel k fall into bin p of roi i
+        p = jnp.arange(pooled, dtype=data.dtype)
+        lo = jnp.floor(p[None, :] * bin_size[:, None]).astype(jnp.int32)
+        hi = jnp.ceil((p[None, :] + 1) * bin_size[:, None]).astype(jnp.int32)
+        lo = jnp.clip(lo + start[:, None], 0, size)
+        hi = jnp.clip(hi + start[:, None], 0, size)
+        k = jnp.arange(size, dtype=jnp.int32)
+        return (k[None, None, :] >= lo[:, :, None]) & \
+               (k[None, None, :] < hi[:, :, None])
+
+    mh = axis_masks(start_h, bin_h, ph, h)       # (R, ph, H)
+    mw = axis_masks(start_w, bin_w, pw, w)       # (R, pw, W)
+
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+    per_roi = data[batch_ind]                    # (R, C, H, W)
+    # max over w per (roi, pw): (R, C, H, pw)
+    t = jnp.where(mw[:, None, None, :, :], per_roi[:, :, :, None, :], neg)
+    t = t.max(axis=-1)
+    # max over h per (roi, ph): (R, C, ph, pw)
+    o = jnp.where(mh[:, None, :, None, :], t.transpose(0, 1, 3, 2)[:, :, None],
+                  neg)
+    o = o.max(axis=-1)                           # (R, C, ph, pw)
+    empty = ~(mh.any(-1)[:, None, :, None] & mw.any(-1)[:, None, None, :])
+    return jnp.where(empty | jnp.isneginf(o), 0.0, o)
+
+
+# ------------------------------------------------------------- correlation
+
+@register("Correlation", arg_names=("data1", "data2"),
+          params={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                  "stride2": 1, "pad_size": 0, "is_multiply": True})
+def correlation(attrs, ctx, data1, data2):
+    """FlowNet correlation layer (correlation.cc CorrelationForward:22-66).
+
+    For every output pixel and displacement (one of D^2 = top channels),
+    the kernel-window dot product (or abs difference) of data1 against
+    displaced data2, normalized by kernel_size^2 * channels.  Vectorized
+    as D^2 shifted elementwise products + a box-filter window sum.
+    """
+    k = int(attrs["kernel_size"])
+    md = int(attrs["max_displacement"])
+    s1 = int(attrs["stride1"])
+    s2 = int(attrs["stride2"])
+    pad = int(attrs["pad_size"])
+    mult = bool(attrs["is_multiply"])
+
+    n, c, h, w = data1.shape
+    hp, wp = h + 2 * pad, w + 2 * pad
+    kr = (k - 1) // 2
+    border = md + kr
+    top_w = int(math.ceil(float(wp - border * 2) / s1))
+    top_h = int(math.ceil(float(hp - border * 2) / s1))
+    ngr = md // s2                       # neighborhood grid radius
+    ngw = ngr * 2 + 1
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    sumelems = k * k * c
+
+    # kernel-window (box) sum anchored at the window's top-left corner
+    def box_sum(x):                      # x: (N, Hp, Wp)
+        return jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, k, k), (1, 1, 1), "valid")
+
+    outs = []
+    for tc in range(ngw * ngw):
+        s2o = (tc % ngw - ngr) * s2      # x displacement
+        s2p = (tc // ngw - ngr) * s2     # y displacement
+        sh2 = jnp.roll(p2, (-s2p, -s2o), axis=(2, 3))
+        prod = (p1 * sh2) if mult else jnp.abs(p1 - sh2)
+        win = box_sum(prod.sum(axis=1))  # (N, Hp-k+1, Wp-k+1)
+        # sample at y1 = i*s1 + md, x1 = j*s1 + md (top-left anchored)
+        sl = win[:, md:md + top_h * s1:s1, md:md + top_w * s1:s1]
+        outs.append(sl / sumelems)
+    return jnp.stack(outs, axis=1)       # (N, D^2, top_h, top_w)
+
+
+# ---------------------------------------------------------------- proposal
+
+def _generate_anchors(base_size, ratios, scales):
+    """Anchor windows, ratio-major x scale-minor
+    (proposal-inl.h:271-305 GenerateAnchors/_Transform/_MakeAnchor)."""
+    w = h = float(base_size)
+    x_ctr = 0.5 * (w - 1.0)
+    y_ctr = 0.5 * (h - 1.0)
+    size = w * h
+    out = []
+    for ratio in ratios:
+        size_ratio = math.floor(size / ratio)
+        for scale in scales:
+            nw = math.floor(math.sqrt(size_ratio) + 0.5) * scale
+            nh = math.floor((nw / scale * ratio) + 0.5) * scale
+            out.append([x_ctr - 0.5 * (nw - 1.0), y_ctr - 0.5 * (nh - 1.0),
+                        x_ctr + 0.5 * (nw - 1.0), y_ctr + 0.5 * (nh - 1.0)])
+    return np.array(out, np.float32)
+
+
+def _pairwise_iou(boxes):
+    """(n, n) IoU with the reference's +1 pixel convention
+    (proposal.cc NonMaximumSuppression:202-236)."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(0.0, xx2 - xx1 + 1.0)
+    ih = jnp.maximum(0.0, yy2 - yy1 + 1.0)
+    inter = iw * ih
+    return inter / (area[:, None] + area[None, :] - inter)
+
+
+def _greedy_nms(boxes, thresh):
+    """Greedy NMS over score-sorted boxes: suppressed[j] = True when an
+    earlier kept box overlaps it with IoU > thresh.  `lax.fori_loop`
+    formulation of proposal.cc:209-237 — sequential dependence only on
+    the scalar loop index, O(n^2) precomputed IoU."""
+    npre = boxes.shape[0]
+    iou = _pairwise_iou(boxes)
+    later = jnp.arange(npre)[None, :] > jnp.arange(npre)[:, None]
+
+    def body(i, suppressed):
+        row = (iou[i] > thresh) & later[i] & ~suppressed[i]
+        return suppressed | row
+
+    return jax.lax.fori_loop(0, npre, body, jnp.zeros((npre,), bool))
+
+
+@register("_contrib_Proposal", arg_names=("cls_prob", "bbox_pred", "im_info"),
+          num_outputs=lambda a: 2 if a.get("output_score") else 1,
+          params={"rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+                  "threshold": 0.7, "rpn_min_size": 16,
+                  "scales": (4.0, 8.0, 16.0, 32.0), "ratios": (0.5, 1.0, 2.0),
+                  "feature_stride": 16, "output_score": False,
+                  "iou_loss": False},
+          aliases=("Proposal",))
+def proposal(attrs, ctx, cls_prob, bbox_pred, im_info):
+    """RPN region proposals (contrib/proposal.cc:252-420): enumerate
+    shifted anchors, apply bbox deltas, clip to image, filter small boxes,
+    keep pre_nms_top_n by score, greedy NMS, emit post_nms_top_n rois
+    (batch index 0 prepended; short lists padded cyclically).
+
+    Single-image (batch 1) like the reference; non-differentiable
+    (ProposalOp::Backward zeroes all input grads).
+    """
+    assert cls_prob.shape[0] == 1, "Proposal handles one image per call"
+    num_anchors = cls_prob.shape[1] // 2
+    height, width = cls_prob.shape[2], cls_prob.shape[3]
+    count = num_anchors * height * width
+    stride = int(attrs["feature_stride"])
+    pre_nms = int(attrs["rpn_pre_nms_top_n"])
+    pre_nms = min(pre_nms, count) if pre_nms > 0 else count
+    post_nms = min(int(attrs["rpn_post_nms_top_n"]), pre_nms)
+
+    anchors = jnp.asarray(_generate_anchors(
+        stride, attrs["ratios"], attrs["scales"]))          # (A, 4)
+    sx = jnp.arange(width, dtype=jnp.float32) * stride
+    sy = jnp.arange(height, dtype=jnp.float32) * stride
+    # enumeration order: index = h*(W*A) + w*A + a (proposal.cc:332-347)
+    shift = jnp.stack(
+        [jnp.broadcast_to(sx[None, :, None], (height, width, num_anchors)),
+         jnp.broadcast_to(sy[:, None, None], (height, width, num_anchors)),
+         jnp.broadcast_to(sx[None, :, None], (height, width, num_anchors)),
+         jnp.broadcast_to(sy[:, None, None], (height, width, num_anchors))],
+        axis=-1)
+    boxes = (anchors[None, None] + shift).reshape(count, 4)
+
+    # foreground scores: second half of the channel axis (proposal.cc:268-276)
+    scores = cls_prob[0, num_anchors:].transpose(1, 2, 0).reshape(count)
+    # deltas: channel a*4+k at (h, w) for box index h*W*A + w*A + a
+    deltas = bbox_pred[0].reshape(num_anchors, 4, height, width) \
+        .transpose(2, 3, 0, 1).reshape(count, 4)
+
+    im_h, im_w, im_scale = im_info[0, 0], im_info[0, 1], im_info[0, 2]
+
+    if attrs["iou_loss"]:
+        # IoUTransformInv (proposal.cc:72-117): corner offsets
+        pred = boxes + deltas
+    else:
+        # BBoxTransformInv (proposal.cc:18-70): ctr/size deltas
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        ctr_x = boxes[:, 0] + 0.5 * (ws - 1.0)
+        ctr_y = boxes[:, 1] + 0.5 * (hs - 1.0)
+        pcx = deltas[:, 0] * ws + ctr_x
+        pcy = deltas[:, 1] * hs + ctr_y
+        pw = jnp.exp(deltas[:, 2]) * ws
+        phh = jnp.exp(deltas[:, 3]) * hs
+        pred = jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (phh - 1.0),
+                          pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (phh - 1.0)],
+                         axis=1)
+    pred = jnp.stack([jnp.clip(pred[:, 0], 0.0, im_w - 1.0),
+                      jnp.clip(pred[:, 1], 0.0, im_h - 1.0),
+                      jnp.clip(pred[:, 2], 0.0, im_w - 1.0),
+                      jnp.clip(pred[:, 3], 0.0, im_h - 1.0)], axis=1)
+
+    # zero out predictions on the padded part of the feature map
+    # (BBoxTransformInv:112-114 sets score -1 for h/w >= real_h/real_w)
+    hh = jnp.arange(count) // (width * num_anchors)
+    ww = (jnp.arange(count) // num_anchors) % width
+    real_h = (im_h / stride).astype(jnp.int32)
+    real_w = (im_w / stride).astype(jnp.int32)
+    scores = jnp.where((hh >= real_h) | (ww >= real_w), -1.0, scores)
+
+    # FilterBox (proposal.cc:122-135): tiny boxes get score -1
+    min_size = attrs["rpn_min_size"] * im_scale
+    bw = pred[:, 2] - pred[:, 0] + 1.0
+    bh = pred[:, 3] - pred[:, 1] + 1.0
+    small = (bw < min_size) | (bh < min_size)
+    grow = jnp.where(small, min_size / 2.0, 0.0)
+    pred = pred + jnp.stack([-grow, -grow, grow, grow], axis=1)
+    scores = jnp.where(small, -1.0, scores)
+
+    # sort desc, keep pre_nms_top_n (ReverseArgsort + ReorderProposals)
+    order = jnp.argsort(-scores)[:pre_nms]
+    top_boxes = pred[order]
+    top_scores = scores[order]
+
+    suppressed = _greedy_nms(top_boxes, float(attrs["threshold"]))
+    kept = ~suppressed
+    rank = jnp.cumsum(kept) - 1
+    keep = jnp.zeros((pre_nms,), jnp.int32).at[
+        jnp.where(kept, rank, pre_nms)].set(
+        jnp.arange(pre_nms, dtype=jnp.int32), mode="drop")
+    out_size = jnp.minimum(kept.sum(), post_nms)
+    # cyclic padding when fewer than post_nms survive (proposal.cc:390-404)
+    idx = keep[jnp.arange(post_nms) % jnp.maximum(out_size, 1)]
+
+    rois = jnp.concatenate(
+        [jnp.zeros((post_nms, 1), top_boxes.dtype), top_boxes[idx]], axis=1)
+    rois = jax.lax.stop_gradient(rois)
+    if attrs.get("output_score"):
+        return rois, jax.lax.stop_gradient(top_scores[idx][:, None])
+    return rois

@@ -86,5 +86,8 @@ class Predictor:
         return self._executor.outputs[index].asnumpy()
 
     def reshape(self, input_shapes):
-        self._executor = self._executor.reshape(**input_shapes)
+        # the C predict API reallocates freely on reshape
+        # (c_predict_api.cc MXPredReshape), so growing inputs is allowed
+        self._executor = self._executor.reshape(allow_up_sizing=True,
+                                                **input_shapes)
         return self

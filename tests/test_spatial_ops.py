@@ -1,0 +1,403 @@
+"""Spatial-warp / detection op family: forward vs numpy oracles mirroring
+the reference C++ kernels, backward vs finite differences.
+
+Reference kernels: src/operator/{grid_generator,bilinear_sampler,
+spatial_transformer,roi_pooling,correlation}.cc and
+src/operator/contrib/proposal.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState(11)
+
+
+# ------------------------------------------------------- numpy oracles
+
+def np_bilinear_sample(data, grid, border=False):
+    """bilinear_sampler.cc:16-67 — zero padding outside the boundary
+    (border=True: clamp sample coords to the image rectangle first,
+    the SpatialTransformer convention)."""
+    n, c, h, w = data.shape
+    _, _, oh, ow = grid.shape
+    out = np.zeros((n, c, oh, ow), np.float64)
+    for b in range(n):
+        for y in range(oh):
+            for x in range(ow):
+                xr = (grid[b, 0, y, x] + 1) * (w - 1) / 2.0
+                yr = (grid[b, 1, y, x] + 1) * (h - 1) / 2.0
+                if border:
+                    xr = min(max(xr, 0.0), w - 1.0)
+                    yr = min(max(yr, 0.0), h - 1.0)
+                tx, ty = int(np.floor(xr)), int(np.floor(yr))
+                wx, wy = 1.0 - (xr - tx), 1.0 - (yr - ty)
+                for dy, dx, wt in ((0, 0, wy * wx), (0, 1, wy * (1 - wx)),
+                                   (1, 0, (1 - wy) * wx),
+                                   (1, 1, (1 - wy) * (1 - wx))):
+                    yy, xx = ty + dy, tx + dx
+                    if 0 <= yy <= h - 1 and 0 <= xx <= w - 1:
+                        out[b, :, y, x] += data[b, :, yy, xx] * wt
+    return out
+
+
+def np_affine_grid(loc, th, tw):
+    """grid_generator-inl.h:73-108 affine branch."""
+    n = loc.shape[0]
+    theta = loc.reshape(n, 2, 3)
+    out = np.zeros((n, 2, th, tw), np.float64)
+    for y in range(th):
+        for x in range(tw):
+            xn = -1.0 + x * 2.0 / (tw - 1)
+            yn = -1.0 + y * 2.0 / (th - 1)
+            v = np.array([xn, yn, 1.0])
+            out[:, :, y, x] = theta @ v
+    return out
+
+
+def np_roi_pool(data, rois, ph, pw, scale):
+    """roi_pooling.cc ROIPoolForward:21-100."""
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+    out = np.zeros((r, c, ph, pw), np.float64)
+    for i in range(r):
+        bi = int(rois[i, 0])
+        sw = int(round(rois[i, 1] * scale))
+        sh = int(round(rois[i, 2] * scale))
+        ew = int(round(rois[i, 3] * scale))
+        eh = int(round(rois[i, 4] * scale))
+        rh, rw = max(eh - sh + 1, 1), max(ew - sw + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for p in range(ph):
+            for q in range(pw):
+                hs = min(max(int(np.floor(p * bh)) + sh, 0), h)
+                he = min(max(int(np.ceil((p + 1) * bh)) + sh, 0), h)
+                ws = min(max(int(np.floor(q * bw)) + sw, 0), w)
+                we = min(max(int(np.ceil((q + 1) * bw)) + sw, 0), w)
+                if he <= hs or we <= ws:
+                    out[i, :, p, q] = 0.0
+                else:
+                    out[i, :, p, q] = data[bi, :, hs:he, ws:we].max((1, 2))
+    return out
+
+
+def np_correlation(d1, d2, k, md, s1, s2, pad, mult):
+    """correlation.cc CorrelationForward:22-66."""
+    n, c, h, w = d1.shape
+    hp, wp = h + 2 * pad, w + 2 * pad
+    kr = (k - 1) // 2
+    border = md + kr
+    th = int(np.ceil((hp - 2 * border) / s1))
+    tw = int(np.ceil((wp - 2 * border) / s1))
+    ngr = md // s2
+    ngw = 2 * ngr + 1
+    p1 = np.zeros((n, c, hp, wp)); p1[:, :, pad:pad + h, pad:pad + w] = d1
+    p2 = np.zeros((n, c, hp, wp)); p2[:, :, pad:pad + h, pad:pad + w] = d2
+    out = np.zeros((n, ngw * ngw, th, tw), np.float64)
+    for i in range(th):
+        for j in range(tw):
+            x1, y1 = j * s1 + md, i * s1 + md
+            for tc in range(ngw * ngw):
+                s2o = (tc % ngw - ngr) * s2
+                s2p = (tc // ngw - ngr) * s2
+                acc = 0.0
+                for hh in range(k):
+                    for ww in range(k):
+                        a = p1[:, :, y1 + hh, x1 + ww]
+                        b = p2[:, :, y1 + s2p + hh, x1 + s2o + ww]
+                        acc = acc + ((a * b) if mult else np.abs(a - b)).sum(1)
+                out[:, tc, i, j] = acc / (k * k * c)
+    return out
+
+
+# ------------------------------------------------------------- forward
+
+def test_grid_generator_affine_forward():
+    loc = RNG.uniform(-1, 1, (2, 6)).astype("f")
+    out = mx.nd.GridGenerator(mx.nd.array(loc), transform_type="affine",
+                              target_shape=(4, 5)).asnumpy()
+    np.testing.assert_allclose(out, np_affine_grid(loc, 4, 5),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grid_generator_warp_forward():
+    flow = RNG.uniform(-1, 1, (2, 2, 3, 4)).astype("f")
+    out = mx.nd.GridGenerator(mx.nd.array(flow),
+                              transform_type="warp").asnumpy()
+    h, w = 3, 4
+    gx, gy = np.meshgrid(np.arange(w), np.arange(h))
+    exp = np.stack([(flow[:, 0] + gx) / ((w - 1) / 2.0) - 1,
+                    (flow[:, 1] + gy) / ((h - 1) / 2.0) - 1], 1)
+    np.testing.assert_allclose(out, exp, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sampler_forward():
+    data = RNG.uniform(-1, 1, (2, 3, 5, 6)).astype("f")
+    grid = RNG.uniform(-1.3, 1.3, (2, 2, 4, 4)).astype("f")  # incl. OOB
+    out = mx.nd.BilinearSampler(mx.nd.array(data), mx.nd.array(grid))
+    np.testing.assert_allclose(out.asnumpy(), np_bilinear_sample(data, grid),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_forward():
+    """ST == affine grid + border-clamped bilinear sample
+    (spatial_transformer.cc:9-53)."""
+    data = RNG.uniform(-1, 1, (2, 3, 6, 6)).astype("f")
+    loc = np.tile(np.array([0.9, 0.05, 0.0, -0.05, 0.9, 0.0], "f"), (2, 1))
+    loc += RNG.uniform(-0.02, 0.02, loc.shape).astype("f")
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(loc),
+                                   target_shape=(5, 5)).asnumpy()
+    grid = np_affine_grid(loc, 5, 5)
+    np.testing.assert_allclose(out, np_bilinear_sample(data, grid, border=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_out_of_bounds_clamps():
+    """A zoomed-out/translated affine that leaves [-1,1] samples border
+    values (clamped), not zeros."""
+    data = np.ones((1, 1, 4, 4), "f")
+    loc = np.array([[2.0, 0.0, 1.5, 0.0, 2.0, 1.5]], "f")  # far out of range
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(loc),
+                                   target_shape=(3, 3)).asnumpy()
+    np.testing.assert_allclose(out, np.ones((1, 1, 3, 3)), rtol=1e-6)
+    grid = np_affine_grid(loc, 3, 3)
+    np.testing.assert_allclose(
+        out, np_bilinear_sample(data, grid, border=True), rtol=1e-5)
+
+
+def test_roi_pooling_forward():
+    data = RNG.uniform(-1, 1, (2, 4, 8, 8)).astype("f")
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [0, 2, 2, 6, 6],
+                     [1, 1, 0, 5, 3],
+                     [1, 4, 4, 4, 4]], "f")    # last: 1x1 roi
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out, np_roi_pool(data, rois, 2, 2, 1.0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_roi_pooling_spatial_scale():
+    data = RNG.uniform(-1, 1, (1, 2, 6, 6)).astype("f")
+    rois = np.array([[0, 0, 0, 10, 10]], "f")
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(3, 3), spatial_scale=0.5).asnumpy()
+    np.testing.assert_allclose(out, np_roi_pool(data, rois, 3, 3, 0.5),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mult", [True, False])
+def test_correlation_forward(mult):
+    d1 = RNG.uniform(-1, 1, (2, 3, 7, 7)).astype("f")
+    d2 = RNG.uniform(-1, 1, (2, 3, 7, 7)).astype("f")
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2), kernel_size=3,
+                            max_displacement=2, stride1=1, stride2=1,
+                            pad_size=2, is_multiply=mult).asnumpy()
+    exp = np_correlation(d1, d2, 3, 2, 1, 1, 2, mult)
+    assert out.shape == exp.shape
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_strided_shape():
+    d1 = RNG.uniform(-1, 1, (1, 2, 10, 10)).astype("f")
+    d2 = RNG.uniform(-1, 1, (1, 2, 10, 10)).astype("f")
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2), kernel_size=1,
+                            max_displacement=2, stride1=2, stride2=2,
+                            pad_size=0).asnumpy()
+    exp = np_correlation(d1, d2, 1, 2, 2, 2, 0, True)
+    assert out.shape == exp.shape
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------- backward
+
+def test_bilinear_sampler_grad():
+    data = RNG.uniform(-1, 1, (1, 2, 4, 4))
+    grid = RNG.uniform(-0.8, 0.8, (1, 2, 3, 3))
+    # keep sampling points away from integer grid lines (floor() kinks)
+    grid = np.round(grid * 8) / 8 + 0.037
+    check_numeric_gradient("BilinearSampler", [data, grid], rtol=2e-2,
+                           atol=2e-3)
+
+
+def test_spatial_transformer_grad():
+    data = RNG.uniform(-1, 1, (1, 2, 5, 5))
+    loc = np.array([[0.63, 0.041, 0.037, -0.029, 0.57, 0.043]])
+    check_numeric_gradient("SpatialTransformer", [data, loc],
+                           attrs={"target_shape": (4, 4)}, rtol=2e-2,
+                           atol=2e-3)
+
+
+def test_grid_generator_grad():
+    loc = RNG.uniform(-1, 1, (2, 6))
+    check_numeric_gradient("GridGenerator", [loc],
+                           attrs={"transform_type": "affine",
+                                  "target_shape": (3, 4)})
+    flow = RNG.uniform(-1, 1, (1, 2, 3, 3))
+    check_numeric_gradient("GridGenerator", [flow],
+                           attrs={"transform_type": "warp"})
+
+
+def test_roi_pooling_grad():
+    data = RNG.uniform(-1, 1, (1, 2, 6, 6))
+    rois = np.array([[0, 0, 0, 5, 5], [0, 1, 1, 4, 4]], "f")
+
+    import mxnet_tpu.autograd as autograd
+    from mxnet_tpu import nd
+    a = nd.array(data.astype("f"))
+    r = nd.array(rois)
+    g = nd.zeros_like(a)
+    autograd.mark_variables([a], [g])
+    with autograd.record():
+        out = nd.ROIPooling(a, r, pooled_size=(2, 2), spatial_scale=1.0)
+        loss = out.sum()
+    autograd.backward([loss])
+    got = g.asnumpy()
+
+    # finite differences on data only (rois are index-only, zero grad)
+    from mxnet_tpu.test_utils import numeric_grad
+    def f(xs):
+        o = nd.ROIPooling(nd.array(xs[0].astype("f")), r, pooled_size=(2, 2),
+                          spatial_scale=1.0)
+        return float(o.asnumpy().sum())
+    exp = numeric_grad(f, [data.copy()])[0]
+    np.testing.assert_allclose(got, exp, rtol=2e-2, atol=2e-3)
+
+
+def test_correlation_grad():
+    d1 = RNG.uniform(-1, 1, (1, 2, 5, 5))
+    d2 = RNG.uniform(-1, 1, (1, 2, 5, 5))
+    check_numeric_gradient("Correlation", [d1, d2],
+                           attrs={"kernel_size": 1, "max_displacement": 1,
+                                  "stride1": 1, "stride2": 1, "pad_size": 1},
+                           rtol=2e-2, atol=2e-3)
+
+
+# ------------------------------------------------------------- proposal
+
+def np_proposal(cls_prob, bbox_pred, im_info, scales, ratios, stride,
+                pre_nms, post_nms, thresh, min_size):
+    """contrib/proposal.cc:252-420 oracle."""
+    A = cls_prob.shape[1] // 2
+    H, W = cls_prob.shape[2:]
+    base = stride - 1.0
+    anchors = []
+    w = h = base + 1.0
+    xc = yc = 0.5 * base
+    size = w * h
+    for ratio in ratios:
+        sr = np.floor(size / ratio)
+        for s in scales:
+            nw = np.floor(np.sqrt(sr) + 0.5) * s
+            nh = np.floor((nw / s * ratio) + 0.5) * s
+            anchors.append([xc - 0.5 * (nw - 1), yc - 0.5 * (nh - 1),
+                            xc + 0.5 * (nw - 1), yc + 0.5 * (nh - 1)])
+    anchors = np.array(anchors)
+    props = np.zeros((A * H * W, 5))
+    for i in range(A):
+        for j in range(H):
+            for k in range(W):
+                idx = j * W * A + k * A + i
+                props[idx, :4] = anchors[i] + np.array(
+                    [k * stride, j * stride, k * stride, j * stride])
+                props[idx, 4] = cls_prob[0, A + i, j, k]
+    im_h, im_w, im_scale = im_info[0]
+    real_h, real_w = int(im_h / stride), int(im_w / stride)
+    for i in range(A):
+        for j in range(H):
+            for k in range(W):
+                idx = j * W * A + k * A + i
+                x1, y1, x2, y2 = props[idx, :4]
+                dx, dy, dw, dh = bbox_pred[0, i * 4:(i + 1) * 4, j, k]
+                ww, hh = x2 - x1 + 1, y2 - y1 + 1
+                cx, cy = x1 + 0.5 * (ww - 1), y1 + 0.5 * (hh - 1)
+                pcx, pcy = dx * ww + cx, dy * hh + cy
+                pw, phh = np.exp(dw) * ww, np.exp(dh) * hh
+                box = [pcx - 0.5 * (pw - 1), pcy - 0.5 * (phh - 1),
+                       pcx + 0.5 * (pw - 1), pcy + 0.5 * (phh - 1)]
+                box[0] = min(max(box[0], 0), im_w - 1)
+                box[1] = min(max(box[1], 0), im_h - 1)
+                box[2] = min(max(box[2], 0), im_w - 1)
+                box[3] = min(max(box[3], 0), im_h - 1)
+                props[idx, :4] = box
+                if j >= real_h or k >= real_w:
+                    props[idx, 4] = -1.0
+    ms = min_size * im_scale
+    for i in range(len(props)):
+        iw = props[i, 2] - props[i, 0] + 1
+        ih = props[i, 3] - props[i, 1] + 1
+        if iw < ms or ih < ms:
+            props[i, 0] -= ms / 2; props[i, 1] -= ms / 2
+            props[i, 2] += ms / 2; props[i, 3] += ms / 2
+            props[i, 4] = -1.0
+    order = np.argsort(-props[:, 4], kind="stable")[:pre_nms]
+    dets = props[order]
+    # greedy nms
+    area = (dets[:, 2] - dets[:, 0] + 1) * (dets[:, 3] - dets[:, 1] + 1)
+    sup = np.zeros(len(dets), bool)
+    keep = []
+    for i in range(len(dets)):
+        if len(keep) >= post_nms:
+            break
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in range(i + 1, len(dets)):
+            if sup[j]:
+                continue
+            xx1 = max(dets[i, 0], dets[j, 0]); yy1 = max(dets[i, 1], dets[j, 1])
+            xx2 = min(dets[i, 2], dets[j, 2]); yy2 = min(dets[i, 3], dets[j, 3])
+            iw = max(0.0, xx2 - xx1 + 1); ih = max(0.0, yy2 - yy1 + 1)
+            inter = iw * ih
+            if inter / (area[i] + area[j] - inter) > thresh:
+                sup[j] = True
+    out = np.zeros((post_nms, 5))
+    for i in range(post_nms):
+        out[i, 1:] = dets[keep[i % len(keep)], :4]
+    return out
+
+
+def test_proposal_forward():
+    H, W = 4, 4
+    stride = 8
+    im_info = np.array([[H * stride, W * stride, 1.0]], "f")
+    kw = dict(scales=(2.0, 4.0), ratios=(0.5, 1.0, 2.0), feature_stride=stride,
+              rpn_pre_nms_top_n=30, rpn_post_nms_top_n=8, threshold=0.7,
+              rpn_min_size=4)
+    # num anchors A = len(scales) * len(ratios) = 6
+    cls_prob = RNG.uniform(0, 1, (1, 2 * 6, H, W)).astype("f")
+    bbox_pred = RNG.uniform(-0.2, 0.2, (1, 4 * 6, H, W)).astype("f")
+    out = mx.nd.Proposal(mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+                         mx.nd.array(im_info), **kw).asnumpy()
+    exp = np_proposal(cls_prob, bbox_pred, im_info, (2.0, 4.0),
+                      (0.5, 1.0, 2.0), stride, 30, 8, 0.7, 4)
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-3)
+
+
+def test_proposal_output_score():
+    A = 4
+    cls_prob = RNG.uniform(0, 1, (1, 2 * A, 3, 3)).astype("f")
+    bbox_pred = RNG.uniform(-0.1, 0.1, (1, 4 * A, 3, 3)).astype("f")
+    im_info = np.array([[48, 48, 1.0]], "f")
+    rois, score = mx.nd.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        scales=(4.0, 8.0), ratios=(0.5, 1.0), feature_stride=16,
+        rpn_pre_nms_top_n=20, rpn_post_nms_top_n=6, rpn_min_size=2,
+        output_score=True)
+    assert rois.shape == (6, 5) and score.shape == (6, 1)
+    assert (rois.asnumpy()[:, 0] == 0).all()
+
+
+def test_spatial_ops_symbolic():
+    """The new family also works through the symbolic executor."""
+    data = mx.sym.Variable("data")
+    loc = mx.sym.Variable("loc")
+    st = mx.sym.SpatialTransformer(data, loc, target_shape=(4, 4))
+    arg_shapes, out_shapes, _ = st.infer_shape(data=(2, 3, 6, 6), loc=(2, 6))
+    assert out_shapes[0] == (2, 3, 4, 4)
+    ex = st.bind(mx.cpu(), {"data": mx.nd.ones((2, 3, 6, 6)),
+                            "loc": mx.nd.array(
+                                np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype("f"))})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.ones((2, 3, 4, 4)), rtol=1e-5)
