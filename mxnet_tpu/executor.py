@@ -172,6 +172,22 @@ class Executor:
     def _multi_device_placed(self):
         return len(set(self._device_map.values())) > 1
 
+    @staticmethod
+    def _maybe_mirror(f):
+        """MXNET_BACKWARD_DO_MIRROR=1 -> rematerialized backward
+        (reference graph_executor.cc:218-231 mirroring): wrap the traced
+        forward in jax.checkpoint saving only MXU-op outputs (tagged
+        "mxu_out" in ops/nn.py), so BN statistics, activations and other
+        elementwise intermediates are recomputed in the backward pass
+        instead of living in HBM across it — the 30-50% activation-memory
+        trade the reference documents (docs/how_to/env_var.md:64-66)."""
+        from . import config
+        if not config.get_bool("MXNET_BACKWARD_DO_MIRROR"):
+            return f
+        import jax
+        policy = jax.checkpoint_policies.save_only_these_names("mxu_out")
+        return jax.checkpoint(f, policy=policy)
+
     def _get_backward_fn(self, with_head_grads):
         key_ = with_head_grads
         fn = self._bwd_cache.get(key_)
@@ -200,7 +216,7 @@ class Executor:
                                          device_map=self._device_map)
                 return heads
 
-            heads, vjp = jax.vjp(f, diff_vals)
+            heads, vjp = jax.vjp(self._maybe_mirror(f), diff_vals)
             if with_head_grads:
                 cot = list(out_grads)
             else:
@@ -245,7 +261,8 @@ class Executor:
                                             device_map=self._device_map)
                 return heads, aux_upd
 
-            heads, vjp, aux_upd = jax.vjp(f, diff_vals, has_aux=True)
+            heads, vjp, aux_upd = jax.vjp(self._maybe_mirror(f), diff_vals,
+                                          has_aux=True)
             cot = [jnp.ones_like(h) if il else jnp.zeros_like(h)
                    for h, il in zip(heads, head_is_loss)]
             (grads,) = vjp(list(cot))

@@ -81,6 +81,15 @@ NHWC_UNAWARE_OPS = frozenset({
 })
 
 
+def _mxu_out(y):
+    """Name MXU-op outputs for the remat policy: under
+    MXNET_BACKWARD_DO_MIRROR the backward pass saves exactly these and
+    recomputes everything else (BN/activation), the reference's mirroring
+    split (graph_executor.cc:218-231).  Identity outside jax.checkpoint."""
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(y, "mxu_out")
+
+
 # --------------------------------------------------------------------- dense
 @register("FullyConnected", arg_names=lambda a: ("data", "weight") if a["no_bias"]
           else ("data", "weight", "bias"),
@@ -97,7 +106,7 @@ def fully_connected(attrs, ctx, data, weight, bias=None):
     y = jnp.dot(x, weight.T)
     if bias is not None:
         y = y + bias
-    return y.astype(data.dtype)
+    return _mxu_out(y.astype(data.dtype))
 
 
 # ---------------------------------------------------------------------- conv
@@ -139,7 +148,7 @@ def convolution(attrs, ctx, data, weight, bias=None):
             feature_group_count=int(attrs["num_group"]))
         if bias is not None:
             y = y + bias
-        return y.astype(data.dtype)
+        return _mxu_out(y.astype(data.dtype))
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if nd == 2 else
@@ -150,7 +159,7 @@ def convolution(attrs, ctx, data, weight, bias=None):
         dimension_numbers=dn, feature_group_count=int(attrs["num_group"]))
     if bias is not None:
         y = y + bias.reshape((1, -1) + (1,) * nd)
-    return y.astype(data.dtype)
+    return _mxu_out(y.astype(data.dtype))
 
 
 @register("Deconvolution", arg_names=lambda a: ("data", "weight") if a["no_bias"]

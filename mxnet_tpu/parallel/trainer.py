@@ -390,7 +390,9 @@ class ShardedTrainer:
                                                 batch_size=bsz)
                 return heads, aux_upd
 
-            heads, vjp, aux_upd = jax.vjp(fwd, params, has_aux=True)
+            from ..executor import Executor
+            heads, vjp, aux_upd = jax.vjp(Executor._maybe_mirror(fwd),
+                                          params, has_aux=True)
             cot = [jnp.ones_like(h) if il else jnp.zeros_like(h)
                    for h, il in zip(heads, head_is_loss)]
             (grads,) = vjp(list(cot))
