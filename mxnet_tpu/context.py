@@ -71,12 +71,17 @@ class Context:
         written for ``mx.gpu()`` runs on a TPU chip); ``cpu`` -> host device.
         """
         import jax
+        # local_devices: in a multi-process job (dist kvstore) the global
+        # enumeration starts with process 0's devices, which other ranks
+        # cannot address — a context always means a device THIS host owns
+        # (reference: Context device ids are per-node)
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = (jax.local_devices(backend="cpu") if _has_platform("cpu")
+                    else jax.local_devices())
         else:
             devs = _accelerators()
             if not devs:  # CPU-only host: impersonate devices (SURVEY §4.2)
-                devs = jax.devices()
+                devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 f"context {self} out of range: only {len(devs)} device(s) available")
@@ -101,9 +106,9 @@ def _has_platform(name):
 
 
 def _accelerators():
-    """All non-host-cpu jax devices, in enumeration order."""
+    """This host's non-cpu jax devices, in enumeration order."""
     import jax
-    return [d for d in jax.devices() if d.platform != "cpu"] or []
+    return [d for d in jax.local_devices() if d.platform != "cpu"] or []
 
 
 def cpu(device_id=0):

@@ -174,19 +174,10 @@ class Executor:
 
     @staticmethod
     def _maybe_mirror(f):
-        """MXNET_BACKWARD_DO_MIRROR=1 -> rematerialized backward
-        (reference graph_executor.cc:218-231 mirroring): wrap the traced
-        forward in jax.checkpoint saving only MXU-op outputs (tagged
-        "mxu_out" in ops/nn.py), so BN statistics, activations and other
-        elementwise intermediates are recomputed in the backward pass
-        instead of living in HBM across it — the 30-50% activation-memory
-        trade the reference documents (docs/how_to/env_var.md:64-66)."""
-        from . import config
-        if not config.get_bool("MXNET_BACKWARD_DO_MIRROR"):
-            return f
-        import jax
-        policy = jax.checkpoint_policies.save_only_these_names("mxu_out")
-        return jax.checkpoint(f, policy=policy)
+        """See :func:`mxnet_tpu.ops.nn.maybe_mirror` (kept as a
+        late-binding hook so tests can assert the wiring)."""
+        from .ops import nn as _nn
+        return _nn.maybe_mirror(f)
 
     def _get_backward_fn(self, with_head_grads):
         key_ = with_head_grads

@@ -95,11 +95,14 @@ class NDArray:
     @property
     def context(self):
         dev = next(iter(self.data.devices()))
-        if dev.platform == "cpu":
-            return Context("cpu", dev.id)
-        # single accelerator platform: report as tpu (gpu alias resolves there)
         import jax
-        accels = [d for d in jax.devices() if d.platform != "cpu"]
+        # report the LOCAL index (multi-process global device ids are not
+        # valid per-node context ids; reference ctx ids are per-node)
+        if dev.platform == "cpu":
+            local = jax.local_devices(backend="cpu")
+            return Context("cpu", local.index(dev) if dev in local else dev.id)
+        # single accelerator platform: report as tpu (gpu alias resolves there)
+        accels = [d for d in jax.local_devices() if d.platform != "cpu"]
         idx = accels.index(dev) if dev in accels else dev.id
         return Context("tpu", idx)
 

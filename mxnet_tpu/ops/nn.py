@@ -90,6 +90,23 @@ def _mxu_out(y):
     return checkpoint_name(y, "mxu_out")
 
 
+def maybe_mirror(f):
+    """MXNET_BACKWARD_DO_MIRROR=1 -> rematerialized backward (reference
+    graph_executor.cc:218-231 mirroring): wrap a traced forward in
+    jax.checkpoint saving only the MXU-op outputs tagged by
+    :func:`_mxu_out`, so BN statistics, activations and other elementwise
+    intermediates are recomputed in the backward pass instead of living
+    in HBM across it — the 30-50% activation-memory trade the reference
+    documents (docs/how_to/env_var.md:64-66; measurements: docs/perf.md).
+    Used by the executor backward/fused paths and ShardedTrainer."""
+    from .. import config
+    if not config.get_bool("MXNET_BACKWARD_DO_MIRROR"):
+        return f
+    import jax
+    policy = jax.checkpoint_policies.save_only_these_names("mxu_out")
+    return jax.checkpoint(f, policy=policy)
+
+
 # --------------------------------------------------------------------- dense
 @register("FullyConnected", arg_names=lambda a: ("data", "weight") if a["no_bias"]
           else ("data", "weight", "bias"),

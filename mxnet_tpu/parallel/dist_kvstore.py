@@ -1,21 +1,27 @@
-"""Distributed KVStore facade over JAX multi-host collectives.
+"""Distributed KVStore over JAX multi-host collectives.
 
 Reference: ``src/kvstore/kvstore_dist.h`` + ``kvstore_dist_server.h`` —
 worker push/pull against parameter servers with sync aggregation over
-exactly ``ps::NumWorkers()`` pushes.  TPU-native design (SURVEY §5.8): no
-servers exist; ``dist_sync`` push = a global psum over all hosts' gradients
-via a jitted sum on a process-spanning mesh (DCN/ICI collectives), followed
-by the local updater.  ``dist_async`` has no TPU analogue (collectives are
-globally synchronous); we map it to sync semantics and warn — see
-SURVEY §7.7 for the descoping rationale.
+exactly ``ps::NumWorkers()`` pushes, big keys sharded across servers
+(`kvstore_dist.h:273-314`).  TPU-native design (SURVEY §5.8): no servers
+exist; a ``dist_sync`` push is ONE jitted XLA program on a
+process-spanning mesh that sums the whole gradient pytree across hosts
+(AllReduce over DCN/ICI), replicating the result to every host — the
+collective replaces the server shard fan-in/fan-out, and batching all
+keys into one program replaces the reference's per-key zmq round trips.
+``dist_async`` has no TPU analogue (collectives are globally
+synchronous); it maps to sync semantics with a warning — SURVEY §7.7.
 
 Bootstrap: ``jax.distributed.initialize`` replaces the ``DMLC_*`` env
-bootstrap (`kvstore.h:162` InitPSEnv); ``tools/launch.py`` sets the
-coordinator env vars.
+bootstrap (`kvstore.h:162` InitPSEnv).  ``tools/launch.py`` sets
+MXNET_TPU_{COORDINATOR,NUM_PROCESSES,PROCESS_ID}; creating a dist store
+under that env joins the job automatically.
 """
 from __future__ import annotations
 
 import logging
+
+import numpy as np
 
 from ..base import MXNetError
 from ..kvstore import KVStore
@@ -29,13 +35,33 @@ class DistKVStore(KVStore):
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
         import jax
+        from .. import config
         if "async" in kv_type:
             logging.warning(
                 "dist_async has no TPU analogue (collectives are globally "
                 "synchronous); using dist_sync semantics.")
+        nproc = config.get_int("MXNET_TPU_NUM_PROCESSES")
+        # NB: probe distributed state, not jax.process_count() — the
+        # latter initializes the XLA backend, after which joining the
+        # job is impossible
+        if nproc and nproc > 1 and not jax.distributed.is_initialized():
+            # launched by tools/launch.py: join the job now
+            coordinator = config.get("MXNET_TPU_COORDINATOR")
+            if not coordinator:
+                # a silent localhost default would make every rank wait on
+                # its own unbound port — fail fast instead
+                raise MXNetError(
+                    "MXNET_TPU_NUM_PROCESSES=%d but MXNET_TPU_COORDINATOR "
+                    "is unset; launch via tools/launch.py or export the "
+                    "coordinator address" % nproc)
+            self.init_env(
+                coordinator_address=coordinator,
+                num_processes=nproc,
+                process_id=config.get_int("MXNET_TPU_PROCESS_ID", 0))
         self._num_workers = jax.process_count()
         self._rank = jax.process_index()
-        self._psum_fn = None
+        self._mesh = None
+        self._reduce_fn = None
 
     @property
     def rank(self):
@@ -45,36 +71,82 @@ class DistKVStore(KVStore):
     def num_workers(self):
         return self._num_workers
 
-    def _global_sum(self, arr):
-        """Sum an array over all processes (DCN collective)."""
-        import jax
-        if self._num_workers == 1:
-            return arr
-        import jax.numpy as jnp
-        from jax.experimental.multihost_utils import (
-            process_allgather)
-        # all-gather over hosts then sum: one DCN collective per push.
-        gathered = process_allgather(arr.data if hasattr(arr, "data")
-                                     else arr)
-        return jnp.sum(gathered, axis=0)
+    # ------------------------------------------------------------ collective
+    def _host_mesh(self):
+        """1-D mesh with one device per process.  The kvstore reduce rides
+        the inter-host fabric; intra-host model/data parallelism belongs
+        to ``parallel.ShardedTrainer``'s own mesh."""
+        if self._mesh is None:
+            import jax
+            devs = []
+            for p in range(self._num_workers):
+                devs.append(next(d for d in jax.devices()
+                                 if d.process_index == p))
+            self._mesh = jax.sharding.Mesh(np.array(devs), ("hosts",))
+        return self._mesh
 
+    def allreduce(self, tree):
+        """Sum a pytree of per-host numpy/jax arrays across all hosts in
+        ONE jitted program; every leaf comes back replicated on every
+        host.  The TPU-native replacement for the reference's per-key
+        server push/pull (kvstore_dist.h:99-161)."""
+        if self._num_workers == 1:
+            return tree
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._host_mesh()
+        ins = NamedSharding(mesh, PartitionSpec("hosts"))
+        outs = NamedSharding(mesh, PartitionSpec())
+
+        def lift(x):
+            # jax arrays stay on device; host arrays upload once
+            local = x[None] if isinstance(x, jax.Array) \
+                else np.asarray(x)[None]
+            return jax.make_array_from_process_local_data(ins, local)
+
+        global_tree = jax.tree.map(lift, tree)
+        if self._reduce_fn is None:
+            self._reduce_fn = jax.jit(
+                lambda t: jax.tree.map(lambda g: g.sum(axis=0), t),
+                out_shardings=outs)
+        return self._reduce_fn(global_tree)
+
+    def _global_sum(self, arr):
+        """Sum one array over all processes (kept for callers of the
+        round-1 API; new code should batch keys via :meth:`allreduce`)."""
+        out = self.allreduce([arr.asnumpy() if hasattr(arr, "asnumpy")
+                              else np.asarray(arr)])
+        return out[0]
+
+    # ------------------------------------------------------------------ api
     def push(self, key, value, priority=0):
+        """Aggregate local replicas, AllReduce every key across hosts in
+        one program, then apply the updater — the reference's
+        sync-aggregation contract (kvstore_dist_server.h:164-199: update
+        runs once after exactly num_workers pushes)."""
         from ..kvstore import _ctype_key_value, _group_kv_pairs
         from ..ndarray import NDArray
         keys, vals = _ctype_key_value(key, value)
         uniq, grouped = _group_kv_pairs(keys, vals)
+        merged = {}
         for k, group in zip(uniq, grouped):
-            merged = group[0].copy()
+            m = group[0].copy()
             for other in group[1:]:
-                merged += other
-            if self._num_workers > 1:
-                merged = NDArray(self._global_sum(merged))
+                m += other
+            merged[k] = m
+        if self._num_workers > 1:
+            summed = self.allreduce({k: m.data for k, m in merged.items()})
+            # addressable_data(0) is this host's replica of the reduced
+            # value — no host round trip
+            merged = {k: NDArray(v.addressable_data(0))
+                      for k, v in summed.items()}
+        for k, m in merged.items():
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("key %s has not been inited" % str(k))
-                self._updater(k, merged, self._store[k])
+                self._updater(k, m, self._store[k])
             else:
-                self._store[k] = merged
+                self._store[k] = m
 
     def barrier(self):
         if self._num_workers > 1:

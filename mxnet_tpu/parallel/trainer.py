@@ -390,8 +390,8 @@ class ShardedTrainer:
                                                 batch_size=bsz)
                 return heads, aux_upd
 
-            from ..executor import Executor
-            heads, vjp, aux_upd = jax.vjp(Executor._maybe_mirror(fwd),
+            from ..ops.nn import maybe_mirror
+            heads, vjp, aux_upd = jax.vjp(maybe_mirror(fwd),
                                           params, has_aux=True)
             cot = [jnp.ones_like(h) if il else jnp.zeros_like(h)
                    for h, il in zip(heads, head_is_loss)]

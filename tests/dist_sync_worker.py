@@ -1,0 +1,61 @@
+"""Worker script for the multi-process dist_sync test.
+
+Run under the launcher (reference tests/nightly/dist_sync_kvstore.py:1-47
+semantics, executed via tools/launch.py --launcher local):
+
+    python tools/launch.py -n 4 python tests/dist_sync_worker.py
+
+Each worker pushes rank-dependent values; the deterministic global sums
+must come back identical on every worker.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def check_diff_to_scalar(a, x):
+    assert np.sum(np.abs(a.asnumpy() - x)) == 0, (a.asnumpy(), x)
+
+
+def main():
+    keys = [3, 5, 7]
+    rate = 2
+    shape = (2, 2)
+    big_shape = (120, 120)
+
+    kv = mx.kv.create("dist_sync")
+    nworker = kv.num_workers
+    my_rank = kv.rank
+    assert nworker == int(os.environ["MXNET_TPU_NUM_PROCESSES"])
+
+    kv.init(keys, [mx.nd.ones(shape)] * len(keys))
+    kv.init(99, mx.nd.ones(big_shape))
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=rate))
+
+    nrepeat = 3
+    for _ in range(nrepeat):
+        # one push carrying two keys: must ride a single jitted reduce
+        kv.push([3, 99], [mx.nd.ones(shape) * (my_rank + 1),
+                          mx.nd.ones(big_shape) * (my_rank + 1)])
+
+    num = (nworker + 1) * nworker * rate / 2 * nrepeat + 1
+    val = mx.nd.zeros(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, num)
+
+    val2 = mx.nd.zeros(big_shape)
+    kv.pull(99, out=val2)
+    check_diff_to_scalar(val2, num)
+
+    kv.barrier()
+    print("worker %d/%d OK" % (my_rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Multi-process dist_sync semantics without a cluster.
+
+Reference: tests/nightly/dist_sync_kvstore.py run under
+``tools/launch.py --launcher local`` (dmlc_tracker local mode) — the
+reference's way of proving multi-node sync semantics on one machine.
+Here 4 CPU processes join one jax.distributed job and the jitted pytree
+AllReduce must produce identical deterministic sums on every worker.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(300)
+def test_dist_sync_4_workers():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # one device per process: drop the conftest's 8-device virtual flag
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_NUM_PROCESSES", None)
+    env.pop("MXNET_TPU_PROCESS_ID", None)
+    # TPU-tunnel site plugins (axon) break CPU multi-process coordination;
+    # the workers are CPU-only, so scrub them from the interpreter path
+    if "PYTHONPATH" in env:
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if "axon" not in p]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            env.pop("PYTHONPATH")
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "4", "--launcher", "local",
+           "--coordinator", "127.0.0.1:%d" % _free_port(),
+           sys.executable, os.path.join(ROOT, "tests", "dist_sync_worker.py")]
+    res = subprocess.run(cmd, capture_output=True, text=True, timeout=280,
+                         cwd=ROOT, env=env)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    for rank in range(4):
+        assert "worker %d/4 OK" % rank in out, out

@@ -61,6 +61,26 @@ def _run_grads(mirror):
             os.environ["MXNET_BACKWARD_DO_MIRROR"] = old
 
 
+def test_mirror_wiring_applies_remat(monkeypatch):
+    """The env var must actually swap in a jax.checkpoint trace — a
+    regression that makes the flag a no-op fails here, not silently."""
+    import jax
+    from mxnet_tpu.ops.nn import maybe_mirror
+    from mxnet_tpu.executor import Executor
+
+    f = lambda x: x * 2.0  # noqa: E731
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "0")
+    assert maybe_mirror(f) is f
+    assert Executor._maybe_mirror(f) is f
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    wrapped = Executor._maybe_mirror(f)
+    assert wrapped is not f
+    # the wrapped trace is a remat call — visible in the jaxpr
+    jaxpr = jax.make_jaxpr(lambda x: jax.grad(lambda y: wrapped(y).sum())(x))(
+        jax.numpy.ones((2,)))
+    assert "remat" in str(jaxpr)
+
+
 def test_mirror_grads_identical():
     g0 = _run_grads(mirror=False)
     g1 = _run_grads(mirror=True)
