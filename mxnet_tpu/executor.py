@@ -162,15 +162,35 @@ class Executor:
                        for i, n in enumerate(self._aux_nodes)]
             return heads, aux_out
 
-        # a multi-device placed graph must run eagerly: jit would collapse
-        # per-node device_put placements onto one device (the reference
-        # runs per-node engine pushes anyway; XLA async dispatch overlaps)
-        fn = raw if self._multi_device_placed() else jax.jit(raw)
+        fn = self._compile(raw)
         self._fwd_cache[is_train] = fn
         return fn
 
     def _multi_device_placed(self):
         return len(set(self._device_map.values())) > 1
+
+    def _compile(self, raw):
+        """One XLA program even for a ctx_group-placed graph: the per-node
+        jax.device_put calls inside eval_graph become sharding constraints
+        under jit, and the GSPMD partitioner pins each segment to its
+        device with cross-device copies at the boundaries — the compiled
+        equivalent of the reference's PlaceDevice + _CrossDeviceCopy pass
+        (graph_executor.cc:249-341), with fusion and donation intact."""
+        import jax
+        return jax.jit(raw)
+
+    def _place_heads(self, heads):
+        """Reference parity: a head produced by a ctx_group-placed node
+        lives on that group's device.  jit returns outputs on the default
+        device, so placed heads take one device-to-device copy here."""
+        if not self._multi_device_placed():
+            return heads
+        import jax
+        placed = []
+        for h, (node, _i) in zip(heads, self._symbol._entries):
+            dev = self._device_map.get(id(node))
+            placed.append(jax.device_put(h, dev) if dev is not None else h)
+        return placed
 
     @staticmethod
     def _maybe_mirror(f):
@@ -216,7 +236,7 @@ class Executor:
             (grads,) = vjp(list(cot))
             return grads
 
-        fn = raw if self._multi_device_placed() else jax.jit(raw)
+        fn = self._compile(raw)
         self._bwd_cache[key_] = fn
         return fn
 
@@ -261,7 +281,7 @@ class Executor:
                        for i, n in enumerate(self._aux_nodes)]
             return heads, aux_out, grads
 
-        fn = raw if self._multi_device_placed() else jax.jit(raw)
+        fn = self._compile(raw)
         self._bwd_cache["fused"] = fn
         return fn
 
@@ -292,7 +312,7 @@ class Executor:
                 tgt._set_data(tgt.data + g)
             else:
                 tgt._set_data(g.astype(tgt.dtype))
-        self._outputs = [NDArray(h) for h in heads]
+        self._outputs = [NDArray(h) for h in self._place_heads(heads)]
         return self._outputs
 
     # ---------------------------------------------------------------- run
@@ -327,7 +347,7 @@ class Executor:
         if is_train:
             for n, upd in zip(self._aux_names, aux_out):
                 self.aux_dict[n]._set_data(upd)
-        self._outputs = [NDArray(h) for h in heads]
+        self._outputs = [NDArray(h) for h in self._place_heads(heads)]
         return self._outputs
 
     def _forward_monitored(self, is_train, key):

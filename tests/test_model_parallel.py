@@ -55,6 +55,79 @@ def test_multi_device_exec_forward_backward():
                                    err_msg=n)
 
 
+def test_multi_device_path_is_compiled():
+    """The ctx_group path must run as ONE jitted XLA program, not eager
+    per-node dispatch (round-1 weakness: executor skipped jit whenever
+    the device map spanned >1 device)."""
+    import jax
+    net = build_net()
+    ex = net.simple_bind(mx.cpu(0), grad_req="write", data=(4, 10),
+                         group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    fn = ex._get_forward_fn(True)
+    assert isinstance(fn, jax.stages.Wrapped), type(fn)
+    fused = ex._get_fused_fn()
+    assert isinstance(fused, jax.stages.Wrapped), type(fused)
+
+
+def test_model_parallel_lstm_speed_within_3x():
+    """Model-parallel LSTM throughput within 3x of single-device
+    (reference example/model-parallel-lstm/lstm.py:142-205 runs layers on
+    different GPUs at comparable speed; compiled placement must not fall
+    back to eager)."""
+    import time
+    rng = np.random.RandomState(0)
+    seq_len, nhid, batch = 8, 64, 16
+
+    def lstm_net(groups):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        slices = mx.sym.SliceChannel(data, num_outputs=seq_len, axis=1,
+                                     squeeze_axis=True)
+        layers = [(mx.rnn.LSTMCell(nhid, prefix="l%d_" % i), grp)
+                  for i, grp in enumerate(groups)]
+        outputs = list(slices)
+        for cell, grp in layers:
+            with mx.AttrScope(ctx_group=grp) if grp else _null_ctx():
+                outputs, _ = cell.unroll(seq_len, inputs=outputs,
+                                         merge_outputs=False)
+        concat = mx.sym.Concat(*outputs, dim=1)
+        fc = mx.sym.FullyConnected(mx.sym.Flatten(concat), num_hidden=4,
+                                   name="fc")
+        return mx.sym.SoftmaxOutput(fc, label, name="softmax")
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _null_ctx():
+        yield
+
+    x = rng.uniform(-1, 1, (batch, seq_len, nhid)).astype(np.float32)
+    y = rng.randint(0, 4, batch).astype(np.float32)
+
+    def bench(groups, group2ctx):
+        net = lstm_net(groups)
+        ex = net.simple_bind(mx.cpu(0), grad_req="write",
+                             data=(batch, seq_len, nhid),
+                             softmax_label=(batch,), group2ctx=group2ctx)
+        for n, arr in ex.arg_dict.items():
+            if n not in ("data", "softmax_label"):
+                arr[:] = rng.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+        ex.forward_backward(data=mx.nd.array(x),
+                            softmax_label=mx.nd.array(y))  # compile
+        ex.outputs[0].wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(5):
+            ex.forward_backward(data=mx.nd.array(x),
+                                softmax_label=mx.nd.array(y))
+        ex.outputs[0].wait_to_read()
+        return (time.perf_counter() - t0) / 5
+
+    t_single = bench([None, None], None)
+    t_mp = bench(["dev1", "dev2"],
+                 {"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    assert t_mp < 3.0 * t_single + 0.05, (t_mp, t_single)
+
+
 def test_placement_actually_crosses_devices():
     """Outputs of dev2-group ops land on the dev2 jax device."""
     import jax
