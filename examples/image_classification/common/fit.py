@@ -56,6 +56,9 @@ def add_fit_args(parser):
                             "model-prefix")
     train.add_argument("--top-k", type=int, default=0,
                        help="report the top-k accuracy. 0 means no report.")
+    train.add_argument("--data-nthreads", type=int, default=4,
+                       help="number of native decode threads "
+                            "(reference --data-nthreads)")
     train.add_argument("--test-io", type=int, default=0,
                        help="1 means test reading speed without training")
     train.add_argument("--monitor", dest="monitor", type=int, default=0,

@@ -30,6 +30,28 @@ def get_rec_iter(args, kv):
         y = rng.randint(0, args.num_classes, n).astype(np.float32)
         train = mx.io.NDArrayIter(x, y, args.batch_size)
         return train, None
+    from mxnet_tpu import config, io_native
+    # native pipeline (reference ImageRecordIter / ImageRecordIOParser2):
+    # C++ reader + N JPEG decode threads, no per-image Python cost.
+    # Needs cores to beat the in-process PIL path (docs/perf.md) — let
+    # MXNET_USE_NATIVE_REC=0/1 override the auto choice.
+    use_native = config.get_bool(
+        "MXNET_USE_NATIVE_REC",
+        io_native.jpeg_available() and (os.cpu_count() or 1) >= 2)
+    if use_native and io_native.jpeg_available():
+        train = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=True,
+            rand_crop=True, rand_mirror=True,
+            num_parts=kv.num_workers, part_index=kv.rank,
+            preprocess_threads=args.data_nthreads)
+        val = None
+        if args.data_val:
+            val = mx.io.ImageRecordIter(
+                path_imgrec=args.data_val, data_shape=image_shape,
+                batch_size=args.batch_size,
+                preprocess_threads=args.data_nthreads)
+        return train, val
     train = mx.image.ImageIter(
         batch_size=args.batch_size, data_shape=image_shape,
         path_imgrec=args.data_train, path_imgidx=args.data_train_idx or None,

@@ -56,6 +56,9 @@ _CATALOG = {
     "MXNET_TPU_PROCESS_ID": ("0", "honored", ""),
     "MXNET_TPU_COORDINATOR": ("", "honored",
                               "jax.distributed coordinator address"),
+    "MXNET_USE_NATIVE_REC": ("", "honored",
+                             "force (1) or disable (0) the native JPEG "
+                             "record pipeline in the examples"),
 }
 
 

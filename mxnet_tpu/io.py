@@ -473,3 +473,13 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+
+def ImageRecordIter(*args, **kwargs):
+    """Native JPEG record iterator (reference io.ImageRecordIter,
+    src/io/iter_image_recordio_2.cc) — see
+    :class:`mxnet_tpu.io_native.ImageRecordIter`.  Requires the native
+    library built with libjpeg; use :class:`mxnet_tpu.image.ImageIter`
+    as the pure-python fallback."""
+    from .io_native import ImageRecordIter as _Native
+    return _Native(*args, **kwargs)

@@ -78,22 +78,43 @@ class MXRecordIO:
         assert not self.writable
         self.fid.seek(pos)
 
-    def write(self, buf):
-        """Write one framed record."""
-        assert self.writable
-        lrec = len(buf) & _LENGTH_MASK
+    def _write_part(self, part, cflag):
+        lrec = (len(part) & _LENGTH_MASK) | (cflag << 29)
         self.fid.write(struct.pack("<II", _MAGIC, lrec))
-        self.fid.write(buf)
-        pad = (4 - (len(buf) % 4)) % 4
+        self.fid.write(part)
+        pad = (4 - (len(part) % 4)) % 4
         if pad:
             self.fid.write(b"\x00" * pad)
 
-    def read(self):
-        """Read the next record, or None at EOF."""
-        assert not self.writable
+    def write(self, buf):
+        """Write one framed record.
+
+        dmlc recordio semantics: the payload is split at 4-aligned
+        occurrences of the magic word (the occurrence is dropped and
+        re-inserted by the reader), with continuation flags 1/2/3 in the
+        upper bits of lrec — so payloads containing the magic (JPEG bytes
+        can) stay seekable and round-trip with the reference reader.
+        """
+        assert self.writable
+        magic_bytes = struct.pack("<I", _MAGIC)
+        parts = []
+        start = 0
+        for pos in range(0, len(buf) - 3, 4):
+            if buf[pos:pos + 4] == magic_bytes:
+                parts.append(buf[start:pos])
+                start = pos + 4
+        parts.append(buf[start:])
+        if len(parts) == 1:
+            self._write_part(buf, 0)
+            return
+        for i, part in enumerate(parts):
+            cflag = 1 if i == 0 else (3 if i == len(parts) - 1 else 2)
+            self._write_part(part, cflag)
+
+    def _read_part(self):
         header = self.fid.read(8)
         if len(header) < 8:
-            return None
+            return None, None
         magic, lrec = struct.unpack("<II", header)
         if magic != _MAGIC:
             raise IOError("Invalid magic number in %s" % self.uri)
@@ -102,7 +123,30 @@ class MXRecordIO:
         pad = (4 - (length % 4)) % 4
         if pad:
             self.fid.read(pad)
-        return buf
+        return lrec >> 29, buf
+
+    def read(self):
+        """Read the next record, or None at EOF (re-joins continuation
+        parts with the magic word re-inserted)."""
+        assert not self.writable
+        cflag, buf = self._read_part()
+        if buf is None:
+            return None
+        if cflag == 0:
+            return buf
+        if cflag != 1:
+            raise IOError("continuation part without start in %s" % self.uri)
+        magic_bytes = struct.pack("<I", _MAGIC)
+        parts = [buf]
+        while True:
+            cflag, part = self._read_part()
+            if part is None or cflag not in (2, 3):
+                raise IOError("truncated continuation record in %s"
+                              % self.uri)
+            parts.append(magic_bytes)
+            parts.append(part)
+            if cflag == 3:
+                return b"".join(parts)
 
 
 class MXIndexedRecordIO(MXRecordIO):

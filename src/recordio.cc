@@ -20,10 +20,9 @@
 #include <thread>
 #include <vector>
 
-namespace {
+#include "recordio_format.h"
 
-constexpr uint32_t kMagic = 0xced7230a;
-constexpr uint32_t kLengthMask = (1u << 29) - 1;
+namespace {
 
 struct Record {
   std::vector<uint8_t> data;
@@ -116,19 +115,9 @@ class RecordIOReader {
 
  private:
   void Run() {
-    std::vector<uint8_t> header(8);
     while (true) {
-      if (std::fread(header.data(), 1, 8, f_) != 8) break;
-      uint32_t magic, lrec;
-      std::memcpy(&magic, header.data(), 4);
-      std::memcpy(&lrec, header.data() + 4, 4);
-      if (magic != kMagic) break;
-      uint32_t len = lrec & kLengthMask;
       Record r;
-      r.data.resize(len);
-      if (len && std::fread(r.data.data(), 1, len, f_) != len) break;
-      uint32_t pad = (4 - (len % 4)) % 4;
-      if (pad) std::fseek(f_, pad, SEEK_CUR);
+      if (!mxtpu::ReadRecRecord(f_, &r.data)) break;
       queue_.Push(std::move(r));
     }
     queue_.Finish();

