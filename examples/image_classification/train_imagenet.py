@@ -35,9 +35,14 @@ def get_rec_iter(args, kv):
     # C++ reader + N JPEG decode threads, no per-image Python cost.
     # Needs cores to beat the in-process PIL path (docs/perf.md) — let
     # MXNET_USE_NATIVE_REC=0/1 override the auto choice.
+    forced = os.environ.get("MXNET_USE_NATIVE_REC")
     use_native = config.get_bool(
         "MXNET_USE_NATIVE_REC",
         io_native.jpeg_available() and (os.cpu_count() or 1) >= 2)
+    if forced == "1" and not io_native.jpeg_available():
+        # an explicit force must fail loudly, not quietly run 4x slower
+        raise RuntimeError("MXNET_USE_NATIVE_REC=1 but the native JPEG "
+                           "pipeline is unavailable on this host")
     if use_native and io_native.jpeg_available():
         train = mx.io.ImageRecordIter(
             path_imgrec=args.data_train, data_shape=image_shape,

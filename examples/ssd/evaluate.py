@@ -1,0 +1,70 @@
+"""Evaluate SSD detections with VOC mAP.
+
+Reference: example/ssd/evaluate.py + evaluate/evaluate_net.py — run the
+inference symbol over a detection .rec and score with
+MApMetric/VOC07MApMetric.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "symbol"))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.image_det import DetRecordIter  # noqa: E402
+
+from metric import MApMetric, VOC07MApMetric  # noqa: E402
+
+
+def evaluate_net(module_or_params, val_rec, num_classes, network="mini",
+                 batch_size=8, data_shape=(3, 96, 96), ctx=None,
+                 ovp_thresh=0.5, use_voc07=True, class_names=None,
+                 mean_pixels=(123.68, 116.779, 103.939)):
+    """Score a trained SSD on a detection .rec; returns (names, values).
+
+    ``module_or_params``: a fitted training Module (its weights are
+    rebound onto the inference symbol) or a param dict.
+    """
+    from train import get_net
+    net = get_net(network, num_classes, train=False)
+    if hasattr(module_or_params, "get_params"):
+        arg_params, aux_params = module_or_params.get_params()
+    else:
+        arg_params, aux_params = module_or_params
+
+    val_iter = DetRecordIter(val_rec, batch_size, data_shape,
+                             mean_pixels=mean_pixels)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=ctx or mx.cpu())
+    mod.bind(data_shapes=val_iter.provide_data,
+             label_shapes=val_iter.provide_label, for_training=False)
+    mod.set_params(arg_params, aux_params, allow_missing=False)
+    metric = (VOC07MApMetric if use_voc07 else MApMetric)(
+        ovp_thresh=ovp_thresh, class_names=class_names)
+    res = mod.score(val_iter, metric)
+    return res
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description="evaluate SSD")
+    p.add_argument("--val-rec", required=True)
+    p.add_argument("--network", default="vgg16_reduced",
+                   choices=["vgg16_reduced", "mini"])
+    p.add_argument("--num-classes", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--data-shape", type=int, default=300)
+    p.add_argument("--model-prefix", default="ssd")
+    p.add_argument("--epoch", type=int, default=240)
+    args = p.parse_args()
+    _, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model_prefix, args.epoch)
+    res = evaluate_net((arg_params, aux_params), args.val_rec,
+                       args.num_classes, args.network, args.batch_size,
+                       (3, args.data_shape, args.data_shape))
+    for n, v in res:  # Module.score returns a list of (name, value) pairs
+        print("%s=%f" % (n, v))

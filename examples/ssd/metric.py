@@ -1,0 +1,203 @@
+"""SSD metrics: training losses + detection mAP.
+
+Reference: example/ssd/train/metric.py (MultiBoxMetric) and
+example/ssd/evaluate/eval_metric.py (MApMetric, VOC07MApMetric).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Training cross-entropy + SmoothL1 over the SSD heads
+    (train/metric.py:5-52)."""
+
+    def __init__(self, eps=1e-8):
+        super().__init__("multibox")
+        self.eps = eps
+        self.reset()
+
+    def reset(self):
+        self.ce_sum = 0.0
+        self.ce_n = 0
+        self.l1_sum = 0.0
+        self.l1_n = 0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()
+        loc_loss = preds[1].asnumpy()
+        cls_label = preds[2].asnumpy()
+        valid = int(np.sum(cls_label >= 0))
+        flat = cls_label.flatten()
+        mask = np.where(flat >= 0)[0]
+        idx = flat[mask].astype(np.int64)
+        prob = cls_prob.transpose(0, 2, 1).reshape(-1, cls_prob.shape[1])
+        prob = prob[mask, idx]
+        self.ce_sum += float((-np.log(prob + self.eps)).sum())
+        self.ce_n += valid
+        self.l1_sum += float(np.sum(loc_loss))
+        self.l1_n += valid
+        self.num_inst = 1
+        self.sum_metric = self.ce_sum / max(self.ce_n, 1)
+
+    def get(self):
+        return (["CrossEntropy", "SmoothL1"],
+                [self.ce_sum / max(self.ce_n, 1),
+                 self.l1_sum / max(self.l1_n, 1)])
+
+
+class MApMetric(mx.metric.EvalMetric):
+    """Mean average precision for detection
+    (evaluate/eval_metric.py:4-228).
+
+    labels: (n, 5|6) [cls, xmin, ymin, xmax, ymax, (difficult)];
+    preds[pred_idx]: (m, 6) [cls, score, xmin, ymin, xmax, ymax].
+    """
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False,
+                 class_names=None, pred_idx=0):
+        name = "mAP" if class_names is None else class_names + ["mAP"]
+        super().__init__(name if isinstance(name, str) else "mAP")
+        self.records = {}
+        self.counts = {}
+        self.ovp_thresh = ovp_thresh
+        self.use_difficult = use_difficult
+        self.class_names = class_names
+        self.pred_idx = int(pred_idx)
+
+    def reset(self):
+        self.records = {}
+        self.counts = {}
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    @staticmethod
+    def _iou(x, ys):
+        ixmin = np.maximum(ys[:, 0], x[0])
+        iymin = np.maximum(ys[:, 1], x[1])
+        ixmax = np.minimum(ys[:, 2], x[2])
+        iymax = np.minimum(ys[:, 3], x[3])
+        iw = np.maximum(ixmax - ixmin, 0.0)
+        ih = np.maximum(iymax - iymin, 0.0)
+        inters = iw * ih
+        uni = (x[2] - x[0]) * (x[3] - x[1]) + \
+            (ys[:, 2] - ys[:, 0]) * (ys[:, 3] - ys[:, 1]) - inters
+        ious = inters / np.maximum(uni, 1e-12)
+        ious[uni < 1e-12] = 0
+        return ious
+
+    def _gt_count(self, gts):
+        if not self.use_difficult and gts.shape[1] >= 6:
+            return int(np.sum(gts[:, 5] < 1))
+        return gts.shape[0]
+
+    def update(self, labels, preds):
+        for i in range(labels[0].shape[0]):
+            label = labels[0][i].asnumpy()
+            label = label[label[:, 0] >= 0]  # drop -1 padding rows
+            pred = preds[self.pred_idx][i].asnumpy()
+            processed = set()
+            while pred.shape[0] > 0:
+                cid = int(pred[0, 0])
+                indices = np.where(pred[:, 0].astype(int) == cid)[0]
+                if cid < 0:
+                    pred = np.delete(pred, indices, axis=0)
+                    continue
+                dets = pred[indices]
+                pred = np.delete(pred, indices, axis=0)
+                processed.add(cid)
+                dets = dets[dets[:, 1].argsort()[::-1]]
+                records = np.hstack((dets[:, 1][:, np.newaxis],
+                                     np.zeros((dets.shape[0], 1))))
+                gts = label[label[:, 0].astype(int) == cid]
+                if gts.size > 0:
+                    found = [False] * gts.shape[0]
+                    for j in range(dets.shape[0]):
+                        ious = self._iou(dets[j, 2:6], gts[:, 1:5])
+                        am = int(np.argmax(ious))
+                        if ious[am] > self.ovp_thresh:
+                            if (not self.use_difficult and
+                                    gts.shape[1] >= 6 and gts[am, 5] > 0):
+                                pass  # difficult gt: neither tp nor fp
+                            elif not found[am]:
+                                records[j, -1] = 1  # tp
+                                found[am] = True
+                            else:
+                                records[j, -1] = 2  # duplicate: fp
+                        else:
+                            records[j, -1] = 2
+                else:
+                    records[:, -1] = 2
+                gt_count = self._gt_count(gts)
+                records = records[records[:, -1] > 0]
+                if records.size > 0:
+                    self._insert(cid, records, gt_count)
+                elif gt_count > 0:
+                    # every det matched a difficult gt: the real gts still
+                    # count toward recall (sentinel row, neither tp nor fp)
+                    self._insert(cid, np.array([[-1.0, 0.0]]), gt_count)
+            # label classes with no detections at all still contribute
+            # their gt count — a wholly-missed class must drag recall to 0,
+            # not drop out of the mean (reference eval_metric.py's
+            # missing-class sentinel)
+            for cid in np.unique(label[:, 0].astype(int)):
+                if cid < 0 or cid in processed:
+                    continue
+                gts = label[label[:, 0].astype(int) == cid]
+                gt_count = self._gt_count(gts)
+                if gt_count > 0:
+                    self._insert(int(cid), np.array([[-1.0, 0.0]]), gt_count)
+
+    def _insert(self, key, records, count):
+        if key not in self.records:
+            self.records[key] = records
+            self.counts[key] = count
+        else:
+            self.records[key] = np.vstack((self.records[key], records))
+            self.counts[key] += count
+
+    def _recall_prec(self, record, count):
+        srt = record[record[:, 0].argsort()[::-1]]
+        tp = np.cumsum(srt[:, 1].astype(int) == 1)
+        fp = np.cumsum(srt[:, 1].astype(int) == 2)
+        recall = tp / float(count) if count > 0 else tp * 0.0
+        prec = tp.astype(float) / np.maximum(tp + fp, 1)
+        return recall, prec
+
+    def _average_precision(self, rec, prec):
+        mrec = np.concatenate(([0.0], rec, [1.0]))
+        mpre = np.concatenate(([0.0], prec, [0.0]))
+        for i in range(mpre.size - 1, 0, -1):
+            mpre[i - 1] = max(mpre[i - 1], mpre[i])
+        i = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[i + 1] - mrec[i]) * mpre[i + 1]))
+
+    def get(self):
+        aps = {}
+        for k, v in self.records.items():
+            recall, prec = self._recall_prec(v, self.counts[k])
+            aps[k] = self._average_precision(recall, prec)
+        if not aps:
+            return ("mAP", float("nan"))
+        mean_ap = float(np.mean(list(aps.values())))
+        if self.class_names is None:
+            return ("mAP", mean_ap)
+        names = [self.class_names[k] if k < len(self.class_names) else str(k)
+                 for k in sorted(aps)] + ["mAP"]
+        values = [aps[k] for k in sorted(aps)] + [mean_ap]
+        return (names, values)
+
+
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (eval_metric.py:230-258)."""
+
+    def _average_precision(self, rec, prec):
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = 0.0 if np.sum(rec >= t) == 0 else float(np.max(prec[rec >= t]))
+            ap += p / 11.0
+        return ap

@@ -1,0 +1,49 @@
+"""Small SSD over a 3-stage convnet backbone.
+
+A CI-scale detector using the same multibox head machinery as the VGG16
+model (ssd_vgg16.build_train_symbol/build_symbol) — the role of the
+reference's smaller legacy configs for quick experiments; converges on
+the synthetic rectangle dataset (tools/synth_dataset.py) in minutes on
+CPU.
+"""
+from __future__ import annotations
+
+from mxnet_tpu import symbol as sym
+
+from ssd_vgg16 import build_symbol, build_train_symbol
+
+MINI_SIZES = [[0.2, 0.3], [0.4, 0.55], [0.7, 0.85]]
+MINI_RATIOS = [[1, 2, 0.5]] * 3
+
+
+def _conv_bn(body, name, f):
+    body = sym.Convolution(body, kernel=(3, 3), pad=(1, 1), num_filter=f,
+                           name="conv%s" % name)
+    body = sym.BatchNorm(body, name="bn%s" % name)
+    return sym.Activation(body, act_type="relu", name="relu%s" % name)
+
+
+def _backbone(data):
+    body = data
+    layers = []
+    for i, f in enumerate([32, 64, 128]):
+        body = _conv_bn(body, "m%d_a" % i, f)
+        body = _conv_bn(body, "m%d_b" % i, f)
+        body = sym.Pooling(body, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2), name="mpool%d" % i)
+        layers.append(body)
+    return layers[-3:]
+
+
+def get_symbol_train(num_classes=3, **kwargs):
+    data = sym.Variable("data")
+    layers = _backbone(data)
+    return build_train_symbol(layers, num_classes, MINI_SIZES, MINI_RATIOS,
+                              nms_thresh=0.45, nms_topk=100)
+
+
+def get_symbol(num_classes=3, nms_thresh=0.45, **kwargs):
+    data = sym.Variable("data")
+    layers = _backbone(data)
+    return build_symbol(layers, num_classes, MINI_SIZES, MINI_RATIOS,
+                        nms_thresh=nms_thresh, nms_topk=100)

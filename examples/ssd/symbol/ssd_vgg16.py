@@ -111,15 +111,14 @@ DEFAULT_RATIOS = [[1, 2, 0.5], [1, 2, 0.5, 3, 1.0 / 3],
                   [1, 2, 0.5], [1, 2, 0.5]]
 
 
-def get_symbol_train(num_classes=20, **kwargs):
-    """Training net: multibox target + losses (reference
-    symbol_builder.get_symbol_train)."""
-    data = sym.Variable("data")
+def build_train_symbol(layers, num_classes, sizes, ratios,
+                       nms_thresh=0.45, nms_topk=400):
+    """Training head over prepared feature scales: multibox target +
+    losses (reference symbol_builder.get_symbol_train) — shared by every
+    SSD backbone."""
     label = sym.Variable("label")
-    feat = vgg16_reduced(data)
-    layers = multi_layer_feature(feat)
     loc_preds, cls_preds, anchors = multibox_layer(
-        layers, num_classes, DEFAULT_SIZES, DEFAULT_RATIOS)
+        layers, num_classes, sizes, ratios)
 
     tmp = mx.contrib.sym.MultiBoxTarget(
         anchors, label, cls_preds, overlap_threshold=0.5,
@@ -140,11 +139,36 @@ def get_symbol_train(num_classes=20, **kwargs):
                             name="loc_loss")
     cls_label = sym.BlockGrad(cls_target, name="cls_label")
     det = mx.contrib.sym.MultiBoxDetection(
-        cls_prob, loc_preds, anchors, nms_threshold=0.45,
+        cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
         force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
-        nms_topk=400, name="detection")
+        nms_topk=nms_topk, name="detection")
     det = sym.BlockGrad(det, name="det_out")
     return sym.Group([cls_prob, loc_loss, cls_label, det])
+
+
+def build_symbol(layers, num_classes, sizes, ratios, nms_thresh=0.5,
+                 force_suppress=False, nms_topk=400):
+    """Inference head over prepared feature scales (reference
+    symbol_builder.get_symbol)."""
+    loc_preds, cls_preds, anchors = multibox_layer(
+        layers, num_classes, sizes, ratios)
+    cls_prob = sym.SoftmaxActivation(data=cls_preds, mode="channel",
+                                     name="cls_prob")
+    out = mx.contrib.sym.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
+        force_suppress=force_suppress, variances=(0.1, 0.1, 0.2, 0.2),
+        nms_topk=nms_topk, name="detection")
+    return out
+
+
+def get_symbol_train(num_classes=20, **kwargs):
+    """Training net over reduced VGG-16 (reference
+    symbol_builder.get_symbol_train)."""
+    data = sym.Variable("data")
+    feat = vgg16_reduced(data)
+    layers = multi_layer_feature(feat)
+    return build_train_symbol(layers, num_classes, DEFAULT_SIZES,
+                              DEFAULT_RATIOS)
 
 
 def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
@@ -153,12 +177,6 @@ def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
     data = sym.Variable("data")
     feat = vgg16_reduced(data)
     layers = multi_layer_feature(feat)
-    loc_preds, cls_preds, anchors = multibox_layer(
-        layers, num_classes, DEFAULT_SIZES, DEFAULT_RATIOS)
-    cls_prob = sym.SoftmaxActivation(data=cls_preds, mode="channel",
-                                     name="cls_prob")
-    out = mx.contrib.sym.MultiBoxDetection(
-        cls_prob, loc_preds, anchors, nms_threshold=nms_thresh,
-        force_suppress=force_suppress, variances=(0.1, 0.1, 0.2, 0.2),
-        nms_topk=nms_topk, name="detection")
-    return out
+    return build_symbol(layers, num_classes, DEFAULT_SIZES, DEFAULT_RATIOS,
+                        nms_thresh=nms_thresh, force_suppress=force_suppress,
+                        nms_topk=nms_topk)

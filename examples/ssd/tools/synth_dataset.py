@@ -1,0 +1,61 @@
+"""Toy detection dataset: colored rectangles on noise backgrounds.
+
+Role of the reference's VOC download+prepare tooling
+(example/ssd/tools/prepare_dataset.py) for environments without the
+dataset: generates a .rec in the detection record format
+(mxnet_tpu.image_det.pack_det_label) whose classes are distinguishable
+by color, so a small SSD must learn localization + classification.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import os
+
+import numpy as np
+
+CLASS_COLORS = [(220, 40, 40), (40, 220, 40), (40, 40, 220)]
+CLASS_NAMES = ["red", "green", "blue"]
+
+
+def make_record_file(path, num_images=64, image_size=96, max_objects=2,
+                     seed=0):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "..", "..", ".."))
+    import mxnet_tpu as mx
+    from mxnet_tpu.image_det import pack_det_label
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    w = mx.recordio.MXRecordIO(path, "w")
+    for i in range(num_images):
+        img = (rng.rand(image_size, image_size, 3) * 40 + 100).astype(
+            np.uint8)
+        objs = []
+        for _ in range(rng.randint(1, max_objects + 1)):
+            cls = rng.randint(len(CLASS_COLORS))
+            bw = rng.randint(image_size // 4, image_size // 2)
+            bh = rng.randint(image_size // 4, image_size // 2)
+            x0 = rng.randint(0, image_size - bw)
+            y0 = rng.randint(0, image_size - bh)
+            img[y0:y0 + bh, x0:x0 + bw] = CLASS_COLORS[cls]
+            objs.append([cls, x0 / image_size, y0 / image_size,
+                         (x0 + bw) / image_size, (y0 + bh) / image_size, 0])
+        buf = _pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=95)
+        w.write(mx.recordio.pack(
+            mx.recordio.IRHeader(0, pack_det_label(objs), i, 0),
+            buf.getvalue()))
+    w.close()
+    return path
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="synth_det.rec")
+    p.add_argument("--num-images", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=96)
+    args = p.parse_args()
+    make_record_file(args.out, args.num_images, args.image_size)
+    print("wrote", args.out)

@@ -1,0 +1,80 @@
+"""Train SSD on a detection .rec.
+
+Reference: example/ssd/train.py + train/train_net.py — Module.fit over
+the multibox training symbol with DetRecordIter data and the
+CrossEntropy/SmoothL1 training metric.
+
+    python train.py --train-rec data.rec --network mini --num-classes 3
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "symbol"))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.image_det import DetRecordIter  # noqa: E402
+
+from metric import MultiBoxMetric  # noqa: E402
+
+
+def get_net(network, num_classes, train=True):
+    if network == "mini":
+        import ssd_mini as m
+    else:
+        import ssd_vgg16 as m
+    return (m.get_symbol_train if train else m.get_symbol)(
+        num_classes=num_classes)
+
+
+def train_net(train_rec, network="vgg16_reduced", num_classes=20,
+              batch_size=32, data_shape=(3, 300, 300), num_epochs=1,
+              lr=0.004, momentum=0.9, wd=5e-4, ctx=None, seed=0,
+              model_prefix=None, mean_pixels=(123.68, 116.779, 103.939),
+              rand_mirror=True, frequent=20):
+    """The train_net.py flow: iterator -> Module.fit with multibox
+    metric; returns the fitted module."""
+    net = get_net(network, num_classes, train=True)
+    train_iter = DetRecordIter(train_rec, batch_size, data_shape,
+                               mean_pixels=mean_pixels, shuffle=True,
+                               rand_mirror=rand_mirror, seed=seed)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=ctx or mx.cpu())
+    mod.fit(train_iter,
+            eval_metric=MultiBoxMetric(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": momentum,
+                              "wd": wd, "rescale_grad": 1.0 / batch_size,
+                              "clip_gradient": 4.0},
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="out", magnitude=2),
+            num_epoch=num_epochs,
+            batch_end_callback=mx.callback.Speedometer(batch_size,
+                                                       frequent))
+    if model_prefix:
+        mod.save_checkpoint(model_prefix, num_epochs)
+    return mod
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="train SSD")
+    p.add_argument("--train-rec", required=True)
+    p.add_argument("--network", default="vgg16_reduced",
+                   choices=["vgg16_reduced", "mini"])
+    p.add_argument("--num-classes", type=int, default=20)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--data-shape", type=int, default=300)
+    p.add_argument("--num-epochs", type=int, default=240)
+    p.add_argument("--lr", type=float, default=0.004)
+    p.add_argument("--model-prefix", default="ssd")
+    args = p.parse_args()
+    train_net(args.train_rec, args.network, args.num_classes,
+              args.batch_size, (3, args.data_shape, args.data_shape),
+              args.num_epochs, lr=args.lr, model_prefix=args.model_prefix)

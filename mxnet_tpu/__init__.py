@@ -60,9 +60,11 @@ from . import config
 from . import predictor
 from .predictor import Predictor
 
-# optional: image pipeline needs PIL
+# optional: image pipelines need PIL
 try:
     from . import image
+    from . import image_det
 except ImportError:  # pragma: no cover
     image = None
+    image_det = None
 

@@ -25,8 +25,11 @@ __all__ = ["DataParallelExecutorGroup"]
 
 
 def _load_general(data, targets):
-    """Load a list of batch arrays into per-device slices of targets."""
+    """Load a list of batch arrays into per-device slices of targets
+    (None target = the symbol does not consume this entry)."""
     for d_src, d_targets in zip(data, targets):
+        if d_targets is None:
+            continue
         if isinstance(d_targets, NDArray):
             d_targets[:] = d_src
         else:
@@ -369,10 +372,15 @@ class DataParallelExecutorGroup:
 
     @property
     def label_arrays(self):
-        label_names = [x[0] for x in self.label_shapes]
-        return [[(self.slices[i], e.arg_dict[name])
+        # tolerate labels the bound symbol does not consume (reference
+        # executor_group filters label_names against the arguments — an
+        # inference symbol scored with a labeled iterator has none).
+        # None placeholders keep positional alignment with batch.label so
+        # a partially-consumed label list still pairs by name.
+        return [None if x[0] not in self.execs[0].arg_dict else
+                [(self.slices[i], e.arg_dict[x[0]])
                  for i, e in enumerate(self.execs)]
-                for name in label_names]
+                for x in self.label_shapes]
 
     def install_monitor(self, mon):
         for exe in self.execs:

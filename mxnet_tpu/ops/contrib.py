@@ -121,7 +121,30 @@ def multibox_target(attrs, ctx, anchor, label, cls_pred):
         loc = jnp.where(pos[:, None], loc, 0.0)
         mask = jnp.where(pos[:, None], 1.0, 0.0)
         mask = jnp.broadcast_to(mask, loc.shape)
-        cls_t = jnp.where(pos, ids[match] + 1.0, 0.0)
+        ratio = float(attrs["negative_mining_ratio"])
+        if ratio > 0:
+            # hard-negative mining (multibox_target.cc): keep the
+            # ratio*npos highest-foreground-confidence negatives among
+            # anchors overlapping gt below negative_mining_thresh; all
+            # other negatives become ignore_label and drop out of the
+            # classification loss — without this SSD collapses to
+            # all-background (positives are <1% of anchors)
+            ignore = float(attrs["ignore_label"])
+            neg_thr = float(attrs["negative_mining_thresh"])
+            min_neg = float(attrs["minimum_negative_samples"])
+            fg = jax.nn.softmax(pred, axis=0)[1:].max(axis=0)
+            eligible = (~pos) & (best_iou < neg_thr)
+            score = jnp.where(eligible, fg, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros(anchors.shape[0], jnp.int32).at[order].set(
+                jnp.arange(anchors.shape[0], dtype=jnp.int32))
+            num_neg = jnp.minimum(
+                jnp.maximum(ratio * pos.sum(), min_neg), eligible.sum())
+            neg = eligible & (rank < num_neg)
+            cls_t = jnp.where(pos, ids[match] + 1.0,
+                              jnp.where(neg, 0.0, ignore))
+        else:
+            cls_t = jnp.where(pos, ids[match] + 1.0, 0.0)
         return loc.reshape(-1), mask.reshape(-1), cls_t
 
     loc_t, loc_m, cls_t = jax.vmap(one)(label.astype(jnp.float32),
@@ -168,10 +191,18 @@ def multibox_detection(attrs, ctx, cls_prob, loc_pred, anchor):
         score_nobg = jnp.where(cls == bg, 0.0, jnp.max(probs, axis=0))
         keep = score_nobg > thr
         order = jnp.argsort(-score_nobg)
-        boxes_o = boxes[order]
-        cls_o = cls[order]
-        score_o = score_nobg[order]
-        keep_o = keep[order]
+        # nms_topk (reference multibox_detection.cc nms_topk param) bounds
+        # the pairwise-IoU working set to K^2 — mandatory at SSD anchor
+        # counts (A^2 would be tens of GB); beyond-K rows are suppressed
+        # like the reference's post-topk tail
+        n_anchors = boxes.shape[0]
+        topk = int(attrs["nms_topk"])
+        k = min(topk, n_anchors) if topk > 0 else n_anchors
+        order_k = order[:k]
+        boxes_o = boxes[order_k]
+        cls_o = cls[order_k]
+        score_o = score_nobg[order_k]
+        keep_o = keep[order_k]
         iou = _iou(boxes_o, boxes_o)
         same_class = (cls_o[:, None] == cls_o[None, :]) | force
         # greedy NMS as a scan over score-sorted boxes
@@ -186,6 +217,11 @@ def multibox_detection(attrs, ctx, cls_prob, loc_pred, anchor):
         out_cls = jnp.where(alive, cls_o.astype(jnp.float32) - shift, -1.0)
         out = jnp.concatenate([out_cls[:, None], score_o[:, None], boxes_o],
                               axis=-1)
+        if k < n_anchors:
+            pad = jnp.concatenate(
+                [jnp.full((n_anchors - k, 1), -1.0),
+                 score_nobg[order[k:], None], boxes[order[k:]]], axis=-1)
+            out = jnp.concatenate([out, pad], axis=0)
         return out
 
     return jax.vmap(one)(cls_prob.astype(jnp.float32),

@@ -704,11 +704,20 @@ def make_loss(attrs, ctx, data):
     """
     scale = float(attrs["grad_scale"])
     norm = attrs["normalization"]
+    thresh = float(attrs["valid_thresh"])
 
     def bwd(res, g):
         (x,) = res
-        s = scale / x.shape[0] if norm == "batch" else scale
-        return (jnp.full_like(x, s),)
+        if norm == "batch":
+            s = jnp.asarray(scale / x.shape[0], x.dtype)
+        elif norm == "valid":
+            # divide by the count of entries above valid_thresh
+            # (make_loss-inl.h:98-113) — SSD's per-positive-anchor scaling
+            valid = jnp.maximum(jnp.sum(x > thresh), 1).astype(x.dtype)
+            s = jnp.asarray(scale, x.dtype) / valid
+        else:
+            s = jnp.asarray(scale, x.dtype)
+        return (jnp.broadcast_to(s, x.shape).astype(x.dtype),)
 
     f = _head_grad_op(lambda d: d, bwd)
     return f(data)

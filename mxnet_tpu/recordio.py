@@ -99,10 +99,16 @@ class MXRecordIO:
         magic_bytes = struct.pack("<I", _MAGIC)
         parts = []
         start = 0
-        for pos in range(0, len(buf) - 3, 4):
-            if buf[pos:pos + 4] == magic_bytes:
+        # bytes.find skips straight to candidate matches (the magic almost
+        # never occurs); only 4-aligned hits are split points
+        pos = buf.find(magic_bytes)
+        while pos != -1:
+            if pos % 4 == 0:
                 parts.append(buf[start:pos])
                 start = pos + 4
+                pos = buf.find(magic_bytes, start)
+            else:
+                pos = buf.find(magic_bytes, pos + 1)
         parts.append(buf[start:])
         if len(parts) == 1:
             self._write_part(buf, 0)

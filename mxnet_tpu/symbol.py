@@ -137,7 +137,12 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
             ins = [jax.device_put(x, dev) for x in ins]
         node_attrs = node.attrs
         shp = node_attrs.get("shape")
-        if isinstance(shp, (tuple, list)) and any(s == 0 for s in shp):
+        # deferred batch dim: ONLY for source ops (zeros/ones/... with no
+        # inputs, e.g. RNN begin_state) — ops WITH inputs (Reshape, ...)
+        # give 0 its own meaning ("copy this dim from the input") and
+        # resolve it themselves
+        if (not node.inputs and isinstance(shp, (tuple, list))
+                and any(s == 0 for s in shp)):
             if batch_size is None:
                 raise MXNetError(
                     "node %r has a deferred (0) dim in shape %s but no "
