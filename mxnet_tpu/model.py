@@ -174,10 +174,18 @@ class FeedForward:
                 monitor=monitor)
         self.arg_params, self.aux_params = mod.get_params()
 
+    def _symbol_label_names(self):
+        """Label arguments by the *_label naming convention (reference
+        DataDesc convention) — needed when predicting with a module that
+        was not created by fit (e.g. right after load)."""
+        return [n for n in self.symbol.list_arguments()
+                if n.endswith("label")]
+
     def predict(self, X, num_batch=None, return_data=False, reset=True):
         data = self._prepare_data(X)
         mod = self._get_module(
-            data_names=[d.name for d in data.provide_data], label_names=[])
+            data_names=[d.name for d in data.provide_data],
+            label_names=self._symbol_label_names())
         if not mod.binded:
             mod.bind(data_shapes=data.provide_data, for_training=False)
             mod.init_params(arg_params=self.arg_params,

@@ -176,6 +176,8 @@ class ShardedTrainer:
         self._arg_nodes, self._aux_nodes = arg_nodes, aux_nodes
         arg_names = [n.name for n in arg_nodes]
         self._input_names = list(data_shapes) + list(label_shapes or ())
+        self._data_names = list(data_shapes)
+        self._label_shapes = dict(label_shapes or {})
         self._param_names = [n for n in arg_names
                              if n not in self._input_names]
         self._aux_names = [n.name for n in aux_nodes]
@@ -513,17 +515,26 @@ class ShardedTrainer:
 
             def fwd(params, aux, batch):
                 p = {k: v.astype(compute_dtype) for k, v in params.items()}
+                bsz = next(iter(batch.values())).shape[0]
+                # loss heads still take label inputs at inference; their
+                # forward ignores the values, so zeros stand in
+                full = dict(batch)
+                for n, s in self._label_shapes.items():
+                    if n not in full:
+                        full[n] = jnp.zeros((bsz,) + tuple(s[1:]),
+                                            jnp.float32)
                 with image_layout(layout):
-                    var_values = self._node_value_map(p, batch, aux)
+                    var_values = self._node_value_map(p, full, aux)
                     heads, _ = eval_graph(topo, entries, var_values,
                                           is_train=False, key=None,
-                                          batch_size=next(
-                                              iter(batch.values())).shape[0])
+                                          batch_size=bsz)
                 return heads
             self._fwd_fn = jax.jit(fwd, in_shardings=(
                 self._param_sharding, self._aux_sharding,
-                self._batch_sharding))
+                {k: self._batch_sharding[k] for k in self._data_names}))
         first = next(iter(batch.values()))
+        # inference takes data inputs only — drop labels if supplied
+        batch = {k: v for k, v in batch.items() if k in self._data_names}
         if isinstance(first, jax.Array):
             dev_batch = batch  # already staged via put_batch
         else:

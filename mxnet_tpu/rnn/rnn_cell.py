@@ -79,8 +79,8 @@ class BaseRNNCell:
     def begin_state(self, func=symbol.zeros, **kwargs):
         """Initial-state symbols (reference begin_state)."""
         assert not self._modified, \
-            "After applying modifier cells the base cell cannot be called "\
-            "directly. Call the modifier cell instead."
+            "this cell has been wrapped by a modifier (dropout/zoneout/"\
+            "residual); invoke the wrapper, not the wrapped base cell"
         states = []
         for info in self.state_info:
             self._init_counter += 1
@@ -436,7 +436,8 @@ class FusedRNNCell(BaseRNNCell):
         return (per_dir - gates * h * h) // (gates * h)
 
     def __call__(self, inputs, states):
-        raise MXNetError("FusedRNNCell cannot be stepped. Please use unroll")
+        raise MXNetError("FusedRNNCell has no single-step form - it is a "
+                         "whole-sequence lax.scan; call unroll() instead")
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
@@ -510,8 +511,8 @@ class SequentialRNNCell(BaseRNNCell):
         self._cells.append(cell)
         if self._override_cell_params:
             assert cell._own_params, \
-                "Either specify params for SequentialRNNCell or child cells,"\
-                " not both."
+                "parameter containers conflict: pass params to the "\
+                "SequentialRNNCell or let each child own its params, not both"
             cell.params._params.update(self.params._params)
         self.params._params.update(cell.params._params)
         return self
