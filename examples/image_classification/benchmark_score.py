@@ -26,7 +26,13 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
     net = models.get_model(network, num_classes=1000,
                            image_shape=",".join(map(str, image_shape)))
     data_shape = (batch_size,) + image_shape
-    ex = net.simple_bind(dev or mx.current_context(), grad_req="null",
+    if dev is None:
+        # bind on the accelerator (reference scores on mx.gpu(0)); a cpu
+        # context would re-ship every weight to the chip per call
+        import jax
+        has_accel = any(d.platform != "cpu" for d in jax.devices())
+        dev = mx.tpu(0) if has_accel else mx.cpu()
+    ex = net.simple_bind(dev, grad_req="null",
                          data=data_shape,
                          softmax_label=(batch_size,))
     init = mx.initializer.Xavier()
