@@ -10,6 +10,9 @@ holds the TPU-native machinery:
   pjit-compiled train step (forward + backward + optimizer + collectives),
   the performant path that Module's per-call forward/backward approximates.
 * :mod:`dist_kvstore` — the ``dist_sync`` KVStore facade over collectives.
+* :mod:`sequence` — ring attention (sequence/context parallelism).
+* :mod:`pipeline` — GPipe-style microbatch pipeline over a ``pipe`` axis.
 """
 from .mesh import build_mesh, data_parallel_spec
+from .pipeline import make_pipeline_mesh, pipeline_apply, pipeline_grad
 from .trainer import ShardedTrainer

@@ -1,0 +1,131 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh.
+
+The reference's model parallelism assigns whole layers to devices and
+runs them sequentially per batch (`example/model-parallel-lstm/
+lstm.py:142-205` — each LSTM layer on its own GPU, overlap only from
+async engine dispatch).  This module is the compiled TPU-native
+successor: the layer stack is sharded over a ``pipe`` mesh axis, the
+batch is split into microbatches, and ONE jitted SPMD program streams
+activations stage-to-stage over the ICI ring (`lax.ppermute` inside
+`shard_map`), so all stages compute concurrently after the fill phase.
+Gradients come from `jax.grad` straight through the schedule — the
+backward pass replays it in reverse (GPipe semantics; per-microbatch
+`jax.checkpoint` keeps activation memory at O(microbatch)).
+
+Scope: uniform stages — every stage maps (microbatch, ...) -> the same
+shape (layer stacks: RNN/transformer layers, repeated blocks).  The
+stage parameters are stacked on a leading axis sharded over ``pipe``.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["pipeline_apply", "pipeline_grad", "make_pipeline_mesh"]
+
+
+def make_pipeline_mesh(n_stages, devices=None):
+    """1-D mesh with a ``pipe`` axis of n_stages devices."""
+    import jax
+    import numpy as np
+    devs = list(devices if devices is not None else jax.devices())[:n_stages]
+    if len(devs) < n_stages:
+        raise ValueError("need %d devices for %d pipeline stages, have %d"
+                         % (n_stages, n_stages, len(devs)))
+    return jax.sharding.Mesh(np.array(devs), ("pipe",))
+
+
+def _stage_loop(stage_fn, params_stack, x_stack, axis_name, remat):
+    """Per-device body under shard_map.
+
+    params_stack: (1, ...) this device's stage params (leading stage axis
+    sharded to size 1).  x_stack: (M, B_u, ...) all microbatches,
+    replicated.  Returns (M, B_u, ...) outputs of the LAST stage
+    (garbage on other devices; caller slices stage S-1's shard).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    m = x_stack.shape[0]
+    params = jax.tree.map(lambda p: p[0], params_stack)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    shift = [(i, (i + 1) % n) for i in range(n)]  # stage s -> s+1
+
+    def tick(carry, t):
+        # carry: (inbuf, outputs)
+        #   inbuf: (B_u, ...) the activation this stage consumes this tick
+        #   outputs: (M, B_u, ...) last-stage results by microbatch
+        inbuf, outputs = carry
+        # stage 0 reads microbatch t from the input stream; others read
+        # what the previous stage sent last tick
+        x_t = jnp.where(sid == 0,
+                        x_stack[jnp.clip(t, 0, m - 1)], inbuf)
+        # active when microbatch (t - sid) is in range
+        mb = t - sid
+        active = (mb >= 0) & (mb < m)
+        y = fn(params, x_t)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # the last stage stores its result; everyone else forwards it
+        outputs = jnp.where(
+            (sid == n - 1) & active,
+            outputs.at[jnp.clip(mb, 0, m - 1)].set(y), outputs)
+        nxt = lax.ppermute(y, axis_name, shift)
+        return (nxt, outputs), None
+
+    inbuf0 = jnp.zeros_like(x_stack[0])
+    outputs0 = jnp.zeros_like(x_stack)
+    (_, outputs), _ = lax.scan(tick, (inbuf0, outputs0),
+                               jnp.arange(m + n - 1))
+    return outputs
+
+
+def pipeline_apply(stage_fn, params_stack, x, mesh, microbatches,
+                   remat=True):
+    """Run ``x`` through ``n_stages`` pipelined applications of
+    ``stage_fn`` (one stage per device on the mesh's ``pipe`` axis).
+
+    stage_fn(params, x_micro) -> y_micro with y.shape == x.shape (uniform
+    stages).  params_stack: pytree whose leaves have a leading stage axis
+    of size n_stages.  x: (batch, ...), split into ``microbatches`` equal
+    chunks.  Returns (batch, ...) outputs of the final stage, replicated.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.devices.size
+    b = x.shape[0]
+    if b % microbatches:
+        raise ValueError("batch %d not divisible into %d microbatches"
+                         % (b, microbatches))
+    x_stack = x.reshape((microbatches, b // microbatches) + x.shape[1:])
+
+    body = functools.partial(_stage_loop, stage_fn, axis_name="pipe",
+                             remat=remat)
+    out = shard_map(
+        lambda p, xs: jax.lax.psum(body(p, xs), "pipe"),
+        mesh=mesh,
+        in_specs=(P("pipe"), P()),
+        out_specs=P(),
+        check_rep=False,
+    )(params_stack, x_stack)
+    # only the last stage contributed nonzeros; psum replicates its result
+    return out.reshape((b,) + out.shape[2:])
+
+
+def pipeline_grad(loss_fn, stage_fn, params_stack, x, labels, mesh,
+                  microbatches, remat=True):
+    """(loss, grads) of ``loss_fn(pipeline(x), labels)`` w.r.t. the
+    stacked stage params — jax.grad runs the schedule in reverse
+    (ppermute transposes to the opposite ring direction)."""
+    import jax
+
+    def full(p):
+        y = pipeline_apply(stage_fn, p, x, mesh, microbatches, remat=remat)
+        return loss_fn(y, labels)
+
+    return jax.value_and_grad(full)(params_stack)
