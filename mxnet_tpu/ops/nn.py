@@ -887,3 +887,89 @@ def sequence_reverse(attrs, ctx, data, sequence_length=None):
     src = jnp.where(steps < lens, lens - 1 - steps, steps)  # [T, B]
     src = src.reshape((T, -1) + (1,) * (data.ndim - 2))
     return jnp.take_along_axis(data, src, axis=0)
+
+
+@register("softmax_cross_entropy", arg_names=("data", "label"))
+def softmax_cross_entropy(attrs, ctx, data, label):
+    """Scalar cross entropy of softmax(data) against integer labels
+    (reference loss_binary_op.cc:11-60)."""
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    idx = jnp.clip(label.astype(jnp.int32), 0, data.shape[-1] - 1)
+    picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)
+    return -jnp.sum(picked).reshape((1,)).astype(data.dtype)
+
+
+@register("IdentityAttachKLSparseReg", arg_names=("data",),
+          aux_names=("moving_avg",),
+          params={"sparseness_target": 0.1, "penalty": 0.001,
+                  "momentum": 0.9})
+def identity_attach_kl_sparse_reg(attrs, ctx, data, moving_avg):
+    """Identity forward; backward adds a KL sparseness penalty against a
+    running mean activation (identity_attach_KL_sparse_reg-inl.h:60-110;
+    pair with sigmoid activations).  The reference updates the running
+    mean during backward; here it updates on the training forward — the
+    same once-per-step cadence in functional form."""
+    s = float(attrs["sparseness_target"])
+    penalty = float(attrs["penalty"])
+    momentum = float(attrs["momentum"])
+    x2 = data.reshape((data.shape[0], -1)).astype(jnp.float32)
+    if ctx.is_train:
+        avg = jnp.mean(x2, axis=0)
+        new_ma = momentum * moving_avg.astype(jnp.float32) \
+            + (1 - momentum) * avg
+    else:
+        new_ma = moving_avg.astype(jnp.float32)
+    ma = lax.stop_gradient(new_ma)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, ()
+
+    def bwd(res, g):
+        reg = penalty * (-s / ma + (1 - s) / (1 - ma))
+        return ((g.reshape(x2.shape) + reg).reshape(g.shape).astype(g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f(data), new_ma.astype(moving_avg.dtype)
+
+
+@register("LSoftmax", arg_names=("data", "weight", "label"),
+          params={"num_hidden": 0, "margin": 2, "beta": 1.0,
+                  "beta_min": 0.0, "scale": 1.0, "verbose": False})
+def lsoftmax(attrs, ctx, data, weight, label):
+    """Large-margin softmax inner product (reference lsoftmax.cc /
+    lsoftmax.cu — GPU-only there; this jnp formulation runs on every
+    backend).  For the label class: f = |x||w| psi(theta) with
+    psi(theta) = (-1)^k cos(m*theta) - 2k on the monotone extension of
+    cos, blended with the plain product by beta/(1+beta).
+    """
+    m = int(attrs["margin"])
+    beta = float(attrs["beta"])
+    x = data.astype(jnp.float32)
+    w = weight.astype(jnp.float32)
+    out = x @ w.T                                     # (N, C)
+    if m == 1 or not ctx.is_train:
+        return out.astype(data.dtype)
+    n = x.shape[0]
+    y = jnp.clip(label.astype(jnp.int32), 0, w.shape[0] - 1)
+    wy = w[y]                                          # (N, D)
+    xn = jnp.linalg.norm(x, axis=1)
+    wn = jnp.linalg.norm(wy, axis=1)
+    fy = jnp.take_along_axis(out, y[:, None], axis=1)[:, 0]
+    cos = jnp.clip(fy / jnp.maximum(xn * wn, 1e-12), -1.0, 1.0)
+    # k such that theta in [k*pi/m, (k+1)*pi/m): count thresholds above cos
+    j = jnp.arange(1, m + 1, dtype=jnp.float32)
+    thresholds = jnp.cos(j * jnp.pi / m)               # (m,)
+    k = jnp.sum(cos[:, None] < thresholds[None, :], axis=1).astype(
+        jnp.float32)
+    k = lax.stop_gradient(k)
+    # cos(m*theta) via the Chebyshev polynomial T_m(cos theta)
+    theta = jnp.arccos(cos)
+    cos_m = jnp.cos(m * theta)
+    psi = ((-1.0) ** k) * cos_m - 2.0 * k
+    fy_new = (beta * fy + xn * wn * psi) / (1.0 + beta)
+    out = out.at[jnp.arange(n), y].set(fy_new)
+    return out.astype(data.dtype)

@@ -562,3 +562,20 @@ def _gen_neg_binomial(key, mu, alpha, shape):
     p = r / (r + mu)
     lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
     return jax.random.poisson(k2, lam, shape)
+
+
+@register("pick", arg_names=("data", "index"),
+          params={"axis": -1, "keepdims": False})
+def pick(attrs, ctx, data, index):
+    """Pick elements along ``axis`` by per-position indices (reference
+    tensor/broadcast_reduce_op_index.cc:96-140).  Out-of-range indices
+    clip to the last element (the reference's clip mode)."""
+    axis = int(attrs["axis"])
+    if axis < 0:
+        axis += data.ndim
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    idx = jnp.expand_dims(idx, axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not attrs["keepdims"]:
+        out = jnp.squeeze(out, axis=axis)
+    return out

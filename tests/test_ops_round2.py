@@ -1,0 +1,110 @@
+"""Ops closing the remaining reference registration sites: pick,
+softmax_cross_entropy, IdentityAttachKLSparseReg, LSoftmax.
+
+Reference: tensor/broadcast_reduce_op_index.cc (pick),
+loss_binary_op.cc (softmax_cross_entropy),
+identity_attach_KL_sparse_reg-inl.h, lsoftmax.cc/.cu.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState(5)
+
+
+def test_pick_forward_and_clip():
+    x = RNG.randn(4, 5).astype("f")
+    i = np.array([0, 4, 2, 9], "f")  # 9 clips to 4
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(i)).asnumpy()
+    want = x[np.arange(4), np.clip(i.astype(int), 0, 4)]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    out2 = mx.nd.pick(mx.nd.array(x), mx.nd.array(i), keepdims=True)
+    assert out2.shape == (4, 1)
+    # 3-d with axis 1
+    x3 = RNG.randn(2, 3, 4).astype("f")
+    i3 = RNG.randint(0, 3, (2, 4)).astype("f")
+    out3 = mx.nd.pick(mx.nd.array(x3), mx.nd.array(i3), axis=1).asnumpy()
+    want3 = np.take_along_axis(x3, i3.astype(int)[:, None, :], axis=1)[:, 0]
+    np.testing.assert_allclose(out3, want3, rtol=1e-6)
+
+
+def test_pick_grad():
+    """Gradient scatters into the picked slots (data input only — the
+    integer index input is non-differentiable)."""
+    x = RNG.randn(3, 4).astype("f")
+    i = np.array([1, 3, 0], "f")
+    data = nd.array(x)
+    idx = nd.array(i)
+    g = nd.zeros_like(data)
+    autograd.mark_variables([data], [g])
+    with autograd.record():
+        loss = nd.pick(data, idx).sum()
+    autograd.backward([loss])
+    want = np.zeros_like(x)
+    want[np.arange(3), i.astype(int)] = 1.0
+    np.testing.assert_allclose(g.asnumpy(), want, rtol=1e-6)
+
+
+def test_softmax_cross_entropy():
+    x = RNG.randn(4, 6).astype("f")
+    y = np.array([1, 5, 0, 3], "f")
+    out = float(mx.nd.softmax_cross_entropy(
+        mx.nd.array(x), mx.nd.array(y)).asnumpy()[0])
+    e = np.exp(x - x.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    want = -np.sum(np.log(p[np.arange(4), y.astype(int)]))
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_kl_sparse_reg_identity_and_penalty():
+    x = RNG.uniform(0.05, 0.95, (8, 6)).astype("f")
+    data = nd.array(x)
+    g = nd.zeros_like(data)
+    autograd.mark_variables([data], [g])
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(
+            data, nd.zeros((6,)), sparseness_target=0.2, penalty=0.01,
+            momentum=0.0)
+        loss = out.sum()
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)  # identity fwd
+    autograd.backward([loss])
+    # momentum 0 -> moving avg = batch mean; grad = 1 + penalty*KL'
+    ma = x.mean(axis=0)
+    reg = 0.01 * (-0.2 / ma + 0.8 / (1 - ma))
+    np.testing.assert_allclose(
+        g.asnumpy(), np.broadcast_to(1.0 + reg[None, :], x.shape),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_lsoftmax_margin1_is_linear_and_margin_penalizes():
+    x = RNG.randn(5, 8).astype("f")
+    w = RNG.randn(4, 8).astype("f")
+    y = np.array([0, 1, 2, 3, 1], "f")
+    plain = mx.nd.LSoftmax(mx.nd.array(x), mx.nd.array(w), mx.nd.array(y),
+                           num_hidden=4, margin=1).asnumpy()
+    np.testing.assert_allclose(plain, x @ w.T, rtol=1e-5)
+    # training-mode margin=2/beta=0: label-class logit is psi(theta) scaled,
+    # always <= the plain inner product
+    from mxnet_tpu.ops.registry import OpContext, get_op
+    import jax.numpy as jnp
+    op = get_op("LSoftmax")
+    attrs = op.parse_attrs({"num_hidden": 4, "margin": 2, "beta": 0.0})
+    out = np.asarray(op.fcompute(attrs, OpContext(is_train=True),
+                                 jnp.asarray(x), jnp.asarray(w),
+                                 jnp.asarray(y)))
+    yi = y.astype(int)
+    assert (out[np.arange(5), yi] <= plain[np.arange(5), yi] + 1e-5).all()
+    others = np.ones_like(plain, bool)
+    others[np.arange(5), yi] = False
+    np.testing.assert_allclose(out[others], plain[others], rtol=1e-5)
+    # psi identity check: psi = (-1)^k cos(2t) - 2k reproduces the output
+    xn = np.linalg.norm(x, axis=1)
+    wn = np.linalg.norm(w[yi], axis=1)
+    cos = (x @ w.T)[np.arange(5), yi] / (xn * wn)
+    t = np.arccos(np.clip(cos, -1, 1))
+    k = (t > np.pi / 2).astype(int)
+    psi = ((-1.0) ** k) * np.cos(2 * t) - 2 * k
+    np.testing.assert_allclose(out[np.arange(5), yi], xn * wn * psi,
+                               rtol=1e-4)
