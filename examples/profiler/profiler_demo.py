@@ -1,0 +1,51 @@
+"""Profiler demo: Chrome-trace capture of a training step.
+
+Reference: ``example/profiler/profiler_executor.py`` — set the profiler
+state around a few executor steps and dump a trace-event JSON that
+chrome://tracing (or Perfetto) loads.  On TPU, set
+``MXNET_PROFILER_XLA_DIR`` to also capture an xprof trace of the device
+timeline.
+
+    python profiler_demo.py [--output profile.json]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--output", default="profile.json")
+    p.add_argument("--steps", type=int, default=5)
+    args = p.parse_args()
+
+    net = models.get_model("lenet", num_classes=10)
+    ex = net.simple_bind(mx.current_context(), data=(32, 1, 28, 28),
+                         softmax_label=(32,))
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            mx.initializer.Xavier()(k, v)
+
+    mx.profiler.profiler_set_config(mode="all", filename=args.output)
+    mx.profiler.profiler_set_state("run")
+    x = np.random.rand(32, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, 32).astype(np.float32)
+    for _ in range(args.steps):
+        ex.forward_backward(data=x, softmax_label=y)
+    ex.outputs[0].wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    print("wrote", args.output)
+
+
+if __name__ == "__main__":
+    main()

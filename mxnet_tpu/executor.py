@@ -288,6 +288,11 @@ class Executor:
     def forward_backward(self, **kwargs):
         """Fused training step: outputs + gradients in one XLA program.
         Equivalent to forward(is_train=True) followed by backward()."""
+        from . import profiler
+        with profiler.record_scope("forward_backward", category="executor"):
+            return self._forward_backward(**kwargs)
+
+    def _forward_backward(self, **kwargs):
         if self._monitor_callback is not None:
             self.forward(is_train=True, **kwargs)
             self.backward()
@@ -323,6 +328,11 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         """Run the forward graph.  kwargs update named input arrays
         (reference python/mxnet/executor.py:95)."""
+        from . import profiler
+        with profiler.record_scope("forward", category="executor"):
+            return self._forward(is_train, **kwargs)
+
+    def _forward(self, is_train=False, **kwargs):
         import numpy as np
         for k, v in kwargs.items():
             if k not in self.arg_dict:

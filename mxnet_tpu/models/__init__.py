@@ -11,15 +11,27 @@ from __future__ import annotations
 import importlib
 
 _MODELS = ("mlp", "lenet", "alexnet", "vgg", "resnet", "inception_bn",
-           "inception_v3", "googlenet")
+           "inception_v3", "inception_resnet_v2", "googlenet", "resnext")
 
 
 def get_model(name, **kwargs):
     """Build a symbol by model name (aliases: inception-bn -> inception_bn,
-    resnet-50 -> resnet(num_layers=50))."""
+    resnet-50 -> resnet(num_layers=50), resnext-101-64x4d)."""
     name = name.replace("-", "_")
+    if name.startswith("resnext") and name != "resnext":
+        # resnext_101_64x4d style names: depth then cardinality x width
+        parts = name.split("_")[1:]
+        if parts:
+            kwargs.setdefault("num_layers", int(parts[0]))
+        if len(parts) > 1 and "x" in parts[1]:
+            g, w = parts[1].split("x")
+            kwargs.setdefault("num_group", int(g))
+            kwargs.setdefault("bottleneck_width", int(w.rstrip("d")))
+        name = "resnext"
     if name.startswith("resnet") and name != "resnet":
-        kwargs.setdefault("num_layers", int(name[len("resnet"):]))
+        # accepts resnet50 and resnet-50 (-> resnet_50) spellings
+        kwargs.setdefault("num_layers",
+                          int(name[len("resnet"):].lstrip("_")))
         name = "resnet"
     if name.startswith("vgg") and name != "vgg":
         kwargs.setdefault("num_layers", int(name[len("vgg"):]))

@@ -97,7 +97,9 @@ def imperative_invoke(op_name, *args, out=None, name=None, **kwargs):
 
     fn = _jitted(op.name, _hashable_attrs(attrs), len(raw), is_train,
                  key is not None)
-    outs = fn(key, *raw)
+    from .. import profiler
+    with profiler.record_scope(op_name, imperative=True):
+        outs = fn(key, *raw)
 
     n_vis = op.get_num_outputs(attrs)
     n_aux = len(aux_names)

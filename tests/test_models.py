@@ -63,6 +63,57 @@ def test_inception_v3_infer():
     assert out_shapes == [(1, 1000)]
 
 
+def test_inception_resnet_v2_infer():
+    net = models.get_model("inception_resnet_v2", num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes == [(1, 1000)]
+
+
+def test_resnext_infer_and_grouping():
+    """resnext-101-64x4d (the reference's published 0.7911 top-1 config)
+    infers; the 3x3 convs carry the cardinality grouping with the 64x4d
+    bottleneck width (stage-1 mid channels = 64 groups x 4 = 256)."""
+    net = models.get_model("resnext-101-64x4d", num_classes=1000)
+    args = net.list_arguments()
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 1000)]
+    d = dict(zip(args, arg_shapes))
+    # grouped conv weight: (num_filter, C/in_group, 3, 3)
+    w = d["stage1_unit1_conv2_weight"]
+    assert w == (256, 4, 3, 3)  # 64 groups x 4-wide
+
+
+def test_resnet_v1_infer():
+    """version=1 builds the post-activation net: stride on the 1x1
+    reduce conv, no bn_data, no v2 tail BN (resnet-v1-fp16.py layout)."""
+    net = models.get_model("resnet50", version=1, num_classes=1000)
+    args = net.list_arguments()
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 1000)]
+    d = dict(zip(args, arg_shapes))
+    assert "bn_data_gamma" not in d and "bn1_gamma" not in d
+    # v1 shortcut carries its own BN
+    assert "stage1_unit1_sc_bn_gamma" in d
+    # non-bottleneck variant builds too
+    small = models.get_model("resnet18", version=1, num_classes=10,
+                             image_shape="3,32,32")
+    _, out, _ = small.infer_shape(data=(1, 3, 32, 32))
+    assert out == [(1, 10)]
+    # resnet-50 dashed alias parses
+    assert models.get_model("resnet-50", num_classes=10) is not None
+    # small variant runs forward
+    small = models.get_model("resnext", num_layers=50, num_classes=7,
+                             num_group=8, image_shape="3,64,64")
+    ex = small.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 64, 64),
+                           softmax_label=(2,))
+    for k, v in ex.aux_dict.items():
+        if k.endswith("moving_var"):
+            v[:] = 1.0
+    out = ex.forward(is_train=False,
+                     data=np.zeros((2, 3, 64, 64), "f"))[0]
+    assert out.shape == (2, 7)
+
+
 def test_predictor_roundtrip(tmp_path):
     """c_predict_api analogue: save checkpoint, predict from files."""
     import os

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Kill stray training processes on every host of a job.
+
+Reference: ``tools/kill-mxnet.py`` (ssh each host in the hostfile and
+kill the named program).  Works against the hosts format
+``tools/launch.py --launcher ssh`` consumes; with no hostfile it cleans
+up local workers (the --launcher local case).
+
+    python tools/kill_mxnet.py [--hostfile hosts] [--prog train_imagenet]
+"""
+from __future__ import annotations
+
+import argparse
+import getpass
+import subprocess
+
+
+def kill_cmd(user, prog, self_pid=None):
+    # exclude this script's own process (its argv contains the pattern)
+    guard = " && $2!=%d" % self_pid if self_pid else ""
+    return ("ps aux | grep -v grep | grep -v kill_mxnet | grep '%s' | "
+            "awk '{if($1==\"%s\"%s)print $2;}' | xargs -r kill -9"
+            % (prog, user, guard))
+
+
+def main():
+    p = argparse.ArgumentParser(description="kill distributed workers")
+    p.add_argument("--hostfile", help="one host per line; omit for local")
+    p.add_argument("--user", default=getpass.getuser())
+    p.add_argument("--prog", default="mxnet_tpu",
+                   help="process-name pattern to kill")
+    args = p.parse_args()
+    if not args.hostfile:
+        import os
+        subprocess.run(kill_cmd(args.user, args.prog, os.getpid()),
+                       shell=True)
+        return
+    cmd = kill_cmd(args.user, args.prog)
+    with open(args.hostfile) as f:
+        for line in f:
+            host = line.strip()
+            if not host:
+                continue
+            print("killing %r on %s" % (args.prog, host))
+            subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no", host,
+                            cmd])
+
+
+if __name__ == "__main__":
+    main()
