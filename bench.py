@@ -53,7 +53,8 @@ def main():
         optimizer=os.environ.get("BENCH_OPTIMIZER", "sgd"),
         learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
         dtype=os.environ.get("BENCH_DTYPE", "bfloat16"),
-        layout=os.environ.get("BENCH_LAYOUT", "NHWC"))
+        layout=os.environ.get("BENCH_LAYOUT", "NHWC"),
+        auto_layouts=os.environ.get("BENCH_AUTO_LAYOUT", "1") == "1")
 
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)

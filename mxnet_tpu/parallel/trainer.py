@@ -138,7 +138,8 @@ class ShardedTrainer:
     def __init__(self, symbol, mesh, data_shapes, label_shapes=(),
                  optimizer="sgd", optimizer_params=None, learning_rate=0.05,
                  momentum=0.9, weight_decay=0.0, initializer=None,
-                 dtype="float32", tp_rules=None, seed=0, layout=None):
+                 dtype="float32", tp_rules=None, seed=0, layout=None,
+                 auto_layouts=False):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -165,6 +166,10 @@ class ShardedTrainer:
         self.symbol = symbol
         self.mesh = mesh
         self.dtype = dtype
+        # auto_layouts: let XLA choose persistent param/state layouts
+        # (Layout.AUTO) instead of jit's default-pinned I/O layouts —
+        # kills the per-step relayout copies (docs/perf.md)
+        self._auto_layouts = bool(auto_layouts)
         if layout not in (None, "NCHW", "NHWC"):
             raise MXNetError("unsupported layout %r" % (layout,))
         self._layout = layout or "NCHW"
@@ -431,6 +436,8 @@ class ShardedTrainer:
 
         state_sharding = {n: [self._param_sharding[n]] * self._n_slots
                           for n in self._param_names}
+        if self._auto_layouts:
+            return self._compile_auto_layout(step, state_sharding)
         in_shardings = (self._param_sharding, state_sharding,
                         self._aux_sharding, self._batch_sharding,
                         None, None, None)
@@ -439,6 +446,59 @@ class ShardedTrainer:
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings,
                        donate_argnums=(0, 1, 2))
+
+    def _compile_auto_layout(self, step, state_sharding):
+        """Compile the step with XLA-chosen parameter/state layouts.
+
+        jit pins donated I/O to default layouts, so every step pays
+        per-weight relayout copies between the conv-preferred tilings
+        and the I/O layout (docs/perf.md "copies" bucket).  With
+        Layout.AUTO on the persistent state, XLA keeps params/opt/aux
+        in its preferred tilings ACROSS steps (the state is donated, so
+        the layout round-trips for free); the one-time device_put below
+        migrates the live state into the chosen formats.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.layout import Format, Layout
+
+        def auto_of(sharding_tree):
+            return jax.tree.map(lambda s: Format(Layout.AUTO, s),
+                                sharding_tree,
+                                is_leaf=lambda x: hasattr(x, "spec"))
+
+        in_shardings = (auto_of(self._param_sharding),
+                        auto_of(state_sharding),
+                        auto_of(self._aux_sharding),
+                        self._batch_sharding, None, None, None)
+        out_shardings = (auto_of(self._param_sharding),
+                         auto_of(state_sharding),
+                         auto_of(self._aux_sharding), None)
+        jf = jax.jit(step, in_shardings=in_shardings,
+                     out_shardings=out_shardings, donate_argnums=(0, 1, 2))
+        # _input_shapes are already layout-converted; stage zeros directly
+        # (put_batch would transpose a host NCHW batch a second time)
+        zero_batch = {
+            n: jax.device_put(
+                jnp.zeros(s, jnp.dtype(self.dtype)
+                          if "label" not in n else jnp.float32),
+                self._batch_sharding[n])
+            for n, s in self._input_shapes.items()}
+        def as_spec(tree):
+            # AUTO-layout args must be abstract at lower time
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+        example = (as_spec(self.params), as_spec(self.opt_state),
+                   as_spec(self.aux), zero_batch, jax.random.PRNGKey(0),
+                   jnp.float32(0.0), jnp.float32(1.0))
+        compiled = jf.lower(*example).compile()
+        fmts = compiled.input_formats[0]
+        # migrate live state into the chosen layouts (one-time copies)
+        self.params = jax.device_put(self.params, fmts[0])
+        self.opt_state = jax.device_put(self.opt_state, fmts[1])
+        self.aux = jax.device_put(self.aux, fmts[2])
+        return compiled
 
     # ------------------------------------------------------------------ api
     def _cast_batch(self, batch):

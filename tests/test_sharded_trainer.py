@@ -219,3 +219,36 @@ def test_bench_script_cpu_smoke(monkeypatch, capsys):
     line = capsys.readouterr().out.strip().splitlines()[-1]
     rec = _json.loads(line)
     assert rec["unit"] == "img/s/chip" and rec["value"] > 0
+
+
+def test_auto_layouts_matches_default():
+    """auto_layouts=True (XLA-chosen persistent param layouts) trains
+    identically to the default-layout step."""
+    np.random.seed(0)
+
+    def build(auto):
+        np.random.seed(11)  # identical initializer draws for both builds
+        data = mx.sym.Variable("data")
+        net = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=4, name="c1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, global_pool=True, pool_type="avg")
+        net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                    name="fc")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mesh = build_mesh(tp=1)
+        return ShardedTrainer(net, mesh, data_shapes={"data": (8, 3, 8, 8)},
+                              label_shapes={"softmax_label": (8,)},
+                              learning_rate=0.1, seed=3,
+                              auto_layouts=auto)
+
+    batch = _batch(classes=3)
+    t0, t1 = build(False), build(True)
+    for _ in range(3):
+        l0 = float(t0.step(batch))
+        l1 = float(t1.step(batch))
+    np.testing.assert_allclose(l1, l0, rtol=1e-5)
+    for k in t0.params:
+        np.testing.assert_allclose(np.asarray(t1.params[k]),
+                                   np.asarray(t0.params[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
