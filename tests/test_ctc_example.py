@@ -1,0 +1,26 @@
+"""LSTM+CTC toy OCR converges (reference example/warpctc/lstm_ocr.py
+role: CTC-aligned sequence recognition through the Module API)."""
+import logging
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "examples", "ctc"))
+
+
+@pytest.mark.slow
+def test_lstm_ctc_learns():
+    import lstm_ocr
+    logging.disable(logging.INFO)
+    try:
+        mod, acc = lstm_ocr.train(epochs=10, batch_size=32, n_train=384,
+                                  lr=0.015)
+    finally:
+        logging.disable(logging.NOTSET)
+    # an untrained decoder scores ~1e-4 exact-match on 4-digit
+    # sequences; 0.3 is far outside chance while robust to
+    # run-to-run optimization variance
+    assert acc > 0.3, acc

@@ -1,0 +1,35 @@
+"""DCGAN example: the adversarial Module flow end-to-end.
+
+Reference: example/gan/dcgan.py — exercises inputs_need_grad,
+get_input_grads, head-grad backward, and cross-forward gradient
+accumulation through the Module API.
+"""
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "examples", "gan"))
+
+
+def test_dcgan_trains():
+    import logging
+    import dcgan
+    logging.disable(logging.INFO)
+    try:
+        modG, modD, history = dcgan.train(
+            epochs=2, batch_size=16, size=16, ngf=16, ndf=16,
+            n_images=64, log_every=2)
+    finally:
+        logging.disable(logging.NOTSET)
+    assert history, "no metric points recorded"
+    assert all(np.isfinite(h) for h in history)
+    # adversarial accuracy is noisy by design; assert the flow ran sanely
+    # (convergence behavior is the example's demo, not a CI invariant)
+    assert 0.1 < np.mean(history) < 1.0, history
+    # both networks actually updated
+    gp, _ = modG.get_params()
+    dp, _ = modD.get_params()
+    assert any(np.abs(v.asnumpy()).max() > 0 for v in gp.values())
+    assert any(np.abs(v.asnumpy()).max() > 0 for v in dp.values())
