@@ -1,0 +1,86 @@
+"""Sorting short integer sequences with a bidirectional LSTM.
+
+Reference: ``example/bi-lstm-sort/lstm_sort.py`` — sequence-to-sequence
+sorting (input: k numbers, target: the same numbers sorted), learned by a
+``BidirectionalCell`` over embeddings with a shared per-timestep softmax
+head.  The task needs both directions: the value at output position t
+depends on the whole input.
+
+    python lstm_sort.py --epochs 10
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_sym(seq_len, vocab_size, num_hidden=64, num_embed=32):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="lstm_l_"),
+        mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="lstm_r_"))
+    outputs, _ = bi.unroll(seq_len, inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, 2 * num_hidden))
+    pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                 name="pred")
+    label = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+
+
+def sort_dataset(n, seq_len, vocab_size, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, vocab_size, (n, seq_len))
+    y = np.sort(x, axis=1)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def element_accuracy(mod, it):
+    """Fraction of output positions predicted exactly right."""
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy()
+        lab = batch.label[0].asnumpy().ravel().astype(np.int64)
+        correct += (np.argmax(pred, axis=1) == lab).sum()
+        total += lab.size
+    return correct / total
+
+
+def train(epochs=10, batch_size=50, seq_len=5, vocab_size=30,
+          num_hidden=64, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    xtr, ytr = sort_dataset(5000, seq_len, vocab_size, seed=0)
+    xte, yte = sort_dataset(500, seq_len, vocab_size, seed=1)
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size, shuffle=True)
+    test_iter = mx.io.NDArrayIter(xte, yte, batch_size)
+
+    net = make_sym(seq_len, vocab_size, num_hidden=num_hidden)
+    mod = mx.module.Module(net, context=ctx)
+    mod.fit(train_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 3e-3},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(batch_size, 50))
+    acc = element_accuracy(mod, test_iter)
+    logging.info("per-position sort accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    a = p.parse_args()
+    train(epochs=a.epochs)

@@ -91,7 +91,8 @@ def train(epochs=2, batch_size=32, size=32, ngf=32, ndf=32, nc=1, z=64,
           lr=2e-4, beta1=0.5, n_images=256, ctx=None, log_every=4):
     import math
     n_up = int(math.log2(size // 4)) + 1
-    assert 4 * 2 ** (n_up - 1) == size, "size must be 4*2^k"
+    assert n_up >= 2 and 4 * 2 ** (n_up - 1) == size, \
+        "size must be 4*2^k with k >= 1 (>= 8)"
     symG, symD = make_dcgan_sym(ngf, ndf, nc, n_up=n_up)
     ctx = ctx or mx.current_context()
 

@@ -1,0 +1,130 @@
+"""Multi-task training: one trunk, two softmax heads, per-head metrics.
+
+Reference: ``example/multi-task/example_multi_task.py`` — a Group of two
+``SoftmaxOutput`` heads trained jointly, a wrapping iterator that serves
+one label per head, and a multi-accuracy metric indexed per output.
+
+    python example_multi_task.py --epochs 5
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def build_network(num_classes=10):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(data=fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(data=act2, name="fc3",
+                                num_hidden=num_classes)
+    sm1 = mx.sym.SoftmaxOutput(data=fc3, name="softmax1")
+    # second task: coarse parity of the digit (num_classes//2 way)
+    fc4 = mx.sym.FullyConnected(data=act2, name="fc4", num_hidden=2)
+    sm2 = mx.sym.SoftmaxOutput(data=fc4, name="softmax2")
+    return mx.sym.Group([sm1, sm2])
+
+
+class MultiTaskIter(mx.io.DataIter):
+    """Serves (label, label % 2) for the two heads."""
+
+    def __init__(self, data_iter):
+        super().__init__()
+        self.data_iter = data_iter
+        self.batch_size = data_iter.batch_size
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        name, shape = (self.data_iter.provide_label[0].name,
+                       self.data_iter.provide_label[0].shape)
+        return [mx.io.DataDesc("softmax1_label", shape),
+                mx.io.DataDesc("softmax2_label", shape)]
+
+    def reset(self):
+        self.data_iter.reset()
+
+    def next(self):
+        batch = self.data_iter.next()
+        label = batch.label[0]
+        parity = mx.nd.array(label.asnumpy() % 2)
+        return mx.io.DataBatch(data=batch.data, label=[label, parity],
+                               pad=batch.pad, index=batch.index)
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-output accuracy vector (reference Multi_Accuracy)."""
+
+    def __init__(self, num):
+        super().__init__("multi-accuracy", num=num)
+
+    def reset(self):
+        self.sum_metric = [0.0] * self.num
+        self.num_inst = [0] * self.num
+
+    def update(self, labels, preds):
+        assert len(labels) == self.num == len(preds)
+        for i in range(self.num):
+            pred = np.argmax(preds[i].asnumpy(), axis=1)
+            lab = labels[i].asnumpy().astype(np.int64)
+            self.sum_metric[i] += (pred.ravel() == lab.ravel()).sum()
+            self.num_inst[i] += len(lab.ravel())
+
+    def get(self):
+        accs = [s / max(n, 1) for s, n in
+                zip(self.sum_metric, self.num_inst)]
+        return (["task%d-accuracy" % i for i in range(self.num)], accs)
+
+
+def synthetic(n, dim=64, classes=10, seed=0):
+    protos = np.random.RandomState(42).randn(
+        classes, dim).astype(np.float32) * 1.5
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = protos[y] + rng.randn(n, dim).astype(np.float32) * 0.5
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def train(epochs=5, batch_size=100, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    x, y = synthetic(4000)
+    xv, yv = synthetic(1000, seed=1)
+    train_iter = MultiTaskIter(mx.io.NDArrayIter(x, y, batch_size,
+                                                 shuffle=True))
+    val_iter = MultiTaskIter(mx.io.NDArrayIter(xv, yv, batch_size))
+
+    mod = mx.module.Module(build_network(), context=ctx,
+                           label_names=("softmax1_label",
+                                        "softmax2_label"))
+    metric = MultiAccuracy(num=2)
+    mod.fit(train_iter, eval_data=val_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric=metric,
+            batch_end_callback=mx.callback.Speedometer(batch_size, 20))
+    val_metric = MultiAccuracy(num=2)
+    res = dict(mod.score(val_iter, val_metric))
+    logging.info("validation: %s", res)
+    return res
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    a = p.parse_args()
+    train(epochs=a.epochs)

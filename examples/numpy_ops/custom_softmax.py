@@ -1,0 +1,100 @@
+"""A softmax output layer written in numpy as a CustomOp.
+
+Reference: ``example/numpy-ops/custom_softmax.py`` — the operator's
+forward/backward run as host callbacks (numpy), while everything around
+them stays compiled; same flow the reference drives through
+``MXCustomOpRegister`` engine callbacks.
+
+    python custom_softmax.py --epochs 5
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], y)
+
+
+@mx.operator.register("demo_softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def make_net():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=64)
+    act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=10)
+    return mx.sym.Custom(data=fc2, label=label, name="softmax",
+                         op_type="demo_softmax")
+
+
+def synthetic(n, dim=64, classes=10, seed=0):
+    protos = np.random.RandomState(42).randn(
+        classes, dim).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = protos[y] + 0.3 * rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def train(epochs=5, batch_size=64, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    x, y = synthetic(2560)
+    xv, yv = synthetic(512, seed=1)
+    mod = mx.module.Module(make_net(), context=ctx,
+                           label_names=("softmax_label",))
+    mod.fit(mx.io.NDArrayIter(x, y, batch_size, shuffle=True),
+            eval_data=mx.io.NDArrayIter(xv, yv, batch_size),
+            num_epoch=epochs, initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    acc = mod.score(mx.io.NDArrayIter(xv, yv, batch_size),
+                    mx.metric.Accuracy())[0][1]
+    logging.info("validation accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    a = p.parse_args()
+    train(epochs=a.epochs)

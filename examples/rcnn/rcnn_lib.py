@@ -1,0 +1,273 @@
+"""Faster R-CNN building blocks: anchors, box transforms, RPN anchor
+targets, and the proposal-target sampler.
+
+Reference: ``example/rcnn/rcnn/processing/{generate_anchor.py,
+bbox_transform.py}``, ``rcnn/io/rpn.py`` (assign_anchor) and
+``rcnn/symbol/proposal_target.py`` — the host-side half of the detector;
+the device-side ops (Proposal, ROIPooling, smooth_l1) are framework ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+# -------------------------------------------------------------- anchors
+def generate_anchors(base_size=16, ratios=(0.5, 1, 2), scales=(8, 16, 32)):
+    """Window-centered anchor set (generate_anchor.py semantics)."""
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w, h = base[2] - base[0] + 1, base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    anchors = []
+    size = w * h
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return np.array(anchors, np.float32)
+
+
+# ------------------------------------------------------ box transforms
+def bbox_transform(ex_rois, gt_rois):
+    """Regression targets (dx, dy, dw, dh) from ex boxes to gt boxes."""
+    ew = ex_rois[:, 2] - ex_rois[:, 0] + 1.0
+    eh = ex_rois[:, 3] - ex_rois[:, 1] + 1.0
+    ecx = ex_rois[:, 0] + 0.5 * (ew - 1)
+    ecy = ex_rois[:, 1] + 0.5 * (eh - 1)
+    gw = gt_rois[:, 2] - gt_rois[:, 0] + 1.0
+    gh = gt_rois[:, 3] - gt_rois[:, 1] + 1.0
+    gcx = gt_rois[:, 0] + 0.5 * (gw - 1)
+    gcy = gt_rois[:, 1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                     np.log(gw / ew), np.log(gh / eh)], axis=1)
+
+
+def bbox_pred(boxes, deltas):
+    """Inverse transform: apply (dx, dy, dw, dh) deltas to boxes."""
+    if boxes.shape[0] == 0:
+        return np.zeros((0, deltas.shape[1]), deltas.dtype)
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1)
+    cy = boxes[:, 1] + 0.5 * (h - 1)
+    pred = np.zeros_like(deltas)
+    for k in range(deltas.shape[1] // 4):
+        dx, dy, dw, dh = (deltas[:, 4 * k + i] for i in range(4))
+        pcx, pcy = dx * w + cx, dy * h + cy
+        pw, ph = np.exp(dw) * w, np.exp(dh) * h
+        pred[:, 4 * k] = pcx - 0.5 * (pw - 1)
+        pred[:, 4 * k + 1] = pcy - 0.5 * (ph - 1)
+        pred[:, 4 * k + 2] = pcx + 0.5 * (pw - 1)
+        pred[:, 4 * k + 3] = pcy + 0.5 * (ph - 1)
+    return pred
+
+
+def clip_boxes(boxes, im_shape):
+    """Clip (x1, y1, x2, y2[, ...]) to image (h, w)."""
+    boxes = boxes.copy()
+    boxes[:, 0::4] = np.clip(boxes[:, 0::4], 0, im_shape[1] - 1)
+    boxes[:, 1::4] = np.clip(boxes[:, 1::4], 0, im_shape[0] - 1)
+    boxes[:, 2::4] = np.clip(boxes[:, 2::4], 0, im_shape[1] - 1)
+    boxes[:, 3::4] = np.clip(boxes[:, 3::4], 0, im_shape[0] - 1)
+    return boxes
+
+
+def iou_matrix(a, b):
+    """(len(a), len(b)) IoU with the +1 pixel convention."""
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    iw = (np.minimum(a[:, None, 2], b[None, :, 2]) -
+          np.maximum(a[:, None, 0], b[None, :, 0]) + 1).clip(0)
+    ih = (np.minimum(a[:, None, 3], b[None, :, 3]) -
+          np.maximum(a[:, None, 1], b[None, :, 1]) + 1).clip(0)
+    inter = iw * ih
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+def nms(dets, thresh):
+    """Greedy NMS on (x1, y1, x2, y2, score) rows; returns kept indices."""
+    if len(dets) == 0:
+        return []
+    order = dets[:, 4].argsort()[::-1]
+    iou = iou_matrix(dets[:, :4], dets[:, :4])
+    keep = []
+    suppressed = np.zeros(len(dets), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > thresh
+    return keep
+
+
+# ------------------------------------------------- RPN anchor targets
+def assign_anchor(feat_shape, gt_boxes, im_info, feat_stride,
+                  scales, ratios, allowed_border=0, rpn_batch_size=64,
+                  fg_fraction=0.5, pos_thresh=0.7, neg_thresh=0.3,
+                  rng=None):
+    """RPN training targets for one image (rpn.py assign_anchor):
+    label 1 = fg (IoU >= pos_thresh or argmax per gt), 0 = bg, -1 =
+    ignore; subsampled to rpn_batch_size; bbox targets toward the
+    best-overlap gt.
+
+    Returns label (A*H*W,), bbox_target (A*H*W, 4), bbox_weight
+    (A*H*W, 4) in index order h*(W*A) + w*A + a (the Proposal op's
+    enumeration and the (2A, H, W) channel layout's flattening).
+    """
+    rng = rng or np.random
+    height, width = feat_shape
+    base = generate_anchors(feat_stride, ratios, scales)
+    A = len(base)
+    sx = np.arange(width) * feat_stride
+    sy = np.arange(height) * feat_stride
+    shift = np.stack(np.broadcast_arrays(
+        sx[None, :, None], sy[:, None, None],
+        sx[None, :, None], sy[:, None, None]), axis=-1).astype(np.float32)
+    anchors = (base[None, None] + shift).reshape(-1, 4)   # h, w, a order
+    total = len(anchors)
+
+    inside = ((anchors[:, 0] >= -allowed_border) &
+              (anchors[:, 1] >= -allowed_border) &
+              (anchors[:, 2] < im_info[1] + allowed_border) &
+              (anchors[:, 3] < im_info[0] + allowed_border))
+    label = np.full(total, -1, np.float32)
+    bbox_target = np.zeros((total, 4), np.float32)
+    bbox_weight = np.zeros((total, 4), np.float32)
+
+    valid_gt = gt_boxes[gt_boxes[:, 4] >= 0][:, :4] if len(gt_boxes) \
+        else np.zeros((0, 4), np.float32)
+    if len(valid_gt):
+        iou = iou_matrix(anchors, valid_gt)
+        best_gt = iou.argmax(1)
+        best_iou = iou.max(1)
+        label[inside & (best_iou < neg_thresh)] = 0
+        # anchors with best overlap per gt are fg even below pos_thresh
+        per_gt_best = iou.argmax(0)
+        label[per_gt_best] = 1
+        label[inside & (best_iou >= pos_thresh)] = 1
+        label[~inside] = -1
+        fg_idx = np.where(label == 1)[0]
+        bbox_target[fg_idx] = bbox_transform(anchors[fg_idx],
+                                             valid_gt[best_gt[fg_idx]])
+        bbox_weight[fg_idx] = 1.0
+    else:
+        label[inside] = 0
+
+    # subsample to the rpn batch
+    fg = np.where(label == 1)[0]
+    max_fg = int(fg_fraction * rpn_batch_size)
+    if len(fg) > max_fg:
+        label[rng.choice(fg, len(fg) - max_fg, replace=False)] = -1
+    bg = np.where(label == 0)[0]
+    max_bg = rpn_batch_size - min(len(fg), max_fg)
+    if len(bg) > max_bg:
+        label[rng.choice(bg, len(bg) - max_bg, replace=False)] = -1
+    bbox_weight[label != 1] = 0.0
+    return label, bbox_target, bbox_weight
+
+
+# --------------------------------------------- proposal-target sampler
+class ProposalTarget(mx.operator.CustomOp):
+    """Sample rois into a fixed Fast-RCNN batch with class labels and
+    per-class bbox regression targets (proposal_target.py)."""
+
+    def __init__(self, num_classes, batch_rois, fg_fraction, fg_thresh,
+                 bg_thresh_hi):
+        super().__init__()
+        self.num_classes = num_classes
+        self.batch_rois = batch_rois
+        self.fg_fraction = fg_fraction
+        self.fg_thresh = fg_thresh
+        self.bg_thresh_hi = bg_thresh_hi
+        self.rng = np.random.RandomState(0)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()          # (N, 5) batch_idx, x1..y2
+        gt = in_data[1].asnumpy()            # (G, 5) x1..y2, cls (pad<0)
+        gt = gt[gt[:, 4] >= 0]
+        R = self.batch_rois
+        all_boxes = rois[:, 1:5]
+        if len(gt):
+            # gt boxes are candidate rois too (proposal_target.py)
+            all_boxes = np.vstack([all_boxes, gt[:, :4]])
+            iou = iou_matrix(all_boxes, gt[:, :4])
+            best = iou.argmax(1)
+            best_iou = iou.max(1)
+            cls = gt[best, 4] + 1            # 0 reserved for background
+        else:
+            best_iou = np.zeros(len(all_boxes), np.float32)
+            best = np.zeros(len(all_boxes), np.int64)
+            cls = np.zeros(len(all_boxes), np.float32)
+
+        fg = np.where(best_iou >= self.fg_thresh)[0]
+        bg = np.where(best_iou < min(self.bg_thresh_hi,
+                                     self.fg_thresh))[0]
+        n_fg = min(len(fg), int(self.fg_fraction * R))
+        if len(fg) > n_fg:
+            fg = self.rng.choice(fg, n_fg, replace=False)
+        n_bg = R - n_fg
+        if len(bg) > n_bg:
+            bg = self.rng.choice(bg, n_bg, replace=False)
+        elif len(bg) > 0:
+            bg = self.rng.choice(bg, n_bg, replace=True)
+        else:
+            bg = np.zeros(n_bg, np.int64)
+        keep = np.concatenate([fg, bg]).astype(np.int64)
+
+        out_rois = np.zeros((R, 5), np.float32)
+        out_rois[:, 1:5] = all_boxes[keep]
+        label = cls[keep].copy()
+        label[n_fg:] = 0
+        target = np.zeros((R, 4 * self.num_classes), np.float32)
+        weight = np.zeros((R, 4 * self.num_classes), np.float32)
+        if len(gt) and n_fg > 0:
+            t = bbox_transform(all_boxes[keep[:n_fg]],
+                               gt[best[keep[:n_fg]], :4])
+            for i in range(n_fg):
+                c = int(label[i])
+                target[i, 4 * c:4 * c + 4] = t[i]
+                weight[i, 4 * c:4 * c + 4] = 1.0
+        self.assign(out_data[0], req[0], out_rois)
+        self.assign(out_data[1], req[1], label)
+        self.assign(out_data[2], req[2], target)
+        self.assign(out_data[3], req[3], weight)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for i in range(len(in_grad)):
+            self.assign(in_grad[i], req[i],
+                        np.zeros(in_grad[i].shape, np.float32))
+
+
+@mx.operator.register("proposal_target")
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    def __init__(self, num_classes, batch_rois=32, fg_fraction=0.5,
+                 fg_thresh=0.5, bg_thresh_hi=0.5):
+        super().__init__(need_top_grad=False)
+        self.num_classes = int(num_classes)
+        self.batch_rois = int(batch_rois)
+        self.fg_fraction = float(fg_fraction)
+        self.fg_thresh = float(fg_thresh)
+        self.bg_thresh_hi = float(bg_thresh_hi)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_output", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        R = self.batch_rois
+        return (in_shape,
+                [(R, 5), (R,), (R, 4 * self.num_classes),
+                 (R, 4 * self.num_classes)], [])
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalTarget(self.num_classes, self.batch_rois,
+                              self.fg_fraction, self.fg_thresh,
+                              self.bg_thresh_hi)

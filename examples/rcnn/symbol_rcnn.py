@@ -1,0 +1,154 @@
+"""Faster R-CNN train/test symbols (toy-scale backbone).
+
+Reference: ``example/rcnn/rcnn/symbol/symbol_vgg.py`` — shared conv
+trunk, RPN (3x3 conv -> 2A cls + 4A bbox), Proposal op, proposal-target
+sampler, ROIPooling, and the two Fast-RCNN heads with
+``SoftmaxOutput(normalization='batch')`` + weighted ``smooth_l1``.
+
+Channel conventions follow the framework Proposal op
+(`mxnet_tpu/ops/spatial.py`): cls channels [bg_0..bg_{A-1},
+fg_0..fg_{A-1}], bbox channels a*4+k, box enumeration h, w, a.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+import rcnn_lib  # noqa: F401  (registers the proposal_target CustomOp)
+
+FEAT_STRIDE = 8
+ANCHOR_SCALES = (2, 4)
+ANCHOR_RATIOS = (1.0,)
+NUM_ANCHORS = len(ANCHOR_SCALES) * len(ANCHOR_RATIOS)
+
+
+def get_trunk(data):
+    """Three stride-2 conv stages -> feature stride 8."""
+    x = data
+    for i, nf in enumerate((16, 32, 64)):
+        x = mx.sym.Convolution(x, kernel=(3, 3), stride=(2, 2),
+                               pad=(1, 1), num_filter=nf,
+                               name="conv%d" % (i + 1))
+        x = mx.sym.Activation(x, act_type="relu",
+                              name="relu%d" % (i + 1))
+    return x
+
+
+def rpn_heads(feat, num_anchors):
+    rpn_conv = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=64, name="rpn_conv_3x3")
+    rpn_relu = mx.sym.Activation(rpn_conv, act_type="relu")
+    cls = mx.sym.Convolution(rpn_relu, kernel=(1, 1),
+                             num_filter=2 * num_anchors,
+                             name="rpn_cls_score")
+    bbox = mx.sym.Convolution(rpn_relu, kernel=(1, 1),
+                              num_filter=4 * num_anchors,
+                              name="rpn_bbox_pred")
+    return cls, bbox
+
+
+def rcnn_heads(feat, rois, num_classes, pooled=(4, 4)):
+    pool = mx.sym.ROIPooling(data=feat, rois=rois, pooled_size=pooled,
+                             spatial_scale=1.0 / FEAT_STRIDE,
+                             name="roi_pool")
+    flat = mx.sym.Flatten(pool)
+    fc6 = mx.sym.FullyConnected(flat, num_hidden=128, name="fc6")
+    relu6 = mx.sym.Activation(fc6, act_type="relu")
+    cls_score = mx.sym.FullyConnected(relu6, num_hidden=num_classes,
+                                      name="cls_score")
+    bbox_pred = mx.sym.FullyConnected(relu6,
+                                      num_hidden=4 * num_classes,
+                                      name="bbox_pred")
+    return cls_score, bbox_pred
+
+
+def get_rcnn_train(num_classes=3, num_anchors=NUM_ANCHORS,
+                   rpn_batch_size=64, batch_rois=32,
+                   rpn_pre_nms=400, rpn_post_nms=64):
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    gt_boxes = mx.sym.Variable("gt_boxes")
+    rpn_label = mx.sym.Variable("label")
+    rpn_bbox_target = mx.sym.Variable("bbox_target")
+    rpn_bbox_weight = mx.sym.Variable("bbox_weight")
+
+    feat = get_trunk(data)
+    rpn_cls, rpn_bbox = rpn_heads(feat, num_anchors)
+
+    # per-anchor 2-way softmax: (1, 2A, H, W) -> (1, 2, A, H, W)
+    rpn_cls_reshape = mx.sym.Reshape(rpn_cls, shape=(0, -4, 2, -1, -2),
+                                     name="rpn_cls_reshape")
+    rpn_cls_prob = mx.sym.SoftmaxOutput(
+        data=rpn_cls_reshape, label=rpn_label, multi_output=True,
+        normalization="valid", use_ignore=True, ignore_label=-1,
+        name="rpn_cls_prob")
+    rpn_bbox_loss_ = rpn_bbox_weight * mx.sym.smooth_l1(
+        data=(rpn_bbox_pred_minus_target(rpn_bbox, rpn_bbox_target)),
+        scalar=3.0, name="rpn_bbox_loss_")
+    rpn_bbox_loss = mx.sym.MakeLoss(rpn_bbox_loss_,
+                                    grad_scale=1.0 / rpn_batch_size,
+                                    name="rpn_bbox_loss")
+
+    # proposals from the softmaxed scores, channel-major (1, 2A, H, W)
+    rpn_cls_act = mx.sym.SoftmaxActivation(rpn_cls_reshape,
+                                           mode="channel",
+                                           name="rpn_cls_act")
+    rpn_cls_act = mx.sym.Reshape(rpn_cls_act, shape=(0, -3, -2),
+                                 name="rpn_cls_act_reshape")
+    rois = mx.sym.Proposal(
+        cls_prob=rpn_cls_act, bbox_pred=rpn_bbox, im_info=im_info,
+        name="rois", feature_stride=FEAT_STRIDE,
+        scales=ANCHOR_SCALES, ratios=ANCHOR_RATIOS,
+        rpn_pre_nms_top_n=rpn_pre_nms, rpn_post_nms_top_n=rpn_post_nms,
+        threshold=0.7, rpn_min_size=4)
+
+    gt_reshape = mx.sym.Reshape(gt_boxes, shape=(-1, 5),
+                                name="gt_boxes_reshape")
+    group = mx.sym.Custom(rois=rois, gt_boxes=gt_reshape,
+                          op_type="proposal_target",
+                          num_classes=num_classes,
+                          batch_rois=batch_rois, name="ptarget")
+    rois = group[0]
+    label = group[1]
+    bbox_target = group[2]
+    bbox_weight = group[3]
+
+    cls_score, bbox_pred = rcnn_heads(feat, rois, num_classes)
+    cls_prob = mx.sym.SoftmaxOutput(data=cls_score, label=label,
+                                    normalization="batch",
+                                    name="cls_prob")
+    bbox_loss_ = bbox_weight * mx.sym.smooth_l1(
+        data=(bbox_pred - bbox_target), scalar=1.0, name="bbox_loss_")
+    bbox_loss = mx.sym.MakeLoss(bbox_loss_,
+                                grad_scale=1.0 / batch_rois,
+                                name="bbox_loss")
+    return mx.sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob,
+                         bbox_loss, mx.sym.BlockGrad(label)])
+
+
+def rpn_bbox_pred_minus_target(pred, target):
+    return pred - target
+
+
+def get_rcnn_test(num_classes=3, num_anchors=NUM_ANCHORS,
+                  rpn_pre_nms=400, rpn_post_nms=32):
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    feat = get_trunk(data)
+    rpn_cls, rpn_bbox = rpn_heads(feat, num_anchors)
+    rpn_cls_reshape = mx.sym.Reshape(rpn_cls, shape=(0, -4, 2, -1, -2))
+    rpn_cls_act = mx.sym.SoftmaxActivation(rpn_cls_reshape,
+                                           mode="channel")
+    rpn_cls_act = mx.sym.Reshape(rpn_cls_act, shape=(0, -3, -2))
+    rois = mx.sym.Proposal(
+        cls_prob=rpn_cls_act, bbox_pred=rpn_bbox, im_info=im_info,
+        name="rois", feature_stride=FEAT_STRIDE,
+        scales=ANCHOR_SCALES, ratios=ANCHOR_RATIOS,
+        rpn_pre_nms_top_n=rpn_pre_nms, rpn_post_nms_top_n=rpn_post_nms,
+        threshold=0.7, rpn_min_size=4)
+    cls_score, bbox_pred = rcnn_heads(feat, rois, num_classes)
+    cls_prob = mx.sym.SoftmaxActivation(cls_score, name="cls_prob")
+    return mx.sym.Group([rois, cls_prob, bbox_pred])

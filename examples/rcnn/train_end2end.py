@@ -1,0 +1,229 @@
+"""Faster R-CNN end-to-end training + evaluation on a toy detection set.
+
+Reference: ``example/rcnn/train_end2end.py`` + ``rcnn/core/loader.py``
+(AnchorLoader: RPN targets computed host-side per batch) and
+``test.py``/``rcnn/core/tester.py`` (Proposal -> heads -> bbox_pred ->
+per-class NMS -> VOC mAP).
+
+Data: rectangles on background where fill intensity encodes the class
+(same family the SSD example trains on), images 96x96, one image per
+batch as the reference trains VOC.
+
+    python train_end2end.py --epochs 4
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+sys.path.insert(0, os.path.join(_HERE, "..", "ssd"))
+
+import mxnet_tpu as mx  # noqa: E402
+
+import rcnn_lib  # noqa: E402
+import symbol_rcnn  # noqa: E402
+from symbol_rcnn import (ANCHOR_RATIOS, ANCHOR_SCALES, FEAT_STRIDE,
+                         NUM_ANCHORS)  # noqa: E402
+
+
+IM_SIZE = 96
+NUM_CLASSES = 3        # background + 2 foreground classes
+MAX_GT = 4
+
+
+def synthetic_detection(n, size=IM_SIZE, seed=0):
+    """Images + gt arrays (MAX_GT, 5) [x1, y1, x2, y2, cls-1], pad -1."""
+    rng = np.random.RandomState(seed)
+    images = np.zeros((n, 1, size, size), "f")
+    gts = -np.ones((n, MAX_GT, 5), "f")
+    for i in range(n):
+        n_obj = rng.randint(1, 3)
+        for j in range(n_obj):
+            cls = rng.randint(0, NUM_CLASSES - 1)
+            w, h = rng.randint(20, 44, 2)
+            x1 = rng.randint(0, size - w)
+            y1 = rng.randint(0, size - h)
+            intensity = 0.4 + 0.5 * cls
+            images[i, 0, y1:y1 + h, x1:x1 + w] = intensity
+            gts[i, j] = [x1, y1, x1 + w - 1, y1 + h - 1, cls]
+        images[i, 0] += 0.05 * rng.randn(size, size)
+    return images.astype("f"), gts
+
+
+class AnchorLoader(mx.io.DataIter):
+    """Per-image iterator emitting RPN anchor targets alongside the
+    image (reference core/loader.py AnchorLoader)."""
+
+    def __init__(self, images, gts, shuffle=False, seed=0):
+        super().__init__()
+        self.images, self.gts = images, gts
+        self.batch_size = 1
+        self.shuffle = shuffle
+        self.rng = np.random.RandomState(seed)
+        self.hf = IM_SIZE // FEAT_STRIDE
+        self.wf = IM_SIZE // FEAT_STRIDE
+        A = NUM_ANCHORS
+        self.provide_data = [
+            mx.io.DataDesc("data", (1, 1, IM_SIZE, IM_SIZE)),
+            mx.io.DataDesc("im_info", (1, 3)),
+            mx.io.DataDesc("gt_boxes", (1, MAX_GT, 5))]
+        self.provide_label = [
+            mx.io.DataDesc("label", (1, A, self.hf, self.wf)),
+            mx.io.DataDesc("bbox_target", (1, 4 * A, self.hf, self.wf)),
+            mx.io.DataDesc("bbox_weight", (1, 4 * A, self.hf, self.wf))]
+        self.reset()
+
+    def reset(self):
+        self.order = (self.rng.permutation(len(self.images))
+                      if self.shuffle else np.arange(len(self.images)))
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= len(self.order):
+            raise StopIteration
+        i = self.order[self.cur]
+        self.cur += 1
+        gt = self.gts[i]
+        label, t, w = rcnn_lib.assign_anchor(
+            (self.hf, self.wf), gt, (IM_SIZE, IM_SIZE), FEAT_STRIDE,
+            ANCHOR_SCALES, ANCHOR_RATIOS, rng=self.rng)
+        A = NUM_ANCHORS
+        # h,w,a order -> (A, H, W) / (4A, H, W) channel layouts
+        label = label.reshape(self.hf, self.wf, A).transpose(2, 0, 1)
+        t = t.reshape(self.hf, self.wf, A, 4).transpose(2, 3, 0, 1) \
+             .reshape(4 * A, self.hf, self.wf)
+        w = w.reshape(self.hf, self.wf, A, 4).transpose(2, 3, 0, 1) \
+             .reshape(4 * A, self.hf, self.wf)
+        im_info = np.array([[IM_SIZE, IM_SIZE, 1.0]], "f")
+        return mx.io.DataBatch(
+            data=[mx.nd.array(self.images[i][None]),
+                  mx.nd.array(im_info),
+                  mx.nd.array(gt[None])],
+            label=[mx.nd.array(label[None]), mx.nd.array(t[None]),
+                   mx.nd.array(w[None])],
+            pad=0, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+
+class RPNAccuracy(mx.metric.EvalMetric):
+    """RPN fg/bg accuracy over non-ignored anchors."""
+
+    def __init__(self):
+        super().__init__("rpn-acc")
+
+    def update(self, labels, preds):
+        pred = preds[0].asnumpy().argmax(1).ravel()
+        lab = labels[0].asnumpy().ravel()
+        keep = lab != -1
+        self.sum_metric += (pred[keep] == lab[keep]).sum()
+        self.num_inst += keep.sum()
+
+
+class RCNNAccuracy(mx.metric.EvalMetric):
+    """Fast-RCNN head accuracy on the sampled rois (label from the
+    in-graph proposal_target output, preds[4])."""
+
+    def __init__(self):
+        super().__init__("rcnn-acc")
+
+    def update(self, labels, preds):
+        pred = preds[2].asnumpy().argmax(1).ravel()
+        lab = preds[4].asnumpy().ravel()
+        self.sum_metric += (pred == lab).sum()
+        self.num_inst += lab.size
+
+
+def train(epochs=4, n_train=200, lr=2e-3, ctx=None, seed=0):
+    ctx = ctx or mx.context.current_context()
+    images, gts = synthetic_detection(n_train, seed=seed)
+    it = AnchorLoader(images, gts, shuffle=True, seed=seed + 1)
+    net = symbol_rcnn.get_rcnn_train(NUM_CLASSES)
+    mod = mx.module.Module(net, context=ctx,
+                           data_names=("data", "im_info", "gt_boxes"),
+                           label_names=("label", "bbox_target",
+                                        "bbox_weight"))
+    metric = mx.metric.CompositeEvalMetric(
+        metrics=[RPNAccuracy(), RCNNAccuracy()])
+    mod.fit(it, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9,
+                              "wd": 5e-4, "clip_gradient": 5.0},
+            eval_metric=metric,
+            batch_end_callback=mx.callback.Speedometer(1, 50))
+    return mod
+
+
+def detect(mod_params, images, nms_thresh=0.3, score_thresh=0.1,
+           ctx=None):
+    """Run the test symbol; per-class bbox decode + NMS.
+    Returns per-image arrays (m, 6) [cls, score, x1, y1, x2, y2]."""
+    ctx = ctx or mx.context.current_context()
+    net = symbol_rcnn.get_rcnn_test(NUM_CLASSES)
+    mod = mx.module.Module(net, context=ctx,
+                           data_names=("data", "im_info"),
+                           label_names=[])
+    mod.bind(data_shapes=[("data", (1, 1, IM_SIZE, IM_SIZE)),
+                          ("im_info", (1, 3))], for_training=False)
+    mod.set_params(*mod_params)
+    im_info = np.array([[IM_SIZE, IM_SIZE, 1.0]], "f")
+    results = []
+    for img in images:
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(img[None]), mx.nd.array(im_info)]),
+            is_train=False)
+        rois, cls_prob, deltas = (o.asnumpy() for o in
+                                  mod.get_outputs())
+        boxes = rcnn_lib.bbox_pred(rois[:, 1:5], deltas)
+        boxes = rcnn_lib.clip_boxes(boxes, (IM_SIZE, IM_SIZE))
+        dets = []
+        for c in range(1, NUM_CLASSES):
+            score = cls_prob[:, c]
+            keep = score > score_thresh
+            if not keep.any():
+                continue
+            cdet = np.hstack([boxes[keep, 4 * c:4 * c + 4],
+                              score[keep, None]])
+            kept = rcnn_lib.nms(cdet, nms_thresh)
+            for k in kept:
+                dets.append([c - 1, cdet[k, 4], *cdet[k, :4]])
+        results.append(np.array(dets, "f").reshape(-1, 6))
+    return results
+
+
+def evaluate(mod, n_test=50, seed=99, ctx=None):
+    """VOC-style mAP at IoU 0.5 using the SSD example's metric."""
+    from metric import MApMetric  # examples/ssd/metric.py
+    images, gts = synthetic_detection(n_test, seed=seed)
+    dets = detect(mod.get_params(), images, ctx=ctx)
+    metric = MApMetric(ovp_thresh=0.5)
+    for img_dets, gt in zip(dets, gts):
+        valid = gt[gt[:, 4] >= 0]
+        label = -np.ones((1, MAX_GT, 6), "f")
+        label[0, :len(valid), 0] = valid[:, 4]
+        label[0, :len(valid), 1:5] = valid[:, :4] / IM_SIZE
+        pred = img_dets.copy().reshape(1, -1, 6)
+        if pred.size:
+            pred[0, :, 2:6] = pred[0, :, 2:6] / IM_SIZE
+        metric.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    name, value = metric.get()
+    mean_ap = value[-1] if isinstance(value, list) else value
+    logging.info("toy VOC mAP@0.5 = %.3f", mean_ap)
+    return mean_ap
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    a = p.parse_args()
+    mod = train(epochs=a.epochs)
+    evaluate(mod)

@@ -1,0 +1,76 @@
+"""L2-SVM / L1-SVM output layer on an MNIST-like task.
+
+Reference: ``example/svm_mnist/svm_mnist.py`` — an MLP trained with the
+``SVMOutput`` large-margin objective instead of softmax cross-entropy
+(src/operator/svm_output.cc).  Data is a synthetic PCA-like Gaussian
+mixture (the reference runs sklearn PCA over downloaded MNIST; no
+downloads in this environment).
+
+    python svm_mnist.py --epochs 8 [--use-linear]
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_net(use_linear=False, num_classes=10):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=512)
+    act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=512)
+    act2 = mx.sym.Activation(data=fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(data=act2, name="fc3",
+                                num_hidden=num_classes)
+    return mx.sym.SVMOutput(data=fc3, name="svm", use_linear=use_linear)
+
+
+def synthetic_pca_mnist(n, dim=70, classes=10, seed=0):
+    """Gaussian clusters + noise, mirroring the reference's noisy PCA input."""
+    protos = np.random.RandomState(42).randn(
+        classes, dim).astype(np.float32) * 2.0
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = protos[y] + rng.randn(n, dim).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def train(epochs=8, batch_size=200, use_linear=False, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    xtr, ytr = synthetic_pca_mnist(6000, seed=0)
+    xte, yte = synthetic_pca_mnist(1000, seed=1)
+
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size, shuffle=True,
+                                   label_name="svm_label")
+    test_iter = mx.io.NDArrayIter(xte, yte, batch_size,
+                                  label_name="svm_label")
+    mod = mx.module.Module(make_net(use_linear), context=ctx,
+                           label_names=("svm_label",))
+    mod.fit(train_iter, eval_data=test_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9,
+                              "wd": 1e-5},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(batch_size, 20))
+    acc = mod.score(test_iter, mx.metric.Accuracy())[0][1]
+    logging.info("%s-SVM test accuracy %.3f",
+                 "L1" if use_linear else "L2", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--use-linear", action="store_true")
+    a = p.parse_args()
+    train(epochs=a.epochs, use_linear=a.use_linear)

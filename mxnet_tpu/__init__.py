@@ -68,3 +68,11 @@ except ImportError:  # pragma: no cover
     image = None
     image_det = None
 
+# optional: torch interop (plugin/torch + python/mxnet/torch.py parity)
+try:
+    from . import torch as th
+    sym.TorchModule = th.torch_module_symbol
+    sym.TorchCriterion = th.torch_criterion_symbol
+except ImportError:  # pragma: no cover
+    th = None
+

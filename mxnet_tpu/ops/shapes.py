@@ -169,3 +169,32 @@ def _rnn(attrs, known):
     if mode == "lstm":
         out["state_cell"] = (num_layers * dirs, batch, state_size)
     return out
+
+
+@register_param_shapes("Custom")
+def _custom(attrs, known):
+    """Let a CustomOpProp's infer_shape fill its parameter-arg shapes
+    (reference custom-inl.h InferShape callback: props conventionally
+    derive label/weight shapes from the data shape)."""
+    from .. import operator as _op
+    try:
+        prop = _op._make_prop(attrs)
+    except Exception:
+        return {}
+    args = prop.list_arguments()
+    in_shapes = [list(known[nm]) if nm in known else None for nm in args]
+    if not in_shapes or in_shapes[0] is None:
+        return {}
+    if any(s is None for s in in_shapes):
+        # partial info: props conventionally only need in_shape[0], but a
+        # prop that indexes a missing input is allowed to give up here
+        try:
+            arg_shapes, _, _ = prop.infer_shape(in_shapes)
+        except Exception:
+            return {}
+    else:
+        # all inputs known: a failure is a real bug in the user's
+        # infer_shape — propagate it (reference custom-inl.h behavior)
+        arg_shapes, _, _ = prop.infer_shape(in_shapes)
+    return {nm: tuple(s) for nm, s in zip(args, arg_shapes)
+            if s is not None}

@@ -1,0 +1,216 @@
+"""Torch interop: run PyTorch modules/criterions as framework operators
+and apply torch tensor functions to NDArrays.
+
+Reference parity: ``plugin/torch`` (torch_module.cc wraps a Torch nn
+module as an Operator whose weights/grads live in the surrounding graph;
+torch_criterion.cc wraps a Torch loss as a loss head) and
+``python/mxnet/torch.py`` (imperative ``mx.th.*`` tensor functions).
+The reference bridges Lua Torch through luajit + the C API; the
+TPU-native build bridges modern PyTorch (CPU) through the CustomOp host
+-callback path — the same architectural seam the reference uses (torch
+runs host-side, the surrounding graph stays compiled).
+
+    import torch.nn as tnn
+    net = mx.sym.TorchModule(data, module=tnn.Linear(64, 10))
+    loss = mx.sym.TorchCriterion(net, label, criterion=tnn.CrossEntropyLoss())
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import operator as _op
+from . import ndarray as _nd
+from .base import MXNetError
+
+try:
+    import torch as _torch
+except ImportError:  # pragma: no cover - torch is baked into this image
+    _torch = None
+
+
+def _require_torch():
+    if _torch is None:
+        raise MXNetError("PyTorch is not available; the torch bridge "
+                         "requires the CPU torch wheel")
+    return _torch
+
+
+# Registry of live modules handed across the CustomOp string boundary
+# (CustomOp params are strings; modules can't be pickled through them
+# safely, so they're kept here keyed by id).
+_MODULES = {}
+_CRITERIA = {}
+
+
+class _TorchModuleOp(_op.CustomOp):
+    """Forward/backward through a torch.nn.Module; module parameters are
+    graph arguments (torch_param_i), so any framework optimizer trains
+    them (torch_module-inl.h's weight/gradWeight mapping)."""
+
+    def __init__(self, module):
+        super().__init__()
+        self.module = module
+        self.params = list(module.parameters())
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        th = _require_torch()
+        x = th.from_numpy(in_data[0].asnumpy().copy())
+        with th.no_grad():
+            for p, v in zip(self.params, in_data[1:]):
+                p.copy_(th.from_numpy(v.asnumpy()))
+        x.requires_grad_(is_train)
+        out = self.module(x)
+        self.assign(out_data[0], req[0], out.detach().numpy())
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # backward may run on a fresh instance (host callbacks are
+        # stateless across calls): rebuild the torch graph from in_data
+        th = _require_torch()
+        x = th.from_numpy(in_data[0].asnumpy().copy())
+        with th.no_grad():
+            for p, v in zip(self.params, in_data[1:]):
+                p.copy_(th.from_numpy(v.asnumpy()))
+        x.requires_grad_(True)
+        out = self.module(x)
+        go = th.from_numpy(out_grad[0].asnumpy().copy())
+        for p in self.params:
+            if p.grad is not None:
+                p.grad = None
+        grads = th.autograd.grad(out, [x] + self.params, grad_outputs=go,
+                                 allow_unused=True)
+        for i, g in enumerate(grads):
+            val = (np.zeros(in_grad[i].shape, np.float32) if g is None
+                   else g.numpy())
+            self.assign(in_grad[i], req[i], val)
+
+
+@_op.register("_torch_module")
+class _TorchModuleProp(_op.CustomOpProp):
+    def __init__(self, module_key):
+        super().__init__(need_top_grad=True)
+        self.module = _MODULES[str(module_key)]
+        self._params = list(self.module.parameters())
+
+    def list_arguments(self):
+        return ["data"] + ["torch_param_%d_weight" % i
+                           for i in range(len(self._params))]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        th = _require_torch()
+        with th.no_grad():
+            out = self.module(th.zeros(*in_shape[0]))
+        return ([tuple(in_shape[0])] +
+                [tuple(p.shape) for p in self._params],
+                [tuple(out.shape)], [])
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _TorchModuleOp(self.module)
+
+
+class _TorchCriterionOp(_op.CustomOp):
+    """Torch loss head: forward = scalar loss broadcast per sample,
+    backward = d(loss)/d(input) (torch_criterion-inl.h)."""
+
+    def __init__(self, criterion, label_dtype):
+        super().__init__()
+        self.criterion = criterion
+        self.label_dtype = label_dtype
+
+    def _label(self, th, arr):
+        lab = th.from_numpy(arr.asnumpy().copy())
+        if self.label_dtype == "long":
+            lab = lab.long()
+        return lab
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        th = _require_torch()
+        x = th.from_numpy(in_data[0].asnumpy().copy())
+        with th.no_grad():
+            loss = self.criterion(x, self._label(th, in_data[1]))
+        n = in_data[0].shape[0]
+        self.assign(out_data[0], req[0],
+                    np.full((n,), float(loss), np.float32))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # stateless: recompute the loss graph from in_data
+        th = _require_torch()
+        x = th.from_numpy(in_data[0].asnumpy().copy())
+        x.requires_grad_(True)
+        loss = self.criterion(x, self._label(th, in_data[1]))
+        (gx,) = th.autograd.grad(loss, [x])
+        self.assign(in_grad[0], req[0], gx.numpy())
+        self.assign(in_grad[1], req[1],
+                    np.zeros(in_grad[1].shape, np.float32))
+
+
+@_op.register("_torch_criterion")
+class _TorchCriterionProp(_op.CustomOpProp):
+    def __init__(self, criterion_key, label_shape="", label_dtype="long"):
+        super().__init__(need_top_grad=False)
+        self.criterion = _CRITERIA[str(criterion_key)]
+        self.label_dtype = str(label_dtype)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return ([tuple(in_shape[0]), tuple(in_shape[1])],
+                [(in_shape[0][0],)], [])
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _TorchCriterionOp(self.criterion, self.label_dtype)
+
+
+def torch_module_symbol(data, module, name="torch"):
+    """Symbol wrapping a torch.nn.Module (mx.sym.TorchModule)."""
+    from . import symbol as _sym
+    _require_torch()
+    key = str(id(module))
+    _MODULES[key] = module
+    return _sym.Custom(data=data, op_type="_torch_module",
+                       module_key=key, name=name)
+
+
+def torch_criterion_symbol(data, label, criterion, label_dtype="long",
+                           name="torch_loss"):
+    """Symbol wrapping a torch loss (mx.sym.TorchCriterion)."""
+    from . import symbol as _sym
+    _require_torch()
+    key = str(id(criterion))
+    _CRITERIA[key] = criterion
+    return _sym.Custom(data=data, label=label,
+                       op_type="_torch_criterion", criterion_key=key,
+                       label_dtype=label_dtype, name=name)
+
+
+# ------------------------------------------------- imperative mx.th.*
+def _make_th_function(fname):
+    def fn(*args, **kwargs):
+        th = _require_torch()
+        tfn = getattr(th, fname)
+        targs = [th.from_numpy(a.asnumpy()) if isinstance(a, _nd.NDArray)
+                 else a for a in args]
+        out = tfn(*targs, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(_nd.array(o.numpy()) for o in out)
+        return _nd.array(out.numpy())
+    fn.__name__ = fname
+    fn.__doc__ = ("NDArray wrapper over torch.%s (reference mx.th.* "
+                  "generated functions)" % fname)
+    return fn
+
+
+_TH_FUNCS = ["add", "mul", "div", "sub", "mm", "bmm", "exp", "log",
+             "sqrt", "abs", "sigmoid", "tanh", "clamp", "sort", "topk",
+             "cumsum", "cumprod", "softmax", "log_softmax", "norm",
+             "var", "std", "median", "conv1d", "conv2d"]
+
+for _f in _TH_FUNCS:
+    if _torch is not None and callable(getattr(_torch, _f, None)):
+        globals()[_f] = _make_th_function(_f)

@@ -1,0 +1,64 @@
+"""CI smoke/convergence tests for the small example families.
+
+Each reference ``example/`` family the repo mirrors gets a tiny-config run
+asserting its headline behavior (convergence, accuracy drop, recall shift)
+rather than just import success — the reference's `tests/python/train`
+style applied to the example surface.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for sub in ("adversary", "numpy_ops", "svm_mnist", "recommenders",
+            "multi_task", "bi_lstm_sort"):
+    sys.path.insert(0, os.path.join(ROOT, "examples", sub))
+
+
+def test_fgsm_attack_drops_accuracy():
+    import fgsm
+    clean, adv = fgsm.train(epochs=4, batch_size=100, eps=0.3,
+                            n_train=2000, n_test=500)
+    assert clean > 0.9, clean
+    assert adv < clean - 0.3, (clean, adv)
+
+
+def test_custom_softmax_converges():
+    import custom_softmax
+    acc = custom_softmax.train(epochs=4, batch_size=64)
+    assert acc > 0.9, acc
+
+
+def test_weighted_logistic_regression():
+    import weighted_logistic_regression as wlr
+    recall = wlr.train(epochs=6, pos_w=3.0)
+    assert recall > 0.6, recall
+
+
+def test_svm_mnist_converges():
+    import svm_mnist
+    acc = svm_mnist.train(epochs=4, batch_size=200)
+    assert acc > 0.9, acc
+
+
+def test_matrix_factorization_beats_baseline():
+    import matrix_fact
+    rmse, base = matrix_fact.train(epochs=6, batch_size=200)
+    assert rmse < 0.5 * base, (rmse, base)
+
+
+def test_multi_task_two_heads_learn():
+    import example_multi_task as emt
+    res = emt.train(epochs=3, batch_size=100)
+    assert res["task0-accuracy"] > 0.9, res
+    assert res["task1-accuracy"] > 0.9, res
+
+
+def test_bi_lstm_sort_learns():
+    import lstm_sort
+    acc = lstm_sort.train(epochs=3, batch_size=50, seq_len=4,
+                          vocab_size=12, num_hidden=48)
+    # random chance is 1/12; partial sort knowledge should clear 0.5
+    assert acc > 0.5, acc

@@ -1,0 +1,56 @@
+"""Faster R-CNN example end-to-end: anchor targets, proposal-target
+sampling, training convergence, detection + VOC mAP.
+
+Reference: example/rcnn (train_end2end.py, rcnn/io/rpn.py assign_anchor,
+rcnn/symbol/proposal_target.py, core/tester.py).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "examples", "rcnn"))
+sys.path.insert(0, os.path.join(ROOT, "examples", "ssd"))
+
+
+def test_bbox_transform_roundtrip():
+    import rcnn_lib
+    rng = np.random.RandomState(0)
+    ex = rng.uniform(0, 50, (20, 2))
+    ex = np.hstack([ex, ex + rng.uniform(5, 40, (20, 2))]).astype("f")
+    gt = rng.uniform(0, 50, (20, 2))
+    gt = np.hstack([gt, gt + rng.uniform(5, 40, (20, 2))]).astype("f")
+    deltas = rcnn_lib.bbox_transform(ex, gt)
+    rec = rcnn_lib.bbox_pred(ex, deltas)
+    np.testing.assert_allclose(rec, gt, atol=1e-3)
+
+
+def test_assign_anchor_marks_gt_anchors_fg():
+    import rcnn_lib
+    gt = np.array([[16, 16, 47, 47, 0]], "f")   # 32x32 box
+    label, target, weight = rcnn_lib.assign_anchor(
+        (12, 12), gt, (96, 96), 8, (2, 4), (1.0,),
+        rng=np.random.RandomState(0))
+    assert (label == 1).sum() >= 1
+    fg = label == 1
+    assert (weight[fg] == 1).all()
+    # targets for the best-matching anchor should be small offsets
+    assert np.abs(target[fg]).max() < 2.0
+
+
+def test_nms_suppresses_overlaps():
+    import rcnn_lib
+    dets = np.array([[0, 0, 10, 10, 0.9],
+                     [1, 1, 11, 11, 0.8],       # overlaps first
+                     [50, 50, 60, 60, 0.7]], "f")
+    keep = rcnn_lib.nms(dets, 0.5)
+    assert list(keep) == [0, 2]
+
+
+def test_faster_rcnn_toy_convergence_and_map():
+    import train_end2end as t
+    mod = t.train(epochs=8, n_train=150, seed=0)
+    mAP = t.evaluate(mod, n_test=25, seed=123)
+    assert mAP > 0.6, mAP

@@ -1,0 +1,102 @@
+"""Torch interop bridge: torch.nn modules/criterions as graph operators,
+mx.th.* imperative tensor functions.
+
+Reference: plugin/torch (torch_module.cc / torch_criterion.cc) and
+python/mxnet/torch.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+
+def test_th_imperative_functions():
+    x = mx.nd.array(np.array([[1.0, 4.0], [9.0, 16.0]], np.float32))
+    np.testing.assert_allclose(mx.th.sqrt(x).asnumpy(),
+                               [[1, 2], [3, 4]])
+    y = mx.th.mm(x, x)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() @ x.asnumpy())
+
+
+def test_torch_module_forward_matches_torch():
+    lin = tnn.Linear(8, 4)
+    data = mx.sym.Variable("data")
+    net = mx.sym.TorchModule(data, module=lin, name="tmod")
+    ex = net.simple_bind(mx.cpu(), data=(2, 8), grad_req="write")
+    x = np.random.RandomState(0).randn(2, 8).astype("f")
+    # feed the torch params through the graph args
+    args = dict(zip(net.list_arguments(), ex.arg_arrays))
+    params = list(lin.parameters())
+    for i, p in enumerate(params):
+        args["tmod_torch_param_%d_weight" % i][:] = p.detach().numpy()
+    ex.forward(is_train=True, data=x)
+    want = lin(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_torch_module_trains_through_framework_optimizer():
+    """A torch Linear trained by the framework's Module/SGD learns a
+    linear map (weights live as graph args, like torch_module-inl.h)."""
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 8).astype("f")
+    x = rng.randn(512, 8).astype("f")
+    y = x @ w_true.T
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("lin_label")
+    net = mx.sym.TorchModule(data, module=tnn.Linear(8, 4, bias=False),
+                             name="tmod")
+    net = mx.sym.LinearRegressionOutput(net, label=label, name="lin")
+
+    mod = mx.module.Module(net, context=mx.cpu(),
+                           label_names=("lin_label",))
+    it = mx.io.NDArrayIter(x, y, 64, shuffle=True, label_name="lin_label")
+    mod.fit(it, num_epoch=10, initializer=mx.init.Xavier(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    rmse = mod.score(it, mx.metric.RMSE())[0][1]
+    assert rmse < 0.1, rmse
+
+
+def test_torch_criterion_loss_head():
+    """CrossEntropyLoss as the loss head drives a small classifier."""
+    rng = np.random.RandomState(1)
+    protos = np.random.RandomState(42).randn(4, 16).astype("f")
+    yy = rng.randint(0, 4, 256)
+    xx = (protos[yy] + 0.3 * rng.randn(256, 16)).astype("f")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("ce_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.TorchCriterion(fc, label,
+                                criterion=tnn.CrossEntropyLoss(),
+                                name="tcrit")
+
+    mod = mx.module.Module(net, context=mx.cpu(),
+                           label_names=("ce_label",))
+    it = mx.io.NDArrayIter(xx, yy.astype("f"), 64, shuffle=True,
+                           label_name="ce_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    # torch's CrossEntropyLoss already averages over the batch; undo the
+    # framework's default 1/batch gradient rescale
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "rescale_grad": 1.0})
+    first_loss = last_loss = None
+    for _ in range(8):
+        it.reset()
+        tot = n = 0
+        for b in it:
+            mod.forward(b, is_train=True)
+            tot += float(mod.get_outputs()[0].asnumpy()[0])
+            n += 1
+            mod.backward()
+            mod.update()
+        if first_loss is None:
+            first_loss = tot / n
+        last_loss = tot / n
+    assert last_loss < 0.5 * first_loss, (first_loss, last_loss)
