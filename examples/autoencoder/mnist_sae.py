@@ -1,0 +1,166 @@
+"""Stacked autoencoder with layer-wise pretraining + fine-tuning.
+
+Reference: ``example/autoencoder/{autoencoder.py,mnist_sae.py}`` — each
+encoder layer is pretrained as a one-layer denoising AE on the features
+of the stack below it, then the full symmetric network is fine-tuned to
+minimize reconstruction error (``LinearRegressionOutput``).
+
+Data: synthetic low-dimensional-manifold images (random smooth basis
+combinations), so reconstruction through a narrow bottleneck is
+learnable and CI can assert MSE << input variance.
+
+    python mnist_sae.py --dims 128,64,16
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+class StackedAutoEncoder:
+    """Greedy layerwise pretrain, then end-to-end finetune."""
+
+    def __init__(self, input_dim, dims, ctx=None):
+        self.input_dim = input_dim
+        self.dims = list(dims)
+        self.ctx = ctx or mx.context.current_context()
+        self.params = {}
+
+    # -- symbols -------------------------------------------------------
+    def _encoder(self, depth):
+        x = mx.sym.Variable("data")
+        for i in range(depth):
+            x = mx.sym.FullyConnected(data=x, num_hidden=self.dims[i],
+                                      name="enc%d" % i)
+            x = mx.sym.Activation(x, act_type="relu", name="enc%d_act" % i)
+        return x
+
+    def _full(self):
+        """encoder stack + mirrored decoder + reconstruction loss."""
+        x = self._encoder(len(self.dims))
+        widths = [self.input_dim] + self.dims[:-1]
+        for i in reversed(range(len(self.dims))):
+            x = mx.sym.FullyConnected(data=x, num_hidden=widths[i],
+                                      name="dec%d" % i)
+            if i != 0:
+                x = mx.sym.Activation(x, act_type="relu",
+                                      name="dec%d_act" % i)
+        return mx.sym.LinearRegressionOutput(data=x, label=mx.sym.Variable(
+            "ae_label"), name="recon")
+
+    # -- training ------------------------------------------------------
+    def _fit_module(self, sym, x, y, epochs, batch_size, lr,
+                    label_name="ae_label", arg_params=None):
+        it = mx.io.NDArrayIter(x, y, batch_size, shuffle=True,
+                               label_name=label_name)
+        mod = mx.module.Module(sym, context=self.ctx,
+                               label_names=(label_name,))
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        if arg_params:
+            mod.set_params(arg_params, {}, allow_missing=True,
+                           allow_extra=True)
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": lr})
+        for _ in range(epochs):
+            it.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+        return mod
+
+    def _features(self, depth, x, batch_size):
+        if depth == 0:
+            return x
+        sym = self._encoder(depth)
+        mod = mx.module.Module(sym, context=self.ctx, label_names=())
+        it = mx.io.NDArrayIter(x, None, batch_size)
+        mod.bind(data_shapes=it.provide_data, for_training=False)
+        mod.set_params(self.params, {}, allow_missing=False,
+                       allow_extra=True)
+        out = []
+        for batch in it:
+            mod.forward(batch, is_train=False)
+            out.append(mod.get_outputs()[0].asnumpy())
+        return np.concatenate(out)[: len(x)]
+
+    def pretrain(self, x, epochs=4, batch_size=100, lr=1e-3):
+        """Greedy layerwise: train layer i to reconstruct features_{i}."""
+        for i in range(len(self.dims)):
+            feats = self._features(i, x, batch_size)
+            data = mx.sym.Variable("data")
+            enc = mx.sym.FullyConnected(data=data,
+                                        num_hidden=self.dims[i],
+                                        name="enc%d" % i)
+            enc = mx.sym.Activation(enc, act_type="relu",
+                                    name="enc%d_act" % i)
+            dec = mx.sym.FullyConnected(data=enc,
+                                        num_hidden=feats.shape[1],
+                                        name="pre_dec%d" % i)
+            sym = mx.sym.LinearRegressionOutput(
+                data=dec, label=mx.sym.Variable("ae_label"))
+            mod = self._fit_module(sym, feats, feats, epochs, batch_size,
+                                   lr)
+            args, _ = mod.get_params()
+            self.params["enc%d_weight" % i] = args["enc%d_weight" % i]
+            self.params["enc%d_bias" % i] = args["enc%d_bias" % i]
+            logging.info("pretrained layer %d (%d -> %d)", i,
+                         feats.shape[1], self.dims[i])
+
+    def finetune(self, x, epochs=6, batch_size=100, lr=1e-3):
+        mod = self._fit_module(self._full(), x, x, epochs, batch_size, lr,
+                               arg_params=self.params)
+        args, _ = mod.get_params()
+        self.params = dict(args)
+        self._final_mod = mod
+        return mod
+
+    def reconstruction_mse(self, x, batch_size=100):
+        mod = self._final_mod
+        it = mx.io.NDArrayIter(x, x, batch_size, label_name="ae_label")
+        out = []
+        for batch in it:
+            mod.forward(batch, is_train=False)
+            out.append(mod.get_outputs()[0].asnumpy())
+        recon = np.concatenate(out)[: len(x)]
+        return float(np.mean((recon - x) ** 2))
+
+
+def smooth_images(n, dim=196, rank=10, seed=0):
+    """Images on a rank-`rank` manifold + small noise."""
+    rng = np.random.RandomState(seed)
+    basis = rng.randn(rank, dim).astype("f")
+    coef = rng.randn(n, rank).astype("f")
+    x = coef @ basis / np.sqrt(rank)
+    return (x + 0.05 * rng.randn(n, dim)).astype("f")
+
+
+def train(dims=(128, 64, 16), n=4000, pre_epochs=3, fine_epochs=6,
+          batch_size=100, ctx=None):
+    x = smooth_images(n)
+    sae = StackedAutoEncoder(x.shape[1], dims, ctx=ctx)
+    sae.pretrain(x, epochs=pre_epochs, batch_size=batch_size)
+    sae.finetune(x, epochs=fine_epochs, batch_size=batch_size)
+    mse = sae.reconstruction_mse(x)
+    var = float(np.var(x))
+    logging.info("reconstruction MSE %.4f vs input variance %.4f",
+                 mse, var)
+    return mse, var, sae
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--dims", default="128,64,16")
+    a = p.parse_args()
+    train(dims=tuple(int(d) for d in a.dims.split(",")))

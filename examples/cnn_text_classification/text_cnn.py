@@ -1,0 +1,96 @@
+"""CNN for sentence classification (Kim 2014).
+
+Reference: ``example/cnn_text_classification/text_cnn.py`` — token
+embeddings, parallel Convolutions with filter widths (3,4,5) over the
+full embedding width, max-pool-over-time, concat, dropout, softmax.
+
+Data: synthetic sentences; class 1 sentences contain one of a few
+"signal" trigrams somewhere, class 0 sentences don't — exactly the
+pattern a width-3 filter + max-over-time detects.
+
+    python text_cnn.py --epochs 6
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_text_cnn(sentence_size, num_embed, vocab_size, num_label=2,
+                  filter_list=(3, 4, 5), num_filter=32, dropout=0.25):
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                             output_dim=num_embed, name="vocab_embed")
+    # (batch, 1, sentence, embed) image for the conv layers
+    conv_input = mx.sym.Reshape(
+        data=embed, shape=(-1, 1, sentence_size, num_embed))
+
+    pooled = []
+    for i, w in enumerate(filter_list):
+        conv = mx.sym.Convolution(data=conv_input, kernel=(w, num_embed),
+                                  num_filter=num_filter,
+                                  name="conv%d" % i)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pool = mx.sym.Pooling(act, pool_type="max",
+                              kernel=(sentence_size - w + 1, 1),
+                              stride=(1, 1))
+        pooled.append(pool)
+
+    concat = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Reshape(concat,
+                       shape=(-1, num_filter * len(filter_list)))
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, num_hidden=num_label, name="cls")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def synthetic_sentences(n, sentence_size=24, vocab_size=200,
+                        n_signals=4, seed=0):
+    signals = np.random.RandomState(42).randint(
+        5, vocab_size, (n_signals, 3))
+    rng = np.random.RandomState(seed)
+    x = rng.randint(5, vocab_size, (n, sentence_size))
+    y = (rng.rand(n) < 0.5).astype(np.int64)
+    for i in np.where(y == 1)[0]:
+        pos = rng.randint(0, sentence_size - 3)
+        x[i, pos:pos + 3] = signals[rng.randint(n_signals)]
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def train(epochs=6, batch_size=100, sentence_size=24, vocab_size=200,
+          num_embed=32, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    xtr, ytr = synthetic_sentences(4000, sentence_size, vocab_size,
+                                   seed=0)
+    xte, yte = synthetic_sentences(1000, sentence_size, vocab_size,
+                                   seed=1)
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size, shuffle=True)
+    test_iter = mx.io.NDArrayIter(xte, yte, batch_size)
+
+    net = make_text_cnn(sentence_size, num_embed, vocab_size)
+    mod = mx.module.Module(net, context=ctx)
+    mod.fit(train_iter, eval_data=test_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(batch_size, 20))
+    acc = mod.score(test_iter, mx.metric.Accuracy())[0][1]
+    logging.info("test accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    a = p.parse_args()
+    train(epochs=a.epochs)

@@ -1,0 +1,192 @@
+"""Deep Embedded Clustering (DEC).
+
+Reference: ``example/dec/dec.py`` — pretrain a stacked autoencoder,
+k-means the bottleneck embedding, then jointly refine encoder weights
+and cluster centers by minimizing KL(P || Q) where Q is a student-t
+soft assignment and P the sharpened target distribution.  The loss (and
+its gradient w.r.t. both the embedding and the centers) is a numpy
+CustomOp, like the reference's ``NumpyOp`` DECLoss.
+
+Data: well-separated synthetic blobs in pixel space, so CI can assert
+cluster accuracy.
+
+    python dec.py --clusters 4
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "autoencoder"))
+
+import mxnet_tpu as mx
+from mnist_sae import StackedAutoEncoder
+
+
+class DECLoss(mx.operator.CustomOp):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def _q(self, z, mu):
+        d2 = ((z[:, None, :] - mu[None, :, :]) ** 2).sum(-1)
+        mask = 1.0 / (1.0 + d2 / self.alpha)
+        q = mask ** ((self.alpha + 1.0) / 2.0)
+        q = q / q.sum(axis=1, keepdims=True)
+        return q, mask
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        z, mu = in_data[0].asnumpy(), in_data[1].asnumpy()
+        q, _ = self._q(z, mu)
+        self.assign(out_data[0], req[0], q)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # stateless across calls: recompute the student-t mask here
+        z, mu, p = (in_data[i].asnumpy() for i in range(3))
+        q, mask = self._q(z, mu)
+        m = mask * (self.alpha + 1.0) / self.alpha * (p - q)
+        dz = (z.T * m.sum(axis=1)).T - m.dot(mu)
+        dmu = (mu.T * m.sum(axis=0)).T - m.T.dot(z)
+        self.assign(in_grad[0], req[0], dz)
+        self.assign(in_grad[1], req[1], dmu)
+        self.assign(in_grad[2], req[2], np.zeros_like(p))
+
+
+@mx.operator.register("dec_loss")
+class DECLossProp(mx.operator.CustomOpProp):
+    def __init__(self, num_centers, alpha=1.0):
+        super().__init__(need_top_grad=False)
+        self.num_centers = int(num_centers)
+        self.alpha = float(alpha)
+
+    def list_arguments(self):
+        return ["z", "mu", "p"]
+
+    def list_outputs(self):
+        return ["q"]
+
+    def infer_shape(self, in_shape):
+        n, d = in_shape[0]
+        return ([in_shape[0], (self.num_centers, d),
+                 (n, self.num_centers)], [(n, self.num_centers)], [])
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return DECLoss(self.alpha)
+
+
+def kmeans(x, k, iters=50, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = x[rng.choice(len(x), k, replace=False)].copy()
+    for _ in range(iters):
+        d = ((x[:, None] - centers[None]) ** 2).sum(-1)
+        a = d.argmin(1)
+        for j in range(k):
+            if (a == j).any():
+                centers[j] = x[a == j].mean(0)
+    return centers, a
+
+
+def cluster_accuracy(pred, truth, k):
+    """Best-permutation accuracy via greedy assignment (blobs are
+    well-separated; full Hungarian not needed)."""
+    w = np.zeros((k, k))
+    for pi, ti in zip(pred, truth.astype(int)):
+        w[pi, ti] += 1
+    acc = 0
+    used_r, used_c = set(), set()
+    for _ in range(k):
+        r, c = np.unravel_index(
+            np.argmax(np.where(
+                np.isin(np.arange(k), list(used_r))[:, None] |
+                np.isin(np.arange(k), list(used_c))[None, :],
+                -1, w)), (k, k))
+        acc += w[r, c]
+        used_r.add(r)
+        used_c.add(c)
+    return acc / len(pred)
+
+
+def blobs(n, dim=64, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(k, dim).astype("f") * 3.0
+    y = rng.randint(0, k, n)
+    return (protos[y] + rng.randn(n, dim).astype("f")).astype("f"), y
+
+
+def train(clusters=4, n=2000, dims=(64, 16), epochs=40, batch_size=256,
+          ctx=None):
+    ctx = ctx or mx.context.current_context()
+    x, y = blobs(n, k=clusters)
+
+    sae = StackedAutoEncoder(x.shape[1], dims, ctx=ctx)
+    sae.pretrain(x, epochs=2, batch_size=100)
+    sae.finetune(x, epochs=4, batch_size=100)
+    z = sae._features(len(dims), x, 100)
+    centers, assign0 = kmeans(z, clusters)
+    acc0 = cluster_accuracy(assign0, y, clusters)
+
+    # DEC refinement graph: encoder -> dec_loss(z, mu, p)
+    enc = sae._encoder(len(dims))
+    dec_sym = mx.sym.Custom(z=enc, mu=mx.sym.Variable("mu"),
+                            p=mx.sym.Variable("p"), name="dec",
+                            op_type="dec_loss", num_centers=clusters)
+    mod = mx.module.Module(dec_sym, context=ctx, data_names=("data",),
+                           label_names=("p",))
+    mod.bind(data_shapes=[("data", (batch_size, x.shape[1]))],
+             label_shapes=[("p", (batch_size, clusters))])
+    # all args come from the pretrained encoder + kmeans centers ("mu"
+    # has no default-init name pattern, so it must arrive as a param)
+    mod.init_params(mx.init.Xavier(),
+                    arg_params={**sae.params,
+                                "mu": mx.nd.array(centers)},
+                    allow_missing=True, allow_extra=True)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "momentum": 0.9})
+
+    def soft_assign(zb, mu):
+        d2 = ((zb[:, None] - mu[None]) ** 2).sum(-1)
+        q = (1.0 + d2) ** -1.0
+        return q / q.sum(1, keepdims=True)
+
+    for epoch in range(epochs):
+        mu = mod.get_params()[0]["mu"].asnumpy()
+        # full-set target distribution P from current Q (reference updates
+        # p every `update_interval`; here once per epoch)
+        znow = sae._features(len(dims), x, 100) if epoch else z
+        q = soft_assign(znow, mu)
+        f = q.sum(0)
+        p = (q ** 2 / f) / (q ** 2 / f).sum(1, keepdims=True)
+        order = np.random.RandomState(epoch).permutation(n)
+        for s in range(0, n - batch_size + 1, batch_size):
+            idx = order[s:s + batch_size]
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(x[idx])],
+                label=[mx.nd.array(p[idx].astype("f"))])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        # keep the SAE param view fresh for _features
+        args, _ = mod.get_params()
+        sae.params = {k: v for k, v in args.items() if k != "mu"}
+
+    mu = mod.get_params()[0]["mu"].asnumpy()
+    zf = sae._features(len(dims), x, 100)
+    pred = soft_assign(zf, mu).argmax(1)
+    acc = cluster_accuracy(pred, y, clusters)
+    logging.info("cluster accuracy: kmeans %.3f -> DEC %.3f", acc0, acc)
+    return acc0, acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--clusters", type=int, default=4)
+    a = p.parse_args()
+    train(clusters=a.clusters)

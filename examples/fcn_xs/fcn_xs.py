@@ -1,0 +1,123 @@
+"""Fully-convolutional network for per-pixel segmentation (FCN-xs).
+
+Reference: ``example/fcn-xs/{symbol_fcnxs.py,fcn_xs.py,init_fcnxs.py}``
+— conv trunk downsamples, a 1x1 score layer maps to classes, a
+``Deconvolution`` initialized as bilinear upsampling restores input
+resolution, ``Crop`` aligns the upsampled map, and a skip branch from a
+shallower stage sharpens boundaries (the 32s -> 16s refinement);
+training is per-pixel ``SoftmaxOutput(multi_output=True)``.
+
+Data: synthetic images of rectangles of distinct classes on background,
+so CI can assert pixel accuracy well above the background-majority
+baseline.
+
+    python fcn_xs.py --epochs 6
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def fcn_symbol(num_classes=3, with_skip=True):
+    data = mx.sym.Variable("data")
+    # stage 1: /2
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), stride=(2, 2),
+                            pad=(1, 1), num_filter=16, name="conv1")
+    r1 = mx.sym.Activation(c1, act_type="relu")
+    # stage 2: /4
+    c2 = mx.sym.Convolution(r1, kernel=(3, 3), stride=(2, 2),
+                            pad=(1, 1), num_filter=32, name="conv2")
+    r2 = mx.sym.Activation(c2, act_type="relu")
+
+    score4 = mx.sym.Convolution(r2, kernel=(1, 1), num_filter=num_classes,
+                                name="score4")  # /4 resolution
+    if with_skip:
+        # FCN-16s-style refinement: upsample deep score x2, add the
+        # shallow stage's score, then upsample the sum the rest of the way
+        up2 = mx.sym.Deconvolution(score4, kernel=(4, 4), stride=(2, 2),
+                                   pad=(1, 1), num_filter=num_classes,
+                                   name="up2", no_bias=True)
+        score2 = mx.sym.Convolution(r1, kernel=(1, 1),
+                                    num_filter=num_classes, name="score2")
+        up2 = mx.sym.Crop(up2, score2, name="crop2")
+        fused = up2 + score2
+        up = mx.sym.Deconvolution(fused, kernel=(4, 4), stride=(2, 2),
+                                  pad=(1, 1), num_filter=num_classes,
+                                  name="upfinal", no_bias=True)
+    else:
+        up = mx.sym.Deconvolution(score4, kernel=(8, 8), stride=(4, 4),
+                                  pad=(2, 2), num_filter=num_classes,
+                                  name="upfinal", no_bias=True)
+    up = mx.sym.Crop(up, data, name="crop_final")
+    return mx.sym.SoftmaxOutput(up, multi_output=True, use_ignore=True,
+                                ignore_label=255, name="softmax")
+
+
+def synthetic_shapes(n, side=32, num_classes=3, seed=0):
+    """Background class 0; rectangles of class 1..num_classes-1 whose fill
+    intensity channel identifies the class."""
+    rng = np.random.RandomState(seed)
+    x = np.zeros((n, 1, side, side), "f")
+    y = np.zeros((n, side, side), "f")
+    for i in range(n):
+        for cls in range(1, num_classes):
+            h, w = rng.randint(6, 14, 2)
+            r, c = rng.randint(0, side - h), rng.randint(0, side - w)
+            x[i, 0, r:r + h, c:c + w] = cls / (num_classes - 1)
+            y[i, r:r + h, c:c + w] = cls
+        x[i] += 0.05 * rng.randn(side, side)
+    return x.astype("f"), y
+
+
+def pixel_accuracy(mod, it, n):
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(1)
+        lab = batch.label[0].asnumpy()
+        correct += (pred == lab).sum()
+        total += lab.size
+    return correct / total
+
+
+def train(epochs=6, batch_size=16, num_classes=3, with_skip=True,
+          ctx=None):
+    ctx = ctx or mx.context.current_context()
+    xtr, ytr = synthetic_shapes(512, seed=0, num_classes=num_classes)
+    xte, yte = synthetic_shapes(128, seed=1, num_classes=num_classes)
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size, shuffle=True)
+    test_iter = mx.io.NDArrayIter(xte, yte, batch_size)
+
+    net = fcn_symbol(num_classes, with_skip)
+    mod = mx.module.Module(net, context=ctx)
+    # bilinear-initialized upsampling, as init_fcnxs.py does for deconvs
+    mod.fit(train_iter, num_epoch=epochs,
+            initializer=mx.init.Mixed(
+                [".*up.*_weight", ".*"],
+                [mx.init.Bilinear(), mx.init.Xavier()]),
+            optimizer="adam", optimizer_params={"learning_rate": 3e-3},
+            eval_metric=mx.metric.Accuracy(axis=1),
+            batch_end_callback=mx.callback.Speedometer(batch_size, 10))
+    acc = pixel_accuracy(mod, test_iter, len(xte))
+    bg = float((yte == 0).mean())
+    logging.info("pixel accuracy %.3f (all-background baseline %.3f)",
+                 acc, bg)
+    return acc, bg
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    a = p.parse_args()
+    train(epochs=a.epochs)

@@ -1,0 +1,86 @@
+"""A numpy loss head: multiclass hinge gradient via PythonLossModule.
+
+Reference: ``example/module/python_loss.py`` — an MLP Module chained
+into a ``PythonLossModule`` whose gradient function is plain numpy; the
+SequentialModule routes labels to the loss and the loss's input grads
+back into the trunk.
+
+    python python_loss.py
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def mc_hinge_grad(scores, labels):
+    """Crammer-Singer multiclass hinge subgradient."""
+    scores = scores.asnumpy()
+    labels = labels.asnumpy().astype(np.int64)
+    n, _ = scores.shape
+    grad = np.zeros_like(scores)
+    for i in range(n):
+        score = 1 + scores[i] - scores[i, labels[i]]
+        score[labels[i]] = 0
+        ind_pred = score.argmax()
+        grad[i, labels[i]] -= 1
+        grad[i, ind_pred] += 1
+    return grad / n
+
+
+def synthetic(n, dim=196, seed=0):
+    protos = np.random.RandomState(42).rand(10, dim).astype("f")
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = protos[y] + 0.25 * rng.randn(n, dim).astype("f")
+    return x.astype("f"), y.astype("f")
+
+
+def train(epochs=4, batch_size=100, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+
+    mlp = mx.module.Module(fc3, label_names=[], context=ctx)
+    loss = mx.module.PythonLossModule(grad_func=mc_hinge_grad)
+    mod = mx.module.SequentialModule() \
+        .add(mlp) \
+        .add(loss, take_labels=True, auto_wiring=True)
+
+    xtr, ytr = synthetic(2000, seed=0)
+    xte, yte = synthetic(500, seed=1)
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size, shuffle=True)
+
+    mod.fit(train_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    # score by running the trunk alone
+    test_iter = mx.io.NDArrayIter(xte, yte, batch_size)
+    correct = total = 0
+    for batch in test_iter:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy()
+        lab = batch.label[0].asnumpy()
+        correct += (pred.argmax(1) == lab).sum()
+        total += len(lab)
+    acc = correct / total
+    logging.info("hinge-loss MLP test accuracy %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    train()

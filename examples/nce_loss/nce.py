@@ -1,0 +1,49 @@
+"""Noise-contrastive estimation loss layer and metrics.
+
+Reference: ``example/nce-loss/nce.py`` — score the true label plus k
+noise labels against the hidden vector via a shared label-embedding
+matrix, and train logistic outputs with the true/noise indicator as the
+target.  Avoids the full-vocab softmax matmul.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def nce_loss(data, label, label_weight, embed_weight, vocab_size,
+             num_hidden):
+    """data: (batch, num_hidden); label: (batch, num_label) candidate ids;
+    label_weight: (batch, num_label) 1 for the true label, 0 for noise."""
+    label_embed = mx.sym.Embedding(data=label, input_dim=vocab_size,
+                                   weight=embed_weight,
+                                   output_dim=num_hidden,
+                                   name="label_embed")
+    data = mx.sym.Reshape(data=data, shape=(-1, 1, num_hidden))
+    pred = mx.sym.broadcast_mul(data, label_embed)
+    pred = mx.sym.sum(data=pred, axis=2)
+    return mx.sym.LogisticRegressionOutput(data=pred, label=label_weight)
+
+
+class NceAuc(mx.metric.EvalMetric):
+    """AUC over (indicator, score) pairs pooled across the batch."""
+
+    def __init__(self):
+        super().__init__("nce-auc")
+
+    def update(self, labels, preds):
+        w = labels[1].asnumpy().ravel()
+        p = preds[0].asnumpy().ravel()
+        order = np.argsort(-p)
+        w = w[order]
+        n_pos = w.sum()
+        n_neg = len(w) - n_pos
+        if n_pos == 0 or n_neg == 0:
+            return
+        # rank-sum AUC
+        ranks = np.arange(1, len(w) + 1)
+        auc = (ranks[w > 0.5].sum() - n_pos * (n_pos + 1) / 2)
+        auc = 1.0 - auc / (n_pos * n_neg)
+        self.sum_metric += auc
+        self.num_inst += 1

@@ -1,0 +1,112 @@
+"""Toy NCE training: learn a many-class mapping without a full softmax.
+
+Reference: ``example/nce-loss/toy_nce.py`` — a feature vector maps to one
+of ``vocab_size`` classes; the NCE head scores the true class against
+sampled noise classes.  The AUC metric over true-vs-noise scores should
+approach 1 as the embedding learns.
+
+    python toy_nce.py --epochs 8
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from nce import nce_loss, NceAuc
+
+
+def get_net(vocab_size, num_label, num_hidden=64):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    label_weight = mx.sym.Variable("label_weight")
+    embed_weight = mx.sym.Variable("embed_weight")
+    pred = mx.sym.FullyConnected(data=data, num_hidden=num_hidden,
+                                 name="trunk")
+    return nce_loss(data=pred, label=label, label_weight=label_weight,
+                    embed_weight=embed_weight, vocab_size=vocab_size,
+                    num_hidden=num_hidden)
+
+
+class ToyNCEIter(mx.io.DataIter):
+    """Feature = noisy one-hot-ish projection of the class; label row =
+    [true_class, noise...] with weight [1, 0, ...]."""
+
+    def __init__(self, count, batch_size, vocab_size, num_label,
+                 feature_size, seed=0):
+        super().__init__()
+        self.batch_size = batch_size
+        self.count = count
+        self.vocab_size = vocab_size
+        self.num_label = num_label
+        self.feature_size = feature_size
+        self.rng = np.random.RandomState(seed)
+        # class->feature projection shared across train/val iterators
+        self.proj = np.random.RandomState(42).randn(
+            vocab_size, feature_size).astype("f")
+        self.provide_data = [mx.io.DataDesc("data",
+                                            (batch_size, feature_size))]
+        self.provide_label = [
+            mx.io.DataDesc("label", (batch_size, num_label)),
+            mx.io.DataDesc("label_weight", (batch_size, num_label))]
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= self.count:
+            raise StopIteration
+        self._i += 1
+        cls = self.rng.randint(0, self.vocab_size, self.batch_size)
+        data = self.proj[cls] + 0.1 * self.rng.randn(
+            self.batch_size, self.feature_size).astype("f")
+        noise = self.rng.randint(0, self.vocab_size,
+                                 (self.batch_size, self.num_label - 1))
+        label = np.concatenate([cls[:, None], noise], axis=1)
+        weight = np.zeros_like(label, dtype="f")
+        weight[:, 0] = 1.0
+        return mx.io.DataBatch(
+            data=[mx.nd.array(data.astype("f"))],
+            label=[mx.nd.array(label.astype("f")),
+                   mx.nd.array(weight)],
+            pad=0, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+
+def train(epochs=8, batch_size=128, vocab_size=100, num_label=6,
+          feature_size=32, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    data_train = ToyNCEIter(60, batch_size, vocab_size, num_label,
+                            feature_size)
+    data_val = ToyNCEIter(10, batch_size, vocab_size, num_label,
+                          feature_size, seed=1)
+    net = get_net(vocab_size, num_label)
+    mod = mx.module.Module(net, context=ctx,
+                           data_names=("data",),
+                           label_names=("label", "label_weight"))
+    metric = NceAuc()
+    mod.fit(data_train, eval_data=data_val, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                              "wd": 1e-5},
+            eval_metric=metric)
+    val_auc = mod.score(data_val, NceAuc())[0][1]
+    logging.info("validation NCE AUC %.3f", val_auc)
+    return val_auc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    a = p.parse_args()
+    train(epochs=a.epochs)

@@ -1,0 +1,127 @@
+"""Neural style transfer: optimize the *input image* through a frozen
+feature network.
+
+Reference: ``example/neural-style/nstyle.py`` — content features + style
+Gram matrices from conv activations define the loss; the executor's
+gradient w.r.t. the data argument (everything else ``grad_req='null'``)
+drives plain gradient descent on the pixels.  The reference extracts
+features from downloaded VGG19 weights; offline, a fixed random conv
+net plays that role — random projections still define Gram/content
+targets, and the optimization mechanics (the point of the example) are
+identical.  Swap in converted VGG19 weights via ``set_params`` for real
+stylization.
+
+    python nstyle.py --iters 60
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def feature_net():
+    """Small conv stack; relu1/relu2 = style taps, relu3 = content tap
+    (the VGG19 relphases 1_1/2_1 vs 4_2 in the reference)."""
+    data = mx.sym.Variable("data")
+    taps = []
+    x = data
+    for i, nf in enumerate((16, 32, 64)):
+        x = mx.sym.Convolution(x, kernel=(3, 3), pad=(1, 1),
+                               num_filter=nf, name="conv%d" % i)
+        x = mx.sym.Activation(x, act_type="relu")
+        taps.append(x)
+        if i < 2:
+            x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                               pool_type="avg")
+    return taps[:2], taps[2]
+
+
+def gram(feat):
+    """(1,C,H,W) -> (C,C) Gram matrix symbol."""
+    c = mx.sym.Reshape(feat, shape=(0, -1))      # drop batch=1 -> (C, HW)
+    return mx.sym.dot(c, c, transpose_b=True)
+
+
+def style_content_loss(style_w, content_w):
+    style_taps, content_tap = feature_net()
+    losses = []
+    for i, s in enumerate(style_taps):
+        target = mx.sym.Variable("style_target%d" % i)
+        g = gram(mx.sym.Reshape(s, shape=(-3, -2)))  # merge batch into C
+        losses.append(style_w * mx.sym.sum(mx.sym.square(g - target)))
+    ct = mx.sym.Variable("content_target")
+    losses.append(content_w * mx.sym.sum(
+        mx.sym.square(content_tap - ct)))
+    return mx.sym.Group([mx.sym.MakeLoss(l) for l in losses])
+
+
+def run(iters=60, size=48, lr=0.2, style_w=1e-6, content_w=1e-3,
+        ctx=None, seed=0):
+    ctx = ctx or mx.context.current_context()
+    rng = np.random.RandomState(seed)
+    style_img = rng.rand(1, 3, size, size).astype("f")
+    content_img = rng.rand(1, 3, size, size).astype("f")
+
+    # --- extract targets with a forward-only executor ------------------
+    style_taps, content_tap = feature_net()
+    extract = mx.sym.Group(list(style_taps) + [content_tap])
+    fixed_args = {
+        name: mx.nd.array(rng.randn(*shape).astype("f") * 0.3)
+        for name, shape in zip(
+            extract.list_arguments(),
+            extract.infer_shape(data=(1, 3, size, size))[0])
+        if name != "data"}
+    ex = extract.bind(ctx, dict(fixed_args,
+                                data=mx.nd.array(style_img)),
+                      grad_req="null")
+    ex.forward()
+    style_targets = []
+    for o in ex.outputs[:2]:
+        f = o.asnumpy().reshape(o.shape[1], -1)
+        style_targets.append(f @ f.T)
+    ex2 = extract.bind(ctx, dict(fixed_args,
+                                 data=mx.nd.array(content_img)),
+                       grad_req="null")
+    ex2.forward()
+    content_target = ex2.outputs[2].asnumpy()
+
+    # --- optimization executor: grad only w.r.t. data ------------------
+    loss_sym = style_content_loss(style_w, content_w)
+    img = mx.nd.array(rng.rand(1, 3, size, size).astype("f"))
+    args = dict(fixed_args)
+    args["data"] = img
+    args["style_target0"] = mx.nd.array(style_targets[0])
+    args["style_target1"] = mx.nd.array(style_targets[1])
+    args["content_target"] = mx.nd.array(content_target)
+    grad_img = mx.nd.zeros(img.shape, ctx=ctx)
+    exo = loss_sym.bind(ctx, args, args_grad={"data": grad_img},
+                        grad_req={"data": "write"})
+
+    history = []
+    for it in range(iters):
+        exo.forward(is_train=True)
+        loss = sum(float(o.asnumpy()) for o in exo.outputs)
+        exo.backward()
+        g = grad_img.asnumpy()
+        new = np.clip(args["data"].asnumpy() - lr * g, 0, 1)
+        args["data"][:] = new
+        history.append(loss)
+        if (it + 1) % 20 == 0:
+            logging.info("iter %d  loss %.5f", it + 1, loss)
+    return history
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=60)
+    a = p.parse_args()
+    run(iters=a.iters)

@@ -1,0 +1,205 @@
+"""DQN on a self-contained CartPole environment.
+
+Reference: ``example/reinforcement-learning/dqn/`` — Q-network +
+target network, epsilon-greedy acting, uniform replay memory, TD
+targets from the frozen copy.  The reference plays ALE Atari through
+OpenCV; neither is available offline, so the classic CartPole dynamics
+(Barto-Sutton-Anderson) are implemented here in ~30 lines of numpy —
+the DQN mechanics are identical.
+
+    python dqn_cartpole.py --episodes 150
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+class CartPole:
+    """Classic cart-pole balancing; episode ends on |x|>2.4, |θ|>12°,
+    or 200 steps."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+        self.state = None
+        self.steps = 0
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, 4).astype("f")
+        self.steps = 0
+        return self.state.copy()
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self.state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(th), np.sin(th)
+        temp = (force + 0.05 * th_dot ** 2 * sinth) / 1.1
+        th_acc = (9.8 * sinth - costh * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / 1.1))
+        x_acc = temp - 0.05 * th_acc * costh / 1.1
+        dt = 0.02
+        self.state = np.array([x + dt * x_dot, x_dot + dt * x_acc,
+                               th + dt * th_dot, th_dot + dt * th_acc],
+                              dtype="f")
+        self.steps += 1
+        done = (abs(self.state[0]) > 2.4 or
+                abs(self.state[2]) > 12 * np.pi / 180 or
+                self.steps >= 200)
+        return self.state.copy(), 1.0, done
+
+
+class ReplayMemory:
+    """Uniform-sampling circular buffer (reference replay_memory.py)."""
+
+    def __init__(self, capacity, state_dim, seed=0):
+        self.capacity = capacity
+        self.s = np.zeros((capacity, state_dim), "f")
+        self.a = np.zeros(capacity, np.int64)
+        self.r = np.zeros(capacity, "f")
+        self.s2 = np.zeros((capacity, state_dim), "f")
+        self.done = np.zeros(capacity, "f")
+        self.size = self.pos = 0
+        self.rng = np.random.RandomState(seed)
+
+    def push(self, s, a, r, s2, done):
+        i = self.pos
+        self.s[i], self.a[i], self.r[i] = s, a, r
+        self.s2[i], self.done[i] = s2, float(done)
+        self.pos = (self.pos + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n):
+        idx = self.rng.randint(0, self.size, n)
+        return (self.s[idx], self.a[idx], self.r[idx], self.s2[idx],
+                self.done[idx])
+
+
+def q_network(num_actions):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, act_type="relu")
+    return mx.sym.FullyConnected(act2, name="qvals",
+                                 num_hidden=num_actions)
+
+
+class DQNAgent:
+    """Q + frozen target module pair; TD(0) regression on sampled
+    transitions (reference base.py/dqn_demo.py training loop)."""
+
+    def __init__(self, state_dim, num_actions, batch_size, ctx,
+                 lr=1e-3, gamma=0.99):
+        self.num_actions = num_actions
+        self.gamma = gamma
+        self.batch_size = batch_size
+        qsym = q_network(num_actions)
+        # training head: MSE on the chosen action's Q via act_mask
+        data = mx.sym.Variable("data")
+        target = mx.sym.Variable("target")      # (batch, num_actions)
+        mask = mx.sym.Variable("mask")          # one-hot chosen action
+        q = q_network(num_actions)
+        loss = mx.sym.LinearRegressionOutput(
+            data=q * mask + (1 - mask) * mx.sym.BlockGrad(q),
+            label=target, name="td")
+        self.train_mod = mx.module.Module(
+            loss, context=ctx, data_names=("data", "mask"),
+            label_names=("target",))
+        self.train_mod.bind(
+            data_shapes=[("data", (batch_size, state_dim)),
+                         ("mask", (batch_size, num_actions))],
+            label_shapes=[("target", (batch_size, num_actions))])
+        self.train_mod.init_params(mx.init.Xavier())
+        self.train_mod.init_optimizer(
+            optimizer="adam", optimizer_params={"learning_rate": lr})
+
+        self.act_mod = mx.module.Module(qsym, context=ctx,
+                                        label_names=[])
+        self.act_mod.bind(data_shapes=[("data", (1, state_dim))],
+                          for_training=False)
+        self.target_mod = mx.module.Module(qsym, context=ctx,
+                                           label_names=[])
+        self.target_mod.bind(
+            data_shapes=[("data", (batch_size, state_dim))],
+            for_training=False)
+        self.sync_acting()
+        self.sync_target()
+
+    def sync_acting(self):
+        self.act_mod.set_params(*self.train_mod.get_params())
+
+    def sync_target(self):
+        self.target_mod.set_params(*self.train_mod.get_params())
+
+    def act(self, state, eps, rng):
+        if rng.rand() < eps:
+            return rng.randint(self.num_actions)
+        self.act_mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(state[None])]), is_train=False)
+        return int(self.act_mod.get_outputs()[0].asnumpy().argmax())
+
+    def learn(self, replay):
+        s, a, r, s2, done = replay.sample(self.batch_size)
+        self.target_mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(s2)]), is_train=False)
+        q2 = self.target_mod.get_outputs()[0].asnumpy()
+        td = r + self.gamma * (1 - done) * q2.max(1)
+        mask = np.zeros((self.batch_size, self.num_actions), "f")
+        mask[np.arange(self.batch_size), a] = 1
+        target = mask * td[:, None]
+        self.train_mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(s), mx.nd.array(mask)],
+            label=[mx.nd.array(target)]), is_train=True)
+        self.train_mod.backward()
+        self.train_mod.update()
+
+
+def train(episodes=150, batch_size=64, ctx=None, seed=0,
+          target_sync=200, eps_decay_episodes=100):
+    ctx = ctx or mx.context.current_context()
+    env = CartPole(seed)
+    rng = np.random.RandomState(seed + 1)
+    agent = DQNAgent(4, 2, batch_size, ctx)
+    replay = ReplayMemory(20000, 4, seed + 2)
+    lengths = []
+    step_count = 0
+    for ep in range(episodes):
+        eps = max(0.05, 1.0 - ep / eps_decay_episodes)
+        s = env.reset()
+        done = False
+        ep_len = 0
+        agent.sync_acting()
+        while not done:
+            a = agent.act(s, eps, rng)
+            s2, r, done = env.step(a)
+            # terminal-by-timeout is not a true failure state
+            fail = done and env.steps < 200
+            replay.push(s, a, r, s2, fail)
+            s = s2
+            ep_len += 1
+            step_count += 1
+            if replay.size >= batch_size and step_count % 4 == 0:
+                agent.learn(replay)
+            if step_count % target_sync == 0:
+                agent.sync_target()
+        lengths.append(ep_len)
+        if (ep + 1) % 20 == 0:
+            logging.info("episode %d  eps %.2f  mean length (last 20) "
+                         "%.1f", ep + 1, eps, np.mean(lengths[-20:]))
+    return lengths
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=150)
+    a = p.parse_args()
+    train(episodes=a.episodes)

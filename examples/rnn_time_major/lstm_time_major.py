@@ -1,0 +1,121 @@
+"""Time-major (TNC) LSTM language model.
+
+Reference: ``example/rnn-time-major/`` — the same bucketing LM as
+``example/rnn`` but with time-major data layout, which avoids the
+per-step batch-major slicing ("up to 1.5x faster" in the reference's
+README on cuDNN).  Here the unroll's ``layout="TNC"`` drives
+``lax.scan`` directly over the leading time axis — the natural scan
+layout on TPU as well.
+
+    python lstm_time_major.py --epochs 3
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+class TimeMajorIter(mx.io.DataIter):
+    """Serves (seq_len, batch) token arrays + shifted targets."""
+
+    def __init__(self, sentences, batch_size, seq_len, vocab_size,
+                 seed=0):
+        super().__init__()
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        flat = np.concatenate(sentences)
+        n_batches = len(flat) // (batch_size * seq_len + 1)
+        self.n_batches = n_batches
+        self.data = flat[: n_batches * batch_size * seq_len].reshape(
+            batch_size, n_batches * seq_len)
+        self.target = flat[1: n_batches * batch_size * seq_len + 1] \
+            .reshape(batch_size, n_batches * seq_len)
+        self.provide_data = [mx.io.DataDesc("data",
+                                            (seq_len, batch_size))]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (seq_len, batch_size))]
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= self.n_batches:
+            raise StopIteration
+        s = self.cur * self.seq_len
+        self.cur += 1
+        # (batch, T) slice -> time-major (T, batch)
+        d = self.data[:, s:s + self.seq_len].T
+        t = self.target[:, s:s + self.seq_len].T
+        return mx.io.DataBatch(
+            data=[mx.nd.array(d.astype("f"))],
+            label=[mx.nd.array(t.astype("f"))],
+            pad=0, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+
+def make_sym(seq_len, vocab_size, num_hidden=64, num_embed=32,
+             num_layers=1):
+    data = mx.sym.Variable("data")          # (T, N)
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                  prefix="lstm_l%d_" % i))
+    outputs, _ = stack.unroll(seq_len, inputs=embed, layout="TNC",
+                              merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                 name="pred")
+    label = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, label=label, name="softmax")
+
+
+def synthetic_corpus(n=500, vocab_size=60, seed=0):
+    """Markov-ish token stream: next token depends on the previous one,
+    so an LSTM beats the unigram baseline measurably."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab_size) * 0.1, size=vocab_size)
+    out = []
+    for _ in range(n):
+        sent = [rng.randint(vocab_size)]
+        for _ in range(rng.randint(10, 30)):
+            sent.append(rng.choice(vocab_size, p=trans[sent[-1]]))
+        out.append(np.array(sent))
+    return out
+
+
+def train(epochs=3, batch_size=16, seq_len=16, vocab_size=60,
+          ctx=None):
+    ctx = ctx or mx.context.current_context()
+    corpus = synthetic_corpus(vocab_size=vocab_size)
+    it = TimeMajorIter(corpus, batch_size, seq_len, vocab_size)
+    net = make_sym(seq_len, vocab_size)
+    mod = mx.module.Module(net, context=ctx)
+    mod.fit(it, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 5e-3},
+            eval_metric=mx.metric.Perplexity(None),
+            batch_end_callback=mx.callback.Speedometer(batch_size, 20))
+    ppl = mod.score(it, mx.metric.Perplexity(None))[0][1]
+    logging.info("train perplexity %.1f (vocab %d)", ppl, vocab_size)
+    return ppl
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    a = p.parse_args()
+    train(epochs=a.epochs)

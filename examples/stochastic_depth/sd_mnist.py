@@ -1,0 +1,112 @@
+"""Stochastic-depth residual network (Huang et al. 2016).
+
+Reference: ``example/stochastic-depth/{sd_module.py,sd_mnist.py,
+sd_cifar10.py}`` — residual blocks whose transform branch is randomly
+dropped per sample during training.  The reference implements the skip
+at the module level (one Module per block, a python coin flip deciding
+whether to execute it); under XLA the graph is compiled once, so the
+TPU-native formulation puts the coin flip *in* the graph: a per-sample
+Bernoulli gate = ``Dropout`` on a ones-vector (inverted scaling makes
+inference the identity, matching the expected-depth rule).
+
+    python sd_mnist.py --epochs 4
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def get_conv(name, data, num_filter, kernel, stride, pad, with_relu=True):
+    conv = mx.sym.Convolution(name=name, data=data, num_filter=num_filter,
+                              kernel=kernel, stride=stride, pad=pad,
+                              no_bias=True)
+    bn = mx.sym.BatchNorm(name=name + "_bn", data=conv, fix_gamma=False,
+                          eps=2e-5)
+    return (mx.sym.Activation(name=name + "_relu", data=bn,
+                              act_type="relu") if with_relu else bn)
+
+
+def sd_block(name, data, num_filter, death_rate):
+    """Residual block with a per-sample stochastic-depth gate."""
+    branch = get_conv(name + "_c1", data, num_filter, (3, 3), (1, 1),
+                      (1, 1), with_relu=True)
+    branch = get_conv(name + "_c2", branch, num_filter, (3, 3), (1, 1),
+                      (1, 1), with_relu=False)
+    if death_rate > 0:
+        # (batch, 1, 1, 1) inverted-Bernoulli gate: 1/(1-p) with prob
+        # 1-p at train time, exactly 1 at inference.
+        ones = mx.sym.ones_like(
+            mx.sym.slice_axis(
+                mx.sym.slice_axis(
+                    mx.sym.slice_axis(branch, axis=1, begin=0, end=1),
+                    axis=2, begin=0, end=1),
+                axis=3, begin=0, end=1))
+        gate = mx.sym.Dropout(ones, p=death_rate,
+                              name=name + "_gate")
+        branch = mx.sym.broadcast_mul(branch, gate)
+    out = data + branch
+    return mx.sym.Activation(out, act_type="relu",
+                             name=name + "_out_relu")
+
+
+def make_sd_net(num_blocks=3, num_filter=16, final_death_rate=0.5,
+                num_classes=10):
+    data = mx.sym.Variable("data")
+    net = get_conv("conv0", data, num_filter, (3, 3), (1, 1), (1, 1))
+    for i in range(num_blocks):
+        # linearly increasing death rate, as in the paper / reference
+        rate = final_death_rate * (i + 1) / num_blocks
+        net = sd_block("block%d" % i, net, num_filter, rate)
+    pool = mx.sym.Pooling(net, pool_type="avg", kernel=(7, 7),
+                          global_pool=True)
+    flat = mx.sym.Flatten(pool)
+    fc = mx.sym.FullyConnected(flat, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def synthetic_mnist(n, side=14, classes=10, seed=0):
+    protos = np.random.RandomState(42).rand(
+        classes, 1, side, side).astype("f")
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    x = protos[y] + 0.2 * rng.randn(n, 1, side, side).astype("f")
+    return x.astype("f"), y.astype("f")
+
+
+def train(epochs=8, batch_size=100, num_blocks=3, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    xtr, ytr = synthetic_mnist(2000, seed=0)
+    xte, yte = synthetic_mnist(500, seed=1)
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size, shuffle=True)
+    test_iter = mx.io.NDArrayIter(xte, yte, batch_size)
+
+    net = make_sd_net(num_blocks=num_blocks)
+    mod = mx.module.Module(net, context=ctx)
+    mod.fit(train_iter, eval_data=test_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                              "wd": 1e-4},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(batch_size, 10))
+    acc = mod.score(test_iter, mx.metric.Accuracy())[0][1]
+    logging.info("test accuracy %.3f (%d stochastic blocks)",
+                 acc, num_blocks)
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    a = p.parse_args()
+    train(epochs=a.epochs)

@@ -68,6 +68,8 @@ except ImportError:  # pragma: no cover
     image = None
     image_det = None
 
+from . import rtc
+
 # optional: torch interop (plugin/torch + python/mxnet/torch.py parity)
 try:
     from . import torch as th

@@ -263,14 +263,15 @@ class BaseModule:
         raise NotImplementedError()
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False):
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         raise NotImplementedError()
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True):
+                   force_init=True, allow_extra=False):
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+                         force_init=force_init, allow_extra=allow_extra)
 
     def save_params(self, fname):
         from .. import ndarray as nd

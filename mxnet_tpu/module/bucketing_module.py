@@ -86,7 +86,8 @@ class BucketingModule(BaseModule):
         return params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False):
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
@@ -94,17 +95,19 @@ class BucketingModule(BaseModule):
                                       arg_params=arg_params,
                                       aux_params=aux_params,
                                       allow_missing=allow_missing,
-                                      force_init=force_init)
+                                      force_init=force_init,
+                                      allow_extra=allow_extra)
         self._params_dirty = False
         self.params_initialized = True
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True):
+                   force_init=True, allow_extra=False):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
                              aux_params=aux_params,
                              allow_missing=allow_missing,
-                             force_init=force_init)
+                             force_init=force_init,
+                             allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
             return

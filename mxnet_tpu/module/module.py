@@ -130,9 +130,15 @@ class Module(BaseModule):
 
     @property
     def output_shapes(self):
+        """Inferred output shapes — available right after bind, before any
+        forward (reference module.py output_shapes reads the bound
+        executor's inferred shapes, not computed outputs)."""
         assert self.binded
-        outputs = self._exec_group.get_outputs()
-        return list(zip(self._output_names, [o.shape for o in outputs]))
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        for d in (self._label_shapes or []):
+            shapes[d.name] = d.shape
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names, out_shapes))
 
     # -------------------------------------------------------------- params
     def get_params(self):
@@ -142,7 +148,8 @@ class Module(BaseModule):
         return (self._arg_params, self._aux_params)
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False):
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         """Initialize parameters (reference module.py:157-226)."""
         if self.params_initialized and not force_init:
             logging.warning("Parameters already initialized and force_init "
@@ -163,6 +170,17 @@ class Module(BaseModule):
             else:
                 initializer(name, arr)
 
+        if not allow_extra:
+            # reference module.py raises on cache keys the model has no
+            # slot for — silence here would drop a typo'd key unnoticed
+            extra = set(arg_params or ()) - set(self._arg_params)
+            extra |= set(aux_params or ()) - set(self._aux_params)
+            if extra:
+                raise ValueError(
+                    "set_params/init_params got params not in the "
+                    "module: %s (pass allow_extra=True to ignore)"
+                    % sorted(extra))
+
         attrs = self._symbol.attr_dict()
         for name, arr in sorted(self._arg_params.items()):
             desc = InitDesc(name, attrs.get(name, None))
@@ -176,12 +194,13 @@ class Module(BaseModule):
         self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True):
+                   force_init=True, allow_extra=False):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
                              aux_params=aux_params,
                              allow_missing=allow_missing,
-                             force_init=force_init)
+                             force_init=force_init,
+                             allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
             logging.warning("Parameters already initialized and force_init "

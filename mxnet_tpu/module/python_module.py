@@ -60,7 +60,7 @@ class PythonModule(BaseModule):
         return (dict(), dict())
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False):
+                    allow_missing=False, force_init=False, allow_extra=False):
         self.params_initialized = True
 
     def update(self):
@@ -68,9 +68,10 @@ class PythonModule(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         if self._label_shapes is None:
-            pass
-        else:
-            raise NotImplementedError()
+            # no labels consumed: not a loss/prediction module — ignore
+            return
+        # default: outputs are scores the metric can evaluate directly
+        eval_metric.update(labels, self.get_outputs())
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

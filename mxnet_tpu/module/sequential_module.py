@@ -75,7 +75,8 @@ class SequentialModule(BaseModule):
         return (arg_params, aux_params)
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False):
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"

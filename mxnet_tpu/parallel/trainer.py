@@ -139,7 +139,7 @@ class ShardedTrainer:
                  optimizer="sgd", optimizer_params=None, learning_rate=0.05,
                  momentum=0.9, weight_decay=0.0, initializer=None,
                  dtype="float32", tp_rules=None, seed=0, layout=None,
-                 auto_layouts=False):
+                 auto_layouts=False, fuse_conv_bn=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -173,6 +173,12 @@ class ShardedTrainer:
         if layout not in (None, "NCHW", "NHWC"):
             raise MXNetError("unsupported layout %r" % (layout,))
         self._layout = layout or "NCHW"
+        # fuse_conv_bn: conv1x1+BN GEMM-with-stats-epilogue fusion
+        # (ops/fused.py); None -> MXNET_FUSE_CONV_BN env default
+        if fuse_conv_bn is None:
+            from ..ops import fused as _fused_mod
+            fuse_conv_bn = _fused_mod.fusion_enabled()
+        self._fuse_conv_bn = bool(fuse_conv_bn) and self._layout == "NHWC"
 
         self._topo = symbol._topo()
         if self._layout == "NHWC":
@@ -389,8 +395,10 @@ class ShardedTrainer:
             def fwd(p32):
                 # compute-precision copies of the f32 masters; the astype
                 # vjp returns f32 grads automatically
+                from ..ops.fused import conv_bn_fusion
                 p = {k: v.astype(compute_dtype) for k, v in p32.items()}
-                with image_layout(layout):
+                with image_layout(layout), \
+                        conv_bn_fusion(self._fuse_conv_bn):
                     var_values = self._node_value_map(p, batch, aux)
                     heads, aux_upd = eval_graph(topo, entries, var_values,
                                                 is_train=True, key=key,

@@ -124,12 +124,47 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
     vals = {}
     aux_updates = {}
     device_map = device_map or {}
+
+    # optional conv1x1+BN fusion (ops/fused.py): deferred convs carry
+    # their input values to the consuming BatchNorm node
+    fuse_plan, fuse_skip = {}, set()
+    if is_train and not device_map:
+        from .ops import fused as _fused
+        from .ops.nn import current_image_layout
+        if _fused.fusion_enabled() and current_image_layout() == "NHWC":
+            fuse_plan, fuse_skip = _fused.plan_conv_bn_fusion(topo, entries)
+
     for i, node in enumerate(topo):
         if node.is_variable:
             try:
                 vals[id(node)] = (var_values[id(node)],)
             except KeyError:
                 raise MXNetError("no value bound for variable %r" % node.name)
+            continue
+        if id(node) in fuse_skip:
+            # conv deferred into its BatchNorm consumer
+            vals[id(node)] = (tuple(vals[id(src)][idx]
+                                    for (src, idx) in node.inputs),)
+            continue
+        if id(node) in fuse_plan:
+            from .ops import fused as _fused
+            conv_node = fuse_plan[id(node)]
+            conv_ins = vals[id(conv_node)][0]
+            bn_ins = [vals[id(src)][idx]
+                      for (src, idx) in node.inputs[1:]]
+            outs = _fused.fused_conv_bn_apply(
+                conv_node.attrs, node.attrs, is_train,
+                conv_ins[0], conv_ins[1], *bn_ins)
+            n_vis = node.num_outputs()
+            n_aux = len(node.inputs) - node.num_args
+            vals[id(node)] = outs[:n_vis]
+            for (src, _), upd in zip(node.inputs[node.num_args:],
+                                     outs[n_vis:n_vis + n_aux]):
+                if src.is_variable:
+                    aux_updates[id(src)] = upd
+            if monitor is not None:
+                for oname, val in zip(node.output_names(), outs[:n_vis]):
+                    monitor(oname, val)
             continue
         ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
         dev = device_map.get(id(node))

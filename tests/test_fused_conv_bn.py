@@ -1,0 +1,89 @@
+"""Conv(1x1)+BatchNorm fusion (ops/fused.py): plan eligibility and
+numerical parity (forward, gradients, aux updates) against the unfused
+graph on the CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import fused
+from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+
+def _bottleneck_net(num_classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                             num_filter=8, no_bias=True, name="conv0")
+    net = mx.sym.BatchNorm(net, name="bn0", fix_gamma=False)
+    net = mx.sym.Activation(net, act_type="relu")
+    # the fusable pair: pointwise conv feeding its BN and nothing else
+    net = mx.sym.Convolution(net, kernel=(1, 1), num_filter=16,
+                             no_bias=True, name="conv1x1")
+    net = mx.sym.BatchNorm(net, name="bn1", fix_gamma=False)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_fusion_plan_finds_pointwise_pair():
+    sym = _bottleneck_net()
+    plan, skip = fused.plan_conv_bn_fusion(sym._topo(), sym._entries)
+    assert len(plan) == 1 and len(skip) == 1
+    conv = next(iter(plan.values()))
+    assert conv.name == "conv1x1"
+
+
+def test_fusion_plan_rejects_multi_consumer():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(1, 1), num_filter=4,
+                           no_bias=True, name="c")
+    bn = mx.sym.BatchNorm(c, name="bn")
+    out = bn + c          # conv consumed twice
+    plan, skip = fused.plan_conv_bn_fusion(out._topo(), out._entries)
+    assert not plan and not skip
+
+
+def _make(fuse, dtype="float32"):
+    mesh = build_mesh(tp=1)
+    np.random.seed(7)
+    return ShardedTrainer(
+        _bottleneck_net(), mesh,
+        data_shapes={"data": (8, 3, 8, 8)},
+        label_shapes={"softmax_label": (8,)},
+        layout="NHWC", dtype=dtype, seed=3, learning_rate=0.1,
+        momentum=0.9, fuse_conv_bn=fuse)
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "data": (rng.uniform(-1, 1, (8, 3, 8, 8)) * 3.0 + 0.5)
+        .astype(np.float32),
+        "softmax_label": rng.randint(0, 10, 8).astype(np.float32),
+    }
+
+
+def test_fused_step_matches_unfused():
+    """Two training steps with and without fusion produce the same
+    params, aux stats, and loss (f32, CPU fallback kernel)."""
+    t_ref = _make(False)
+    t_fused = _make(True)
+    b1 = t_ref.put_batch(_batch(0))
+    b2 = t_fused.put_batch(_batch(0))
+    losses = []
+    for t, b in ((t_ref, b1), (t_fused, b2)):
+        l1 = float(t.step(b))
+        l2 = float(t.step(b))
+        losses.append((l1, l2))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5,
+                               atol=1e-6)
+    for k in t_ref.params:
+        np.testing.assert_allclose(
+            np.asarray(t_fused.params[k]), np.asarray(t_ref.params[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k)
+    for k in t_ref.aux:
+        np.testing.assert_allclose(
+            np.asarray(t_fused.aux[k]), np.asarray(t_ref.aux[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k)

@@ -1,0 +1,47 @@
+"""mx.rtc runtime kernel compilation (reference python/mxnet/rtc.py,
+tests/python/gpu/test_rtc.py; NVRTC role played by Pallas/Mosaic)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_rtc_source_kernel():
+    """The reference test_rtc.py flow: compile a source kernel, push."""
+    x = mx.nd.array(np.random.RandomState(0).randn(100, 10)
+                    .astype("f"))
+    y = mx.nd.zeros((100, 10))
+    rtc = mx.rtc.Rtc("abs", [("x", x)], [("y", y)], """
+y_ref[:] = jnp.abs(x_ref[:])
+""")
+    rtc.push([x], [y], (1, 1, 1), (1, 1, 1))
+    np.testing.assert_allclose(y.asnumpy(), np.abs(x.asnumpy()),
+                               rtol=1e-6)
+
+
+def test_rtc_callable_kernel_two_inputs():
+    a = mx.nd.array(np.arange(64, dtype="f").reshape(8, 8))
+    b = mx.nd.array(np.ones((8, 8), "f") * 2)
+    out = mx.nd.zeros((8, 8))
+
+    def kern(a_ref, b_ref, out_ref):
+        out_ref[:] = a_ref[:] * b_ref[:] + 1.0
+
+    rtc = mx.rtc.Rtc("muladd", [("a", a), ("b", b)], [("out", out)],
+                     kern)
+    rtc.push([a, b], [out])
+    np.testing.assert_allclose(out.asnumpy(),
+                               a.asnumpy() * 2 + 1, rtol=1e-6)
+
+
+def test_rtc_gridded_kernel():
+    """grid_dims[0] > 1 exposes pl.program_id(0) like blockIdx.x."""
+    x = mx.nd.array(np.ones((4, 128), "f"))
+    y = mx.nd.zeros((4, 128))
+    rtc = mx.rtc.Rtc("rowscale", [("x", x)], [("y", y)], """
+i = pl.program_id(0)
+y_ref[i, :] = x_ref[i, :] * (i + 1)
+""")
+    rtc.push([x], [y], (4, 1, 1), (1, 1, 1))
+    np.testing.assert_allclose(y.asnumpy(),
+                               np.arange(1, 5)[:, None] *
+                               np.ones((4, 128), "f"))
