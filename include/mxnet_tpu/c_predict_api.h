@@ -1,0 +1,85 @@
+/*
+ * C prediction ABI for mxnet_tpu — the deployment boundary.
+ *
+ * Role parity: include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc
+ * in the reference (and the amalgamation's libmxnet_predict).  The same
+ * flat MXPred* entry points are exported from libmxtpu_predict.so; under
+ * the hood an embedded CPython drives mxnet_tpu.predictor.Predictor, so
+ * a C/C++ application links one shared library and never touches Python
+ * itself.
+ *
+ * Flow (identical to the reference):
+ *   MXPredCreate(symbol_json, params_bytes, ...) -> handle
+ *   MXPredSetInput(handle, "data", floats, n)
+ *   MXPredForward(handle)
+ *   MXPredGetOutputShape(handle, 0, &shape, &ndim)
+ *   MXPredGetOutput(handle, 0, out_floats, n)
+ *   MXPredFree(handle)
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+/* Last error message of the calling thread (empty string if none). */
+const char *MXGetLastError();
+
+/* Create a predictor.
+ * symbol_json_str : contents of the *-symbol.json file
+ * param_bytes     : contents of the *.params file
+ * param_size      : byte length of param_bytes
+ * dev_type        : 1 = cpu, 2 = gpu (accelerator), 3 = tpu
+ * dev_id          : device ordinal
+ * num_input_nodes : number of input nodes (usually 1, "data")
+ * input_keys      : input names
+ * input_shape_indptr : length num_input_nodes+1; input i's shape is
+ *                      input_shape_data[indptr[i] .. indptr[i+1])
+ * input_shape_data   : concatenated input shapes
+ * Returns 0 on success, -1 on failure (see MXGetLastError). */
+int MXPredCreate(const char *symbol_json_str,
+                 const void *param_bytes, int param_size,
+                 int dev_type, int dev_id,
+                 mx_uint num_input_nodes,
+                 const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data,
+                 PredictorHandle *out);
+
+/* Output shape of output node `index`; pointers are valid until the
+ * next call on this handle. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/* Copy `size` floats into input `key` (row-major, shape from create). */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/* Run the forward pass. */
+int MXPredForward(PredictorHandle handle);
+
+/* Copy output node `index` into `data` (`size` floats, row-major). */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                    mx_float *data, mx_uint size);
+
+/* Re-bind with new input shapes (same keys/layout as create). */
+int MXPredReshape(PredictorHandle handle,
+                  mx_uint num_input_nodes,
+                  const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  PredictorHandle *out);
+
+/* Release the predictor. */
+int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

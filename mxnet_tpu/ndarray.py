@@ -511,19 +511,31 @@ def save(fname, data):
             _write_str(f, n)
 
 
-def load(fname):
-    """Load from :func:`save`'s format; returns list or dict matching input."""
-    with open(fname, "rb") as f:
-        magic, _ = struct.unpack("<QQ", f.read(16))
-        if magic != _LIST_MAGIC:
-            raise MXNetError(f"invalid NDArray file {fname}")
-        n, = struct.unpack("<Q", f.read(8))
-        arrays = [_load_one(f) for _ in range(n)]
-        m, = struct.unpack("<Q", f.read(8))
-        names = [_read_str(f) for _ in range(m)]
+def _load_stream(f, what):
+    magic, _ = struct.unpack("<QQ", f.read(16))
+    if magic != _LIST_MAGIC:
+        raise MXNetError(f"invalid NDArray {what}")
+    n, = struct.unpack("<Q", f.read(8))
+    arrays = [_load_one(f) for _ in range(n)]
+    m, = struct.unpack("<Q", f.read(8))
+    names = [_read_str(f) for _ in range(m)]
     if names:
         return dict(zip(names, arrays))
     return arrays
+
+
+def load(fname):
+    """Load from :func:`save`'s format; returns list or dict matching input."""
+    with open(fname, "rb") as f:
+        return _load_stream(f, f"file {fname}")
+
+
+def load_buffer(buf):
+    """Load NDArrays from in-memory bytes (reference
+    MXNDArrayLoadFromBuffer, c_api.cc) — the C predict API hands the
+    .params content as a buffer, not a path."""
+    import io
+    return _load_stream(io.BytesIO(buf), "buffer")
 
 
 # Op functions (mx.nd.relu etc.) are attached by ops/__init__ at import time.

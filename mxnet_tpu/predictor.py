@@ -7,6 +7,8 @@ surface here, jit-compiled underneath.
 """
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from .base import MXNetError
@@ -41,6 +43,17 @@ class Predictor:
 
         if isinstance(param_bytes_or_dict, str):
             loaded = nd.load(param_bytes_or_dict)
+        elif isinstance(param_bytes_or_dict, (bytes, bytearray)):
+            # raw .params content — the C predict API path
+            # (MXPredCreate receives the file as a buffer)
+            loaded = nd.load_buffer(bytes(param_bytes_or_dict))
+        else:
+            loaded = None
+        if loaded is not None:
+            if not isinstance(loaded, dict):
+                raise MXNetError(
+                    "params were saved as an unnamed list; the predictor "
+                    "needs the name->array dict form (save with a dict)")
             params = {}
             for k, v in loaded.items():
                 if ":" in k:
@@ -58,6 +71,16 @@ class Predictor:
                 args[name] = nd.zeros(shape, ctx=ctx)
             elif name in params:
                 args[name] = params[name]
+            elif name.endswith("label"):
+                # deployment symbols keep their loss heads; label inputs
+                # are inert at inference.  NOTE: the reference
+                # c_predict_api.cc:182-188 silently zero-fills EVERY
+                # missing arg; restricting the fallback to label-named
+                # args (and warning) keeps missing real weights a loud
+                # error instead of silent garbage.
+                logging.warning("Predictor: zero-filling inference-inert "
+                                "input %r", name)
+                args[name] = nd.zeros(shape, ctx=ctx)
             else:
                 raise MXNetError("missing parameter %r" % name)
         aux = {}

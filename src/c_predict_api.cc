@@ -1,0 +1,304 @@
+// C prediction ABI (include/mxnet_tpu/c_predict_api.h) — embedded-Python
+// implementation.
+//
+// Role parity: src/c_api/c_predict_api.cc in the reference.  The
+// reference's predict library is a thin C shim over its C++ executor;
+// here the executor IS jax/XLA reached through python, so the native
+// deployment artifact embeds CPython once per process and drives
+// mxnet_tpu.predictor.Predictor.  Every entry point follows the
+// reference's API_BEGIN/API_END error convention: catch everything,
+// stash the message for MXGetLastError, return -1.
+//
+// Build: `make libmxtpu_predict.so` (links libpython); run with
+// MXTPU_PYTHONHOME/PYTHONPATH set so the embedded interpreter finds the
+// mxnet_tpu package (see tests/test_c_predict.py for the exact flow).
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_predict_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct PredRecord {
+  PyObject *predictor = nullptr;          // mxnet_tpu.predictor.Predictor
+  std::vector<std::string> input_keys;
+  std::vector<mx_uint> out_shape;         // scratch for GetOutputShape
+};
+
+std::once_flag g_py_once;
+
+void EnsurePython() {
+  std::call_once(g_py_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works from any thread (including this one) below
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class Gil {
+ public:
+  Gil() { state_ = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void SetPyError() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+const char *DevName(int dev_type) {
+  switch (dev_type) {
+    case 2: return "gpu";
+    case 3: return "tpu";
+    default: return "cpu";
+  }
+}
+
+// shapes dict {key: (d0, d1, ...)} from the indptr-packed C arrays
+PyObject *BuildShapesDict(mx_uint num_input_nodes, const char **input_keys,
+                          const mx_uint *input_shape_indptr,
+                          const mx_uint *input_shape_data) {
+  PyObject *shapes = PyDict_New();
+  if (!shapes) return nullptr;
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyObject *shape = PyTuple_New(
+        input_shape_indptr[i + 1] - input_shape_indptr[i]);
+    if (!shape) { Py_DECREF(shapes); return nullptr; }
+    for (mx_uint j = input_shape_indptr[i], k = 0;
+         j < input_shape_indptr[i + 1]; ++j, ++k) {
+      PyTuple_SET_ITEM(shape, k,
+                       PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    if (PyDict_SetItemString(shapes, input_keys[i], shape) != 0) {
+      Py_DECREF(shape);
+      Py_DECREF(shapes);
+      return nullptr;
+    }
+    Py_DECREF(shape);
+  }
+  return shapes;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  EnsurePython();
+  Gil gil;
+  try {
+    PyObject *mod = PyImport_ImportModule("mxnet_tpu");
+    if (!mod) { SetPyError(); return -1; }
+    PyObject *ctx_mod = PyObject_GetAttrString(mod, "context");
+    PyObject *ctx = PyObject_CallMethod(ctx_mod, "Context", "si",
+                                        DevName(dev_type), dev_id);
+    if (!ctx) { SetPyError(); return -1; }
+
+    PyObject *shapes = BuildShapesDict(num_input_nodes, input_keys,
+                                       input_shape_indptr,
+                                       input_shape_data);
+    if (!shapes) { SetPyError(); return -1; }
+    auto rec = new PredRecord();
+    for (mx_uint i = 0; i < num_input_nodes; ++i) {
+      rec->input_keys.emplace_back(input_keys[i]);
+    }
+
+    PyObject *pred_mod = PyObject_GetAttrString(mod, "predictor");
+    PyObject *cls = pred_mod ? PyObject_GetAttrString(pred_mod,
+                                                      "Predictor")
+                             : nullptr;
+    PyObject *params = PyBytes_FromStringAndSize(
+        static_cast<const char *>(param_bytes), param_size);
+    PyObject *json = PyUnicode_FromString(symbol_json_str);
+    if (!cls || !params || !json) {
+      SetPyError();
+      Py_XDECREF(json);
+      Py_XDECREF(params);
+      Py_XDECREF(cls);
+      Py_XDECREF(pred_mod);
+      Py_DECREF(shapes);
+      delete rec;
+      return -1;
+    }
+    PyObject *args = PyTuple_Pack(3, json, params, shapes);
+    PyObject *kw = PyDict_New();
+    PyDict_SetItemString(kw, "ctx", ctx);
+    PyObject *pred = PyObject_Call(cls, args, kw);
+    Py_DECREF(args);
+    Py_DECREF(kw);
+    Py_DECREF(json);
+    Py_DECREF(params);
+    Py_DECREF(shapes);
+    Py_DECREF(cls);
+    Py_DECREF(pred_mod);
+    Py_DECREF(ctx);
+    Py_DECREF(ctx_mod);
+    Py_DECREF(mod);
+    if (!pred) { SetPyError(); delete rec; return -1; }
+    rec->predictor = pred;
+    *out = rec;
+    return 0;
+  } catch (const std::exception &e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  Gil gil;
+  auto rec = static_cast<PredRecord *>(handle);
+  // hand the floats to python as a flat list-free bytes + frombuffer
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (!np) { SetPyError(); return -1; }
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+  PyObject *flat = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
+                                       "float32");
+  Py_DECREF(bytes);
+  Py_DECREF(np);
+  if (!flat) { SetPyError(); return -1; }
+  // Predictor.set_input reshapes via the bound arg's shape: pass the
+  // flat array reshaped python-side
+  PyObject *arr = PyObject_GetAttrString(rec->predictor, "_executor");
+  PyObject *arg_dict = arr ? PyObject_GetAttrString(arr, "arg_dict")
+                           : nullptr;
+  PyObject *target = arg_dict ? PyMapping_GetItemString(arg_dict, key)
+                              : nullptr;
+  PyObject *shape = target ? PyObject_GetAttrString(target, "shape")
+                           : nullptr;
+  PyObject *shaped = shape ? PyObject_CallMethod(flat, "reshape", "O",
+                                                 shape)
+                           : nullptr;
+  PyObject *r = shaped ? PyObject_CallMethod(rec->predictor, "set_input",
+                                             "sO", key, shaped)
+                       : nullptr;
+  Py_XDECREF(r);
+  Py_XDECREF(shaped);
+  Py_XDECREF(shape);
+  Py_XDECREF(target);
+  Py_XDECREF(arg_dict);
+  Py_XDECREF(arr);
+  Py_DECREF(flat);
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Gil gil;
+  auto rec = static_cast<PredRecord *>(handle);
+  PyObject *r = PyObject_CallMethod(rec->predictor, "forward", nullptr);
+  if (!r) { SetPyError(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  Gil gil;
+  auto rec = static_cast<PredRecord *>(handle);
+  PyObject *out = PyObject_CallMethod(rec->predictor, "get_output", "I",
+                                      index);
+  if (!out) { SetPyError(); return -1; }
+  PyObject *shape = PyObject_GetAttrString(out, "shape");
+  Py_ssize_t nd = PyTuple_Size(shape);
+  rec->out_shape.resize(nd);
+  for (Py_ssize_t i = 0; i < nd; ++i) {
+    rec->out_shape[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i)));
+  }
+  Py_DECREF(shape);
+  Py_DECREF(out);
+  *shape_data = rec->out_shape.data();
+  *shape_ndim = static_cast<mx_uint>(nd);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  Gil gil;
+  auto rec = static_cast<PredRecord *>(handle);
+  PyObject *out = PyObject_CallMethod(rec->predictor, "get_output", "I",
+                                      index);
+  if (!out) { SetPyError(); return -1; }
+  // np.ascontiguousarray(out, float32).tobytes()
+  PyObject *np = PyImport_ImportModule("numpy");
+  PyObject *contig = PyObject_CallMethod(np, "ascontiguousarray", "Os",
+                                         out, "float32");
+  Py_DECREF(np);
+  Py_DECREF(out);
+  if (!contig) { SetPyError(); return -1; }
+  PyObject *bytes = PyObject_CallMethod(contig, "tobytes", nullptr);
+  Py_DECREF(contig);
+  if (!bytes) { SetPyError(); return -1; }
+  Py_ssize_t len = PyBytes_Size(bytes);
+  if (static_cast<mx_uint>(len / sizeof(mx_float)) < size) {
+    g_last_error = "MXPredGetOutput: requested size exceeds output";
+    Py_DECREF(bytes);
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), size * sizeof(mx_float));
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
+                  const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle *out) {
+  Gil gil;
+  auto rec = static_cast<PredRecord *>(handle);
+  PyObject *shapes = BuildShapesDict(num_input_nodes, input_keys,
+                                     input_shape_indptr,
+                                     input_shape_data);
+  if (!shapes) { SetPyError(); return -1; }
+  PyObject *r = PyObject_CallMethod(rec->predictor, "reshape", "O",
+                                    shapes);
+  Py_DECREF(shapes);
+  if (!r) { SetPyError(); return -1; }
+  Py_DECREF(r);
+  // reference semantics: the caller owns a NEW handle and frees both the
+  // old and the new one independently
+  auto fresh = new PredRecord();
+  fresh->predictor = rec->predictor;
+  Py_INCREF(fresh->predictor);
+  fresh->input_keys = rec->input_keys;
+  *out = fresh;
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  auto rec = static_cast<PredRecord *>(handle);
+  Py_XDECREF(rec->predictor);
+  delete rec;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,73 @@
+/* End-to-end exercise of the C prediction ABI (reference
+ * c_predict_api.h flow): load symbol+params produced by python, run a
+ * forward pass on data read from a file, print the outputs so the
+ * pytest harness can compare against the in-python Predictor. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../include/mxnet_tpu/c_predict_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(2); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 6) {
+    fprintf(stderr,
+            "usage: %s symbol.json file.params data.f32 batch dim\n",
+            argv[0]);
+    return 2;
+  }
+  long json_size, param_size, data_size;
+  char *json = read_file(argv[1], &json_size);
+  char *params = read_file(argv[2], &param_size);
+  float *data = (float *)read_file(argv[3], &data_size);
+  mx_uint batch = (mx_uint)atoi(argv[4]);
+  mx_uint dim = (mx_uint)atoi(argv[5]);
+
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {batch, dim};
+
+  PredictorHandle h = NULL;
+  if (MXPredCreate(json, params, (int)param_size, 1, 0, 1, keys, indptr,
+                   shape, &h) != 0) {
+    fprintf(stderr, "MXPredCreate failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredSetInput(h, "data", data, batch * dim) != 0) {
+    fprintf(stderr, "MXPredSetInput failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredForward(h) != 0) {
+    fprintf(stderr, "MXPredForward failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint *oshape, ondim;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "GetOutputShape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint total = 1;
+  for (mx_uint i = 0; i < ondim; ++i) total *= oshape[i];
+  float *out = (float *)malloc(total * sizeof(float));
+  if (MXPredGetOutput(h, 0, out, total) != 0) {
+    fprintf(stderr, "MXPredGetOutput failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("shape");
+  for (mx_uint i = 0; i < ondim; ++i) printf(" %u", oshape[i]);
+  printf("\n");
+  for (mx_uint i = 0; i < total; ++i) printf("%.6f\n", out[i]);
+  MXPredFree(h);
+  return 0;
+}

@@ -1,0 +1,77 @@
+"""The native C prediction ABI (src/c_predict_api.cc): build the shared
+library, train+checkpoint a tiny net in python, run inference from a C
+program, and compare with the in-process Predictor.
+
+Reference roles: include/mxnet/c_predict_api.h, src/c_api/c_predict_api.cc,
+amalgamation/ (single-library predict-only deployment).
+"""
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(ROOT, "src")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+def _build():
+    subprocess.run(["make", "libmxtpu_predict.so"], cwd=SRC, check=True,
+                   capture_output=True)
+    lib = os.path.join(SRC, "libmxtpu_predict.so")
+    exe = os.path.join(SRC, "c_predict_test")
+    subprocess.run(
+        ["gcc", "-O1", os.path.join(ROOT, "tests", "c_predict_test.c"),
+         "-o", exe, "-L" + SRC, "-lmxtpu_predict",
+         "-Wl,-rpath," + SRC], check=True, capture_output=True)
+    return exe
+
+
+def test_c_predict_matches_python():
+    exe = _build()
+    rng = np.random.RandomState(0)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "net")
+        mod.save_checkpoint(prefix, 0)
+        x = rng.randn(2, 8).astype("f")
+        xfile = os.path.join(d, "x.f32")
+        x.tofile(xfile)
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ROOT + ":" + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [exe, prefix + "-symbol.json", prefix + "-0000.params",
+             xfile, "2", "8"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.strip().split("\n")
+        assert lines[0].split() == ["shape", "2", "4"], lines[0]
+        c_vals = np.array([float(v) for v in lines[1:]]).reshape(2, 4)
+
+        # in-process reference
+        pred = mx.predictor.Predictor(
+            open(prefix + "-symbol.json").read(),
+            prefix + "-0000.params", {"data": (2, 8)})
+        pred.forward(data=x)
+        py_vals = pred.get_output(0)
+    np.testing.assert_allclose(c_vals, py_vals, rtol=1e-4, atol=1e-5)
