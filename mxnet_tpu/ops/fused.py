@@ -270,3 +270,83 @@ def plan_conv_bn_fusion(topo, entries=()):
         plan[id(node)] = src
         skip.add(id(src))
     return plan, skip
+
+
+# ------------------------------------------- space-to-depth stem conv
+# MLPerf-style stem optimization: the 7x7/s2 conv on C=3 input wastes
+# the 128-wide MXU (3 input channels).  Factor-2 space-to-depth turns it
+# into an EXACTLY equivalent 4x4/s1 conv on 12 channels at half spatial
+# resolution.  Derivation: with a' = kh-3 = 2u+ph (ph in {0,1}),
+#   out(x,y) = sum W[a,b] X[2x+a-3, 2y+b-3]
+#            = sum_{u,v,ph,pw} W[2u+ph+3, 2v+pw+3] X2[x+u, y+v, (ph,pw,:)]
+# i.e. a 4x4 conv (u,v in -2..1) with asymmetric padding (2,1).
+_STEM = None
+
+
+class stem_s2d:
+    """Context manager enabling the stem rewrite during a trace."""
+
+    def __init__(self, enable):
+        self.enable = enable
+
+    def __enter__(self):
+        global _STEM
+        self._prev = _STEM
+        _STEM = self.enable
+        return self
+
+    def __exit__(self, *exc):
+        global _STEM
+        _STEM = self._prev
+
+
+def stem_s2d_enabled():
+    if _STEM is not None:
+        return bool(_STEM)
+    return os.environ.get("MXNET_STEM_S2D", "0") == "1"
+
+
+def _stem_eligible(node):
+    a = node.attrs
+    return (tuple(a.get("kernel") or ()) == (7, 7)
+            and (tuple(a.get("stride") or ()) or (1, 1)) == (2, 2)
+            and (tuple(a.get("pad") or ()) or (0, 0)) == (3, 3)
+            and (tuple(a.get("dilate") or ()) or (1, 1)) == (1, 1)
+            and int(a.get("num_group", 1)) == 1 and bool(a.get("no_bias")))
+
+
+def plan_stem_s2d(topo):
+    """{id(conv node)} for stem convs fed directly by a data variable."""
+    out = set()
+    for node in topo:
+        if node.is_variable or node.op is None:
+            continue
+        if node.op.name != "Convolution" or not _stem_eligible(node):
+            continue
+        src, _ = node.inputs[0]
+        if src.is_variable:
+            out.add(id(node))
+    return out
+
+
+def stem_s2d_conv(x, w):
+    """x: NHWC (N, H, W, 3) with H, W even; w: OIHW (O, C, 7, 7).
+    Returns the identical conv1 output at (N, H/2, W/2, O)."""
+    nb, h, wd, cin = x.shape
+    nout = w.shape[0]
+    # space-to-depth 2x2, phase-major channels (ph, pw, i)
+    x2 = x.reshape(nb, h // 2, 2, wd // 2, 2, cin)
+    x2 = jnp.transpose(x2, (0, 1, 3, 2, 4, 5))      # N, H2, W2, ph, pw, C
+    x2 = x2.reshape(nb, h // 2, wd // 2, 4 * cin)
+    # weight: W2[(u+2),(v+2),(ph,pw,i),o] = W[o,i,2u+ph+3,2v+pw+3]
+    wp = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))  # offsets -4..3
+    # wp index a = a'+4 = 2u+ph+4 = 2(u+2)+ph ; split into (u+2, ph)
+    w6 = wp.reshape(nout, cin, 4, 2, 4, 2)          # O, C, u, ph, v, pw
+    w2 = jnp.transpose(w6, (2, 4, 3, 5, 1, 0))      # u, v, ph, pw, C, O
+    w2 = w2.reshape(4, 4, 4 * cin, nout).astype(x.dtype)
+    import jax.lax as _lax
+    dn = _lax.conv_dimension_numbers(x2.shape, w2.shape,
+                                     ("NHWC", "HWIO", "NHWC"))
+    return _lax.conv_general_dilated(
+        x2, w2, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=dn)

@@ -139,7 +139,8 @@ class ShardedTrainer:
                  optimizer="sgd", optimizer_params=None, learning_rate=0.05,
                  momentum=0.9, weight_decay=0.0, initializer=None,
                  dtype="float32", tp_rules=None, seed=0, layout=None,
-                 auto_layouts=False, fuse_conv_bn=None):
+                 auto_layouts=False, fuse_conv_bn=None,
+                 stem_space_to_depth=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -179,6 +180,13 @@ class ShardedTrainer:
             from ..ops import fused as _fused_mod
             fuse_conv_bn = _fused_mod.fusion_enabled()
         self._fuse_conv_bn = bool(fuse_conv_bn) and self._layout == "NHWC"
+        # stem_space_to_depth: equivalent 4x4/s1 rewrite of the 7x7/s2
+        # C=3 stem conv (ops/fused.py stem_s2d_conv)
+        if stem_space_to_depth is None:
+            from ..ops import fused as _fused_mod
+            stem_space_to_depth = _fused_mod.stem_s2d_enabled()
+        self._stem_s2d = bool(stem_space_to_depth) and \
+            self._layout == "NHWC"
 
         self._topo = symbol._topo()
         if self._layout == "NHWC":
@@ -395,10 +403,11 @@ class ShardedTrainer:
             def fwd(p32):
                 # compute-precision copies of the f32 masters; the astype
                 # vjp returns f32 grads automatically
-                from ..ops.fused import conv_bn_fusion
+                from ..ops.fused import conv_bn_fusion, stem_s2d
                 p = {k: v.astype(compute_dtype) for k, v in p32.items()}
                 with image_layout(layout), \
-                        conv_bn_fusion(self._fuse_conv_bn):
+                        conv_bn_fusion(self._fuse_conv_bn), \
+                        stem_s2d(self._stem_s2d):
                     var_values = self._node_value_map(p, batch, aux)
                     heads, aux_upd = eval_graph(topo, entries, var_values,
                                                 is_train=True, key=key,

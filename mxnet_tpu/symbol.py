@@ -128,11 +128,16 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
     # optional conv1x1+BN fusion (ops/fused.py): deferred convs carry
     # their input values to the consuming BatchNorm node
     fuse_plan, fuse_skip = {}, set()
+    stem_plan = set()
     if is_train and not device_map:
         from .ops import fused as _fused
         from .ops.nn import current_image_layout
-        if _fused.fusion_enabled() and current_image_layout() == "NHWC":
-            fuse_plan, fuse_skip = _fused.plan_conv_bn_fusion(topo, entries)
+        if current_image_layout() == "NHWC":
+            if _fused.fusion_enabled():
+                fuse_plan, fuse_skip = _fused.plan_conv_bn_fusion(
+                    topo, entries)
+            if _fused.stem_s2d_enabled():
+                stem_plan = _fused.plan_stem_s2d(topo)
 
     for i, node in enumerate(topo):
         if node.is_variable:
@@ -166,6 +171,15 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
                 for oname, val in zip(node.output_names(), outs[:n_vis]):
                     monitor(oname, val)
             continue
+        if id(node) in stem_plan:
+            from .ops import fused as _fused
+            s_ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
+            sx = s_ins[0]
+            if sx.ndim == 4 and sx.shape[1] % 2 == 0 \
+                    and sx.shape[2] % 2 == 0:
+                vals[id(node)] = (_fused.stem_s2d_conv(sx, s_ins[1]),)
+                continue
+            # odd spatial size: fall through to the direct conv
         ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
         dev = device_map.get(id(node))
         if dev is not None:

@@ -87,3 +87,43 @@ def test_fused_step_matches_unfused():
         np.testing.assert_allclose(
             np.asarray(t_fused.aux[k]), np.asarray(t_ref.aux[k]),
             rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def _stem_net(num_classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(7, 7), stride=(2, 2),
+                             pad=(3, 3), num_filter=8, no_bias=True,
+                             name="conv0")
+    net = mx.sym.BatchNorm(net, name="bn0", fix_gamma=False)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_stem_space_to_depth_matches():
+    """The 4x4/s1 space-to-depth rewrite of the 7x7/s2 stem trains
+    identically to the direct conv (f32)."""
+    def make(stem):
+        mesh = build_mesh(tp=1)
+        np.random.seed(11)
+        return ShardedTrainer(
+            _stem_net(), mesh,
+            data_shapes={"data": (8, 3, 16, 16)},
+            label_shapes={"softmax_label": (8,)},
+            layout="NHWC", dtype="float32", seed=5, learning_rate=0.1,
+            momentum=0.9, stem_space_to_depth=stem)
+
+    t_ref, t_s2d = make(False), make(True)
+    rng = np.random.RandomState(3)
+    batch = {"data": rng.randn(8, 3, 16, 16).astype("f"),
+             "softmax_label": rng.randint(0, 10, 8).astype("f")}
+    for t in (t_ref, t_s2d):
+        b = t.put_batch(batch)
+        t.step(b)
+        t.step(b)
+    for k in t_ref.params:
+        np.testing.assert_allclose(
+            np.asarray(t_s2d.params[k]), np.asarray(t_ref.params[k]),
+            rtol=1e-4, atol=1e-5, err_msg=k)
