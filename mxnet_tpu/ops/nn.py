@@ -433,6 +433,30 @@ def batch_norm(attrs, ctx, data, gamma, beta, moving_mean, moving_var):
     return out, new_mm, new_mv
 
 
+@register("LayerNorm", arg_names=("data", "gamma", "beta"),
+          num_outputs=lambda a: 3 if a.get("output_mean_var") else 1,
+          params={"axis": -1, "eps": 1e-5, "output_mean_var": False})
+def layer_norm(attrs, ctx, data, gamma, beta):
+    """Layer normalization over ``axis`` (the transformer workhorse;
+    post-reference-era op — the 0.10.1 reference predates attention —
+    kept API-compatible with mxnet's later LayerNorm)."""
+    axis = int(attrs["axis"])
+    eps = float(attrs["eps"])
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[axis] if i == (axis % data.ndim) else 1
+                   for i in range(data.ndim))
+    out = ((xf - mean) * inv * gamma.astype(jnp.float32).reshape(bshape)
+           + beta.astype(jnp.float32).reshape(bshape)).astype(data.dtype)
+    if attrs.get("output_mean_var"):
+        # mxnet's LayerNorm(output_mean_var=True) returns (out, mean, std)
+        return (out, jnp.squeeze(mean, axis),
+                jnp.squeeze(jnp.sqrt(var + eps), axis))
+    return out
+
+
 @register("InstanceNorm", arg_names=("data", "gamma", "beta"),
           params={"eps": 1e-3})
 def instance_norm(attrs, ctx, data, gamma, beta):

@@ -108,6 +108,16 @@ def _in(attrs, known):
     return {"gamma": c, "beta": c}
 
 
+@register_param_shapes("LayerNorm")
+def _ln(attrs, known):
+    data = known.get("data")
+    if data is None:
+        return {}
+    axis = int(attrs.get("axis", -1))
+    c = (int(data[axis]),)
+    return {"gamma": c, "beta": c}
+
+
 @register_param_shapes("LeakyReLU")
 def _prelu(attrs, known):
     data = known.get("data")

@@ -1,0 +1,64 @@
+"""Transformer LM example + LayerNorm op.
+
+The causal-attention stack (FlashAttention op, LayerNorm, positional
+embeddings) trained through the Module API on a Markov corpus must
+approach the generating chain's entropy floor.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "examples", "transformer"))
+
+
+def test_layernorm_forward_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6, 8).astype("f") * 3 + 1
+    g = rng.rand(8).astype("f") + 0.5
+    b = rng.randn(8).astype("f")
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g),
+                          mx.nd.array(b), axis=-1, eps=1e-5).asnumpy()
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * g + b
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm_grad_finite_difference():
+    data = mx.sym.Variable("data")
+    net = mx.sym.MakeLoss(mx.sym.sum(mx.sym.square(
+        mx.sym.LayerNorm(data, name="ln"))))
+    ex = net.simple_bind(mx.cpu(), data=(3, 5))
+    rng = np.random.RandomState(1)
+    # simple_bind zero-fills args: gamma/beta must be nonzero or the
+    # whole computation (and both gradients) collapses to zero
+    ex.arg_dict["ln_gamma"][:] = rng.rand(5).astype("f") + 0.5
+    ex.arg_dict["ln_beta"][:] = rng.randn(5).astype("f")
+    x = rng.randn(3, 5).astype("f")
+    ex.forward(is_train=True, data=x)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    eps = 1e-3
+    num = np.zeros_like(x)
+    for i in range(3):
+        for j in range(5):
+            for s, sign in ((eps, 1), (-eps, -1)):
+                xp = x.copy()
+                xp[i, j] += s
+                ex.forward(is_train=False, data=xp)
+                num[i, j] += sign * float(ex.outputs[0].asnumpy().sum())
+    num /= 2 * eps
+    np.testing.assert_allclose(g, num, rtol=2e-2, atol=2e-2)
+
+
+def test_gpt_mini_approaches_entropy_floor():
+    import train_lm
+    ppl, floor = train_lm.train(epochs=3, seq_len=32, vocab_size=32,
+                                d_model=32)
+    assert ppl < 1.5 * floor, (ppl, floor)
+    assert ppl < 8, ppl     # uniform would be 32
