@@ -14,5 +14,6 @@ holds the TPU-native machinery:
 * :mod:`pipeline` — GPipe-style microbatch pipeline over a ``pipe`` axis.
 """
 from .mesh import build_mesh, data_parallel_spec
+from .moe import make_expert_mesh, switch_moe
 from .pipeline import make_pipeline_mesh, pipeline_apply, pipeline_grad
 from .trainer import ShardedTrainer

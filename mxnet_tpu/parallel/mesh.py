@@ -48,3 +48,14 @@ def data_parallel_spec(mesh):
     """PartitionSpec sharding dim 0 (batch) over the data axis."""
     from jax.sharding import PartitionSpec as P
     return P(mesh.axis_names[0])
+
+
+def make_1d_mesh(axis_name, n_devices, devices=None):
+    """1-D mesh with ``axis_name`` over exactly ``n_devices`` devices."""
+    import jax
+    import numpy as _np
+    devs = list(devices if devices is not None else jax.devices())[:n_devices]
+    if len(devs) < n_devices:
+        raise ValueError("need %d devices for the %r axis, have %d"
+                         % (n_devices, axis_name, len(devs)))
+    return jax.sharding.Mesh(_np.array(devs), (axis_name,))

@@ -25,13 +25,8 @@ __all__ = ["pipeline_apply", "pipeline_grad", "make_pipeline_mesh"]
 
 def make_pipeline_mesh(n_stages, devices=None):
     """1-D mesh with a ``pipe`` axis of n_stages devices."""
-    import jax
-    import numpy as np
-    devs = list(devices if devices is not None else jax.devices())[:n_stages]
-    if len(devs) < n_stages:
-        raise ValueError("need %d devices for %d pipeline stages, have %d"
-                         % (n_stages, n_stages, len(devs)))
-    return jax.sharding.Mesh(np.array(devs), ("pipe",))
+    from .mesh import make_1d_mesh
+    return make_1d_mesh("pipe", n_stages, devices)
 
 
 def _stage_loop(stage_fn, params_stack, x_stack, axis_name, remat):
