@@ -81,10 +81,12 @@ class SequentialModule(BaseModule):
             return
         assert self.binded, "call bind before initializing the parameters"
         for module in self._modules:
+            # each child owns a SUBSET of the chain's params, so keys
+            # belonging to other children are always "extra" here
             module.init_params(initializer=initializer,
                                arg_params=arg_params, aux_params=aux_params,
                                allow_missing=allow_missing,
-                               force_init=force_init)
+                               force_init=force_init, allow_extra=True)
 
         def _check_name(known_names, new_names, modules, i):
             for name in new_names:
@@ -104,6 +106,16 @@ class SequentialModule(BaseModule):
                         i_layer)
             _check_name(aux_names, aux_params_l.keys(), self._modules,
                         i_layer)
+        if not allow_extra:
+            # the per-child calls had to allow extras (each child owns a
+            # subset); enforce the caller's contract against the UNION
+            extra = set(arg_params or ()) - set(arg_names)
+            extra |= set(aux_params or ()) - set(aux_names)
+            if extra:
+                raise ValueError(
+                    "set_params/init_params got params not in any chained "
+                    "module: %s (pass allow_extra=True to ignore)"
+                    % sorted(extra))
         self.params_initialized = True
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,

@@ -327,3 +327,31 @@ def count_sketch(attrs, ctx, data, h, s):
     sign = s.astype(data.dtype).reshape(-1)
     out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
     return out.at[..., idx].add(data * sign)
+
+
+@register("_contrib_SwitchMoE",
+          arg_names=("data", "router_weight", "expert1_weight",
+                     "expert1_bias", "expert2_weight", "expert2_bias"),
+          num_outputs=2,
+          params={"num_experts": 0, "hidden_size": 0,
+                  "capacity_factor": 1.25},
+          aliases=("SwitchMoE",))
+def switch_moe_op(attrs, ctx, data, router_weight, expert1_weight,
+                  expert1_bias, expert2_weight, expert2_bias):
+    """Switch-routed mixture-of-experts FFN over (batch, seq, d) or
+    (tokens, d) inputs; returns (output, load_balance_loss).
+
+    Symbol-level surface of :func:`mxnet_tpu.parallel.moe.switch_moe`
+    (expert sharding comes from the surrounding mesh via GSPMD when the
+    step runs under one — the op itself is placement-agnostic).
+    """
+    from ..parallel.moe import switch_moe as _moe
+    if int(attrs["num_experts"]) <= 0 or int(attrs["hidden_size"]) <= 0:
+        raise MXNetError("_contrib_SwitchMoE requires num_experts > 0 "
+                         "and hidden_size > 0")
+    shape = data.shape
+    x = data.reshape(-1, shape[-1]) if data.ndim > 2 else data
+    y, aux = _moe(x, router_weight, expert1_weight, expert1_bias,
+                  expert2_weight, expert2_bias,
+                  capacity_factor=float(attrs["capacity_factor"]))
+    return y.reshape(shape), aux

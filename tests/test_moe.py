@@ -88,3 +88,46 @@ def test_switch_moe_expert_parallel_parity():
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(float(aux1), float(aux0), rtol=1e-5)
+
+
+def test_switch_moe_symbol_trains_through_module():
+    """The _contrib_SwitchMoE op trains a classifier through Module.fit
+    (aux load-balance loss attached via MakeLoss)."""
+    import mxnet_tpu as mx
+    # initializers draw from the global RNGs — pin for run-order
+    # independence
+    np.random.seed(7)
+    mx.random.seed(7)
+    rng = np.random.RandomState(0)
+    protos = np.random.RandomState(42).randn(8, 16).astype("f")
+    yy = rng.randint(0, 8, 1024)
+    xx = (protos[yy] + 0.3 * rng.randn(1024, 16)).astype("f")
+
+    data = mx.sym.Variable("data")
+    moe_out = mx.sym._contrib_SwitchMoE(data, num_experts=4,
+                                        hidden_size=32, name="moe")
+    fc = mx.sym.FullyConnected(moe_out[0] + data, num_hidden=8,
+                               name="cls")
+    sm = mx.sym.SoftmaxOutput(fc, name="softmax")
+    balance = mx.sym.MakeLoss(0.01 * moe_out[1], name="balance")
+    net = mx.sym.Group([sm, balance])
+
+    class _Acc(mx.metric.EvalMetric):
+        """first-output accuracy (the balance head has no label)"""
+
+        def __init__(self):
+            super().__init__("acc0")
+
+        def update(self, labels, preds):
+            pred = preds[0].asnumpy().argmax(1)
+            lab = labels[0].asnumpy()
+            self.sum_metric += (pred == lab).sum()
+            self.num_inst += len(lab)
+
+    mod = mx.module.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(xx, yy.astype("f"), 64, shuffle=True)
+    mod.fit(it, num_epoch=6, initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            eval_metric=_Acc())
+    acc = mod.score(it, _Acc())[0][1]
+    assert acc > 0.9, acc

@@ -106,3 +106,41 @@ def test_bf16_training_convergence():
     heads = trainer.forward({"data": x})
     prob = np.asarray(heads[0]).astype("f")
     assert (prob.argmax(1) == y).mean() > 0.95
+
+
+def test_conv_train_to_threshold():
+    """Reference tests/python/train/test_conv.py: a LeNet-style conv net
+    trains to >0.95 accuracy through Module.fit."""
+    protos = np.random.RandomState(21).rand(10, 1, 16, 16).astype("f")
+
+    def digits(n, seed):
+        rng = np.random.RandomState(seed)
+        y = rng.randint(0, 10, n)
+        x = protos[y] + 0.25 * rng.randn(n, 1, 16, 16).astype("f")
+        return x.astype("f"), y.astype("f")
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             name="c1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16,
+                             name="c2")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    xtr, ytr = digits(2000, 0)
+    xva, yva = digits(500, 1)
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.fit(mx.io.NDArrayIter(xtr, ytr, 100, shuffle=True),
+            num_epoch=5, initializer=mx.init.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    acc = mod.score(mx.io.NDArrayIter(xva, yva, 100),
+                    mx.metric.Accuracy())[0][1]
+    assert acc > 0.95, acc
