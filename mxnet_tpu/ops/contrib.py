@@ -349,6 +349,13 @@ def switch_moe_op(attrs, ctx, data, router_weight, expert1_weight,
     if int(attrs["num_experts"]) <= 0 or int(attrs["hidden_size"]) <= 0:
         raise MXNetError("_contrib_SwitchMoE requires num_experts > 0 "
                          "and hidden_size > 0")
+    if (router_weight.shape[1] != int(attrs["num_experts"])
+            or expert1_weight.shape[2] != int(attrs["hidden_size"])):
+        raise MXNetError(
+            "_contrib_SwitchMoE: weights shaped for E=%d, ff=%d do not "
+            "match num_experts=%s hidden_size=%s"
+            % (router_weight.shape[1], expert1_weight.shape[2],
+               attrs["num_experts"], attrs["hidden_size"]))
     shape = data.shape
     x = data.reshape(-1, shape[-1]) if data.ndim > 2 else data
     y, aux = _moe(x, router_weight, expert1_weight, expert1_bias,

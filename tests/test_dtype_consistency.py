@@ -1,0 +1,75 @@
+"""Cross-dtype operator consistency sweep.
+
+Reference: tests/python/gpu/test_operator_gpu.py runs the CPU operator
+suite under ``check_consistency`` across devices and dtype combinations
+(f32/f16).  Devices are uniform under XLA, so dtype is the surviving
+axis: every op here must produce bf16/f16 outputs within reduced-precision
+tolerance of its f32 result.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+_RNG = np.random.RandomState(7)
+
+
+def _x(*shape):
+    return _RNG.uniform(-2, 2, shape).astype("float32")
+
+
+CASES = [
+    ("relu", [_x(4, 16)], {}),
+    ("sigmoid", [_x(4, 16)], {}),
+    ("tanh", [_x(4, 16)], {}),
+    ("exp", [_x(4, 16) * 0.5], {}),
+    ("sqrt", [np.abs(_x(4, 16)) + 0.1], {}),
+    ("broadcast_add", [_x(4, 16), _x(1, 16)], {}),
+    ("broadcast_mul", [_x(4, 16), _x(1, 16)], {}),
+    ("dot", [_x(8, 16), _x(16, 8)], {}),
+    ("sum", [_x(4, 16)], {"axis": 1}),
+    ("max", [_x(4, 16)], {"axis": 1}),
+    ("softmax", [_x(4, 16)], {}),
+    ("log_softmax", [_x(4, 16)], {}),
+    ("transpose", [_x(4, 16)], {}),
+    ("Flatten", [_x(4, 2, 8)], {}),
+    ("SwapAxis", [_x(4, 2, 8)], {"dim1": 1, "dim2": 2}),
+    ("clip", [_x(4, 16)], {"a_min": -1.0, "a_max": 1.0}),
+]
+
+
+@pytest.mark.parametrize("op_name,arrays,attrs",
+                         CASES, ids=[c[0] for c in CASES])
+def test_dtype_consistency_bf16(op_name, arrays, attrs):
+    check_consistency(op_name, arrays, attrs=attrs,
+                      dtypes=("float32", "bfloat16"),
+                      rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("op_name,arrays,attrs",
+                         CASES[:8], ids=[c[0] for c in CASES[:8]])
+def test_dtype_consistency_f16(op_name, arrays, attrs):
+    check_consistency(op_name, arrays, attrs=attrs,
+                      dtypes=("float32", "float16"),
+                      rtol=5e-3, atol=5e-3)
+
+
+def test_conv_bn_dtype_consistency():
+    """Layer ops keep reduced-precision outputs close to f32 (reference
+    test_operator_gpu conv/BN consistency cases)."""
+    x = _x(2, 3, 8, 8)
+    w = _x(4, 3, 3, 3) * 0.2
+    check_consistency("Convolution", [x, w],
+                      attrs={"kernel": (3, 3), "num_filter": 4,
+                             "no_bias": True},
+                      dtypes=("float32", "bfloat16"),
+                      rtol=5e-2, atol=5e-2)
+    g = np.ones(3, "float32")
+    b = np.zeros(3, "float32")
+    mm = np.zeros(3, "float32")
+    mv = np.ones(3, "float32")
+    check_consistency("BatchNorm", [x, g, b, mm, mv],
+                      attrs={"fix_gamma": False},
+                      dtypes=("float32", "bfloat16"),
+                      rtol=5e-2, atol=5e-2)

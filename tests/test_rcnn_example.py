@@ -50,7 +50,12 @@ def test_nms_suppresses_overlaps():
 
 
 def test_faster_rcnn_toy_convergence_and_map():
+    import mxnet_tpu as mx
     import train_end2end as t
-    mod = t.train(epochs=8, n_train=150, seed=0)
+    # Xavier/shuffle draw from the global RNGs: pin them so the result
+    # does not depend on which tests ran before this one
+    np.random.seed(5)
+    mx.random.seed(5)
+    mod = t.train(epochs=10, n_train=150, seed=0)
     mAP = t.evaluate(mod, n_test=25, seed=123)
     assert mAP > 0.6, mAP
