@@ -1,0 +1,105 @@
+"""Multi-digit captcha recognition: one CNN, four digit heads.
+
+Reference: ``example/captcha`` — a convnet reads a 4-character captcha
+image; the head emits 4x10 logits softmaxed per position (label is the
+4-digit string).  Images here are synthetic: four prototype digit
+patches side by side with noise/jitter (the reference generates them
+with the ImageCaptcha library, unavailable offline).
+
+    python train_captcha.py --epochs 8
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+N_DIGITS = 4
+SIDE = 16
+
+
+def captcha_net(num_digits=N_DIGITS, num_classes=10):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")    # (batch, num_digits)
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=16,
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=32,
+                             name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=256, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_digits * num_classes,
+                                name="fc2")
+    net = mx.sym.Reshape(net, shape=(-1, num_classes))
+    label = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(net, label=label, name="softmax")
+
+
+def synthetic_captchas(n, seed=0, noise=0.2):
+    protos = np.random.RandomState(42).rand(10, SIDE, SIDE).astype("f")
+    rng = np.random.RandomState(seed)
+    x = np.zeros((n, 1, SIDE, SIDE * N_DIGITS), "f")
+    y = rng.randint(0, 10, (n, N_DIGITS))
+    for i in range(n):
+        for j in range(N_DIGITS):
+            jitter = rng.randint(-1, 2)
+            patch = np.roll(protos[y[i, j]], jitter, axis=0)
+            x[i, 0, :, j * SIDE:(j + 1) * SIDE] = patch
+        x[i] += noise * rng.randn(SIDE, SIDE * N_DIGITS)
+    return x.astype("f"), y.astype("f")
+
+
+def exact_match(mod, it, n):
+    it.reset()
+    hits = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy()
+        pred = pred.reshape(-1, N_DIGITS, 10).argmax(-1)
+        lab = batch.label[0].asnumpy().astype(int)
+        hits += (pred == lab).all(axis=1).sum()
+        total += len(lab)
+    return hits / total
+
+
+def train(epochs=8, batch_size=64, ctx=None):
+    ctx = ctx or mx.context.current_context()
+    np.random.seed(17)
+    mx.random.seed(17)
+    xtr, ytr = synthetic_captchas(4000, seed=0)
+    xte, yte = synthetic_captchas(800, seed=1)
+    train_iter = mx.io.NDArrayIter(xtr, ytr, batch_size, shuffle=True)
+    test_iter = mx.io.NDArrayIter(xte, yte, batch_size)
+
+    mod = mx.module.Module(captcha_net(), context=ctx)
+    mod.fit(train_iter, num_epoch=epochs,
+            initializer=mx.init.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(batch_size, 20))
+    per_digit = mod.score(test_iter, mx.metric.Accuracy())[0][1]
+    exact = exact_match(mod, test_iter, len(xte))
+    logging.info("per-digit accuracy %.3f, exact-captcha accuracy %.3f",
+                 per_digit, exact)
+    return per_digit, exact
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    a = p.parse_args()
+    train(epochs=a.epochs)

@@ -116,3 +116,11 @@ def test_time_major_lstm_beats_unigram():
     # uniform/unigram perplexity over the dirichlet(0.1) corpus is far
     # higher; the Markov structure should pull it well under vocab/2
     assert ppl < 30, ppl
+
+
+def test_captcha_multi_digit():
+    sys.path.insert(0, os.path.join(ROOT, "examples", "captcha"))
+    import train_captcha
+    per_digit, exact = train_captcha.train(epochs=5)
+    assert per_digit > 0.9, per_digit
+    assert exact > 0.7, exact
