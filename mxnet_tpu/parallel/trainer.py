@@ -620,6 +620,108 @@ class ShardedTrainer:
         return self._fwd_fn(self.params, self.aux, dev_batch)
 
 
+    # ------------------------------------------------------- checkpoints
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Write reference-format checkpoint files from the sharded
+        state: ``prefix-symbol.json`` + ``prefix-%04d.params`` (arg:/aux:
+        name prefixes — Module/FeedForward can load these) and optionally
+        ``prefix-%04d.states`` holding the fused optimizer slots + the
+        update counter.  NOTE: the .states layout is the fused-path's own
+        (name-keyed slot arrays); Module's .states files are pickled
+        per-index Updater dicts and the two are NOT interchangeable —
+        params/aux files are.
+        """
+        import numpy as _np
+        from .. import ndarray as _nd
+
+        self.symbol.save("%s-symbol.json" % prefix)
+        data = {}
+        for k, v in self.params.items():
+            data["arg:%s" % k] = _nd.array(_np.asarray(v))
+        for k, v in self.aux.items():
+            data["aux:%s" % k] = _nd.array(_np.asarray(v))
+        _nd.save("%s-%04d.params" % (prefix, epoch), data)
+        if save_optimizer_states:
+            st = {"meta:num_update": _nd.array(_np.array(
+                [self.optimizer.begin_num_update + self._step_count],
+                _np.int64))}
+            for k, slots in self.opt_state.items():
+                for i, sl in enumerate(slots):
+                    st["slot%d:%s" % (i, k)] = _nd.array(_np.asarray(sl))
+            _nd.save("%s-%04d.states" % (prefix, epoch), st)
+
+    def _state_target(self, live, sharding):
+        """device_put target preserving the live array's layout: under
+        auto_layouts the AOT-compiled step was lowered with XLA-chosen
+        formats, which a plain NamedSharding put would discard."""
+        return live.format if self._auto_layouts else sharding
+
+    def load_checkpoint(self, prefix, epoch, load_optimizer_states=False):
+        """Restore params/aux (and fused optimizer slots) saved by
+        :meth:`save_checkpoint`.  Params/aux files are Module-format, so
+        Module-trained checkpoints resume on the fused path; optimizer
+        .states files are fused-path-specific (see save_checkpoint).
+        Raises on any name mismatch — a silent partial load would look
+        like a resume while actually restarting from random init."""
+        import jax
+        import numpy as _np
+        from .. import ndarray as _nd
+
+        loaded = _nd.load("%s-%04d.params" % (prefix, epoch))
+        file_args = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                     if k.startswith("arg:")}
+        file_aux = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                    if k.startswith("aux:")}
+        missing = (set(self.params) - set(file_args)) |             (set(self.aux) - set(file_aux))
+        unexpected = (set(file_args) - set(self.params)) |             (set(file_aux) - set(self.aux))
+        if missing or unexpected:
+            raise MXNetError(
+                "checkpoint/model mismatch: missing %s, unexpected %s"
+                % (sorted(missing), sorted(unexpected)))
+        with self.mesh:
+            for name, v in file_args.items():
+                self.params[name] = jax.device_put(
+                    _np.asarray(v.asnumpy(), _np.float32),
+                    self._state_target(self.params[name],
+                                       self._param_sharding[name]))
+            for name, v in file_aux.items():
+                self.aux[name] = jax.device_put(
+                    _np.asarray(v.asnumpy(), _np.float32),
+                    self._state_target(self.aux[name],
+                                       self._aux_sharding[name]))
+            if load_optimizer_states:
+                st = _nd.load("%s-%04d.states" % (prefix, epoch))
+                slots_in_file = {}
+                for k in st:
+                    if k.startswith("slot"):
+                        slot, name = k.split(":", 1)
+                        i = int(slot[len("slot"):])
+                        slots_in_file[name] = max(
+                            slots_in_file.get(name, 0), i + 1)
+                for name, n in slots_in_file.items():
+                    if name not in self.opt_state or                             n != len(self.opt_state[name]):
+                        raise MXNetError(
+                            "optimizer state mismatch for %r: file has "
+                            "%d slots, trainer (%s) expects %d — resume "
+                            "with the optimizer the checkpoint was saved "
+                            "with" % (name, n,
+                                      type(self.optimizer).__name__,
+                                      self._n_slots))
+                for k, v in st.items():
+                    if k == "meta:num_update":
+                        self.optimizer.begin_num_update = int(
+                            v.asnumpy().astype(_np.int64)[0])
+                        self._step_count = 0
+                        continue
+                    slot, name = k.split(":", 1)
+                    i = int(slot[len("slot"):])
+                    self.opt_state[name][i] = jax.device_put(
+                        _np.asarray(v.asnumpy(), _np.float32),
+                        self._state_target(self.opt_state[name][i],
+                                           self._param_sharding[name]))
+
+
+
 class _HostArray:
     """Minimal NDArray-like shim so Initializers can write numpy in-place."""
 
@@ -639,3 +741,4 @@ class _HostArray:
 
     def __getitem__(self, key):
         return self.data[key]
+

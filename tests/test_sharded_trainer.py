@@ -252,3 +252,62 @@ def test_auto_layouts_matches_default():
         np.testing.assert_allclose(np.asarray(t1.params[k]),
                                    np.asarray(t0.params[k]),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_trainer_checkpoint_roundtrip_and_module_interop(tmp_path):
+    """save_checkpoint/load_checkpoint on the fused path: params,
+    optimizer slots, and step counter resume identically; the files are
+    Module-format (arg:/aux: prefixes + symbol JSON)."""
+    import os
+    prefix = os.path.join(str(tmp_path), "ck")
+
+    t1 = _make(optimizer="adam")
+    b = t1.put_batch(_batch())
+    for _ in range(3):
+        loss_before = float(t1.step(b))
+    t1.save_checkpoint(prefix, 3, save_optimizer_states=True)
+
+    t2 = _make(optimizer="adam")
+    t2.load_checkpoint(prefix, 3, load_optimizer_states=True)
+    for k in t1.params:
+        np.testing.assert_allclose(np.asarray(t2.params[k]),
+                                   np.asarray(t1.params[k]),
+                                   rtol=1e-6, err_msg=k)
+    b2 = t2.put_batch(_batch())
+    l1 = float(t1.step(b))
+    l2 = float(t2.step(b2))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    # Module can read the same files (reference checkpoint interop)
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    assert set(args) == set(t1.params)
+
+
+def test_trainer_checkpoint_auto_layouts(tmp_path):
+    """load_checkpoint preserves XLA-chosen layouts (auto_layouts): the
+    loaded state must still feed the AOT-compiled step."""
+    import os
+    prefix = os.path.join(str(tmp_path), "al")
+    t1 = _make(optimizer="adam", auto_layouts=True)
+    b = t1.put_batch(_batch())
+    float(t1.step(b))
+    t1.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    t2 = _make(optimizer="adam", auto_layouts=True)
+    b2 = t2.put_batch(_batch())
+    float(t2.step(b2))  # compile the AOT step before loading
+    t2.load_checkpoint(prefix, 1, load_optimizer_states=True)
+    l1 = float(t1.step(b))
+    l2 = float(t2.step(b2))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_trainer_checkpoint_optimizer_mismatch_raises(tmp_path):
+    import os
+    prefix = os.path.join(str(tmp_path), "mm")
+    t1 = _make(optimizer="adam")
+    b = t1.put_batch(_batch())
+    float(t1.step(b))
+    t1.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    t2 = _make(optimizer="sgd")
+    with pytest.raises(mx.base.MXNetError, match="optimizer state"):
+        t2.load_checkpoint(prefix, 1, load_optimizer_states=True)
