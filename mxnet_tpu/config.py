@@ -41,6 +41,11 @@ _CATALOG = {
                                      "update_on_kvstore heuristic"),
     "MXNET_ENABLE_GPU_P2P": ("1", "inert", "ICI is always direct"),
     # profiler
+    "MXNET_FUSE_CONV_BN": ("0", "honored",
+        "Pallas conv1x1+BN stats fusion in ShardedTrainer (docs/perf.md: "
+        "measured slower on v5e; off by default)"),
+    "MXNET_STEM_S2D": ("0", "honored",
+        "space-to-depth rewrite of 7x7/s2 stem convs in ShardedTrainer"),
     "MXNET_PROFILER_AUTOSTART": ("0", "honored", "see profiler.py"),
     "MXNET_PROFILER_MODE": ("0", "honored", ""),
     "MXNET_PROFILER_FILENAME": ("profile.json", "honored", ""),
