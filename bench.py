@@ -71,11 +71,23 @@ def main():
     float(trainer.step(batch_dict))
     float(trainer.step(batch_dict))
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(batch_dict)
-    assert np.isfinite(float(loss))  # value fetch closes the async chain
-    dt = time.perf_counter() - t0
+    # BENCH_SCAN>1: chain that many steps inside one device program
+    # (ShardedTrainer.run_steps) — removes per-step dispatch entirely
+    scan = int(os.environ.get("BENCH_SCAN", "1"))
+    if scan > 1:
+        steps = max(scan, (steps // scan) * scan)
+        float(np.asarray(trainer.run_steps(batch_dict, scan))[-1])  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps // scan):
+            losses = trainer.run_steps(batch_dict, scan)
+        assert np.isfinite(float(np.asarray(losses)[-1]))
+        dt = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.step(batch_dict)
+        assert np.isfinite(float(loss))  # value fetch closes the chain
+        dt = time.perf_counter() - t0
 
     img_per_sec = steps * batch / dt
     img_per_sec_chip = img_per_sec / n_dev

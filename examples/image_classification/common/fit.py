@@ -64,6 +64,14 @@ def add_fit_args(parser):
     train.add_argument("--monitor", dest="monitor", type=int, default=0,
                        help="log network parameters every N iters if larger "
                             "than 0")
+    train.add_argument("--fused", type=int, default=-1,
+                       help="1: train via the fused ShardedTrainer step "
+                            "(the TPU performance path, docs/perf.md); "
+                            "0: the Module path (API parity); -1: auto "
+                            "(fused on TPU, Module elsewhere)")
+    train.add_argument("--dtype", type=str, default="float32",
+                       help="compute dtype for the fused path (bfloat16 "
+                            "recommended on TPU; master weights stay f32)")
     return train
 
 
@@ -95,6 +103,112 @@ def _get_lr_scheduler(args, kv):
                                                      factor=args.lr_factor))
 
 
+def _use_fused(args):
+    if getattr(args, "fused", -1) != -1:
+        return bool(args.fused)
+    try:
+        import jax
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _fit_fused(args, sym, train, val, kv):
+    """Train through the fused ShardedTrainer step (one XLA program per
+    step: forward+backward+allreduce+optimizer) with the fit-CLI surface
+    — lr schedule, checkpoints, Speedometer logging, epoch eval.
+
+    This is the performance path the bench measures (docs/perf.md: 9.5x
+    the per-op Module dispatch on a remote TPU backend); the Module path
+    (--fused 0) remains the API-parity route.  Batches are staged with
+    ``put_batch`` and the step dispatch is async, so host IO for batch
+    N+1 overlaps device compute for batch N; the loss value is fetched
+    (a device sync) only every --disp-batches.
+    """
+    import numpy as np
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    data_name, data_shape = train.provide_data[0][:2]
+    label_name, label_shape = train.provide_label[0][:2]
+    lr, lr_scheduler = _get_lr_scheduler(args, kv)
+    optimizer_params = {"lr_scheduler": lr_scheduler}
+
+    mesh = build_mesh(tp=1)
+    common = dict(
+        data_shapes={data_name: tuple(data_shape)},
+        label_shapes={label_name: tuple(label_shape)},
+        optimizer=args.optimizer, optimizer_params=optimizer_params,
+        learning_rate=lr, momentum=args.mom, weight_decay=args.wd,
+        dtype=args.dtype, auto_layouts=True,
+        initializer=mx.initializer.Xavier(
+            rnd_type="gaussian", factor_type="in", magnitude=2))
+    try:
+        trainer = ShardedTrainer(sym, mesh, layout="NHWC", **common)
+    except mx.base.MXNetError:
+        # nets with NCHW-pinned axis semantics fall back to NCHW
+        trainer = ShardedTrainer(sym, mesh, **common)
+
+    begin_epoch = args.load_epoch or 0
+    if args.load_epoch and args.model_prefix:
+        trainer.load_checkpoint(args.model_prefix, args.load_epoch)
+
+    eval_metrics = [mx.metric.create("accuracy")]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=args.top_k))
+
+    for epoch in range(begin_epoch, args.num_epochs):
+        train.reset()
+        tic = time.time()
+        nbatch = 0
+        loss = None
+        for batch in train:
+            dev = trainer.put_batch({
+                data_name: batch.data[0].asnumpy(),
+                label_name: batch.label[0].asnumpy()})
+            loss = trainer.step(dev)
+            nbatch += 1
+            if args.disp_batches and nbatch % args.disp_batches == 0:
+                # float(loss) syncs the async chain — the only per-batch
+                # device round trip, paid once per disp window
+                lval = float(loss)
+                speed = args.disp_batches * args.batch_size / \
+                    (time.time() - tic)
+                logging.info(
+                    "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    "\tcross-entropy=%f", epoch, nbatch, speed, lval)
+                tic = time.time()
+        if loss is not None:
+            logging.info("Epoch[%d] Train-cross-entropy=%f", epoch,
+                         float(loss))
+        if args.model_prefix and kv.rank == 0:
+            trainer.save_checkpoint(args.model_prefix, epoch + 1,
+                                    save_optimizer_states=True)
+        if val is not None:
+            val.reset()
+            for m in eval_metrics:
+                m.reset()
+            for batch in val:
+                probs = np.asarray(trainer.forward(
+                    {data_name: batch.data[0].asnumpy()})[0])
+                n_valid = probs.shape[0] - batch.pad
+                lab = mx.nd.array(batch.label[0].asnumpy()[:n_valid])
+                for m in eval_metrics:
+                    m.update([lab], [mx.nd.array(probs[:n_valid])])
+            for m in eval_metrics:
+                for name, value in zip(*_metric_get(m)):
+                    logging.info("Epoch[%d] Validation-%s=%f", epoch,
+                                 name, value)
+    return trainer
+
+
+def _metric_get(m):
+    name, value = m.get()
+    if not isinstance(name, list):
+        name, value = [name], [value]
+    return name, value
+
+
 def fit(args, network, data_loader, **kwargs):
     """Train the model (reference fit.py fit())."""
     kv = mx.kv.create(args.kv_store)
@@ -115,6 +229,14 @@ def fit(args, network, data_loader, **kwargs):
                              (time.time() - tic))
                 tic = time.time()
         return
+
+    if _use_fused(args):
+        if "dist" in args.kv_store:
+            logging.warning("--fused with a dist kv-store: the fused "
+                            "trainer allreduces over the device mesh of "
+                            "THIS process; use tools/launch.py host "
+                            "meshes for multi-process training")
+        return _fit_fused(args, network, train, val, kv)
 
     if args.load_epoch and args.model_prefix:
         sym, arg_params, aux_params = mx.model.load_checkpoint(

@@ -306,6 +306,196 @@ def stem_s2d_enabled():
     return os.environ.get("MXNET_STEM_S2D", "0") == "1"
 
 
+# ------------------------------------------- input-BN conv dX elision
+# In nets whose first layers are data -> BatchNorm(fix_gamma=True) ->
+# Convolution (the reference ResNet family), the stem conv's backward-
+# data pass exists ONLY to feed the input BN's beta gradient
+# (dbeta = sum_nhw conv_dX; the data itself is never differentiated and
+# fix_gamma kills dgamma).  That transposed conv is ~4% of the ResNet-50
+# step (docs/perf.md "conv1 dX") and is MXU-hostile (3/12 input
+# channels).  The channel-sums of dX are computable EXACTLY without it:
+#
+#   sum_{n,i,j} dX[n,i,j,c]
+#     = sum_{a,b,o} W[a,b,c,o] * sum_{n, (p,q) in valid(a) x valid(b)} dY
+#
+# where valid(a) is the CONTIGUOUS range of output rows whose tap ``a``
+# lands in-bounds — so each tap's term is a rectangle sum on the
+# integral image of the batch-reduced dY.  The elided conv returns a
+# constant-per-channel fake dX carrying those exact sums (sum-preserving
+# broadcast), which the BN backward reduces back to dbeta; XLA DCEs
+# everything else dX fed (the dead data gradient).
+#
+# SAFETY: only valid when the conv input's cotangent is consumed by
+# channel-sums alone — i.e. the BN input is a non-differentiated batch
+# variable and fix_gamma is set.  eval_graph plans it only for convs fed
+# by such a BN, and only when the caller declares its batch-variable
+# names via ``elide_input_grads`` (ShardedTrainer does: its vjp is over
+# params only).  Executor/autograd paths, which may request data
+# gradients (adversarial examples), never enable it.
+_ELIDE_NAMES = None
+
+
+class elide_input_grads:
+    """Context manager declaring batch-input variable names whose
+    gradients the caller will never request."""
+
+    def __init__(self, names):
+        self.names = frozenset(names) if names else frozenset()
+
+    def __enter__(self):
+        global _ELIDE_NAMES
+        self._prev = _ELIDE_NAMES
+        _ELIDE_NAMES = self.names
+        return self
+
+    def __exit__(self, *exc):
+        global _ELIDE_NAMES
+        _ELIDE_NAMES = self._prev
+
+
+def elide_names():
+    return _ELIDE_NAMES or frozenset()
+
+
+def plan_input_bn_elide(topo, entries, names):
+    """{id(conv node)} whose backward-data pass can be elided: 2-d
+    no-bias group-1 convs consuming (only they) a BatchNorm with
+    fix_gamma whose data input is one of ``names``."""
+    if not names:
+        return set()
+    uses = {}
+    for node in topo:
+        for (src, _i) in node.inputs:
+            uses[id(src)] = uses.get(id(src), 0) + 1
+    for (node, _i) in entries:
+        uses[id(node)] = uses.get(id(node), 0) + 1
+    out = set()
+    for node in topo:
+        if node.is_variable or node.op is None:
+            continue
+        if node.op.name != "Convolution":
+            continue
+        a = node.attrs
+        if (len(tuple(a.get("kernel") or ())) != 2
+                or int(a.get("num_group", 1)) != 1
+                or not a.get("no_bias")):
+            continue
+        src, idx = node.inputs[0]
+        if (src.is_variable or src.op is None or idx != 0
+                or src.op.name != "BatchNorm"
+                or not src.attrs.get("fix_gamma", True)
+                or uses.get(id(src), 0) != 1):
+            continue
+        data_src = _follow_passthrough(src.inputs[0][0])
+        if data_src is not None and data_src.is_variable \
+                and data_src.name in names:
+            out.add(id(node))
+    return out
+
+
+def _follow_passthrough(node):
+    """Walk back through shape/value-preserving single-use pass-through
+    nodes (identity/_copy — the reference resnet's ``sym.identity`` stem
+    wrapper).  Gradient flow through them is the identity, so plans that
+    reason about a producer chain may look through them.  Returns the
+    first non-pass-through node, or None on a malformed chain."""
+    seen = 0
+    while (node is not None and not node.is_variable
+           and node.op is not None
+           and node.op.name in ("identity", "_copy")):
+        if not node.inputs:
+            return None
+        node = node.inputs[0][0]
+        seen += 1
+        if seen > 32:  # defensive: no such chain is legitimate
+            return None
+    return node
+
+
+def _tap_range(a, stride, pad_lo, dilate, size_in, size_out):
+    """Inclusive (lo, hi) range of output positions whose tap ``a`` reads
+    an in-bounds input element; empty when lo > hi."""
+    off = a * dilate - pad_lo
+    # p >= ceil(-off / stride), p <= floor((size_in - 1 - off) / stride)
+    lo = max(0, (-off + stride - 1) // stride) if off < 0 else 0
+    hi = min(size_out - 1, (size_in - 1 - off) // stride)
+    return lo, hi
+
+
+def _dx_channel_sums(dy, w_hwio, strides, padding, dilate, in_h, in_w):
+    """Exact (C,) sums over n,h,w of the conv's backward-data cotangent,
+    via rectangle sums on the integral image of the batch-reduced dY."""
+    kh, kw = w_hwio.shape[0], w_hwio.shape[1]
+    ho, wo = dy.shape[1], dy.shape[2]
+    d = jnp.sum(dy.astype(jnp.float32), axis=0)          # (Ho, Wo, O)
+    integ = jnp.pad(jnp.cumsum(jnp.cumsum(d, axis=0), axis=1),
+                    ((1, 0), (1, 0), (0, 0)))
+    rows = [_tap_range(a, strides[0], padding[0][0], dilate[0], in_h, ho)
+            for a in range(kh)]
+    cols = [_tap_range(b, strides[1], padding[1][0], dilate[1], in_w, wo)
+            for b in range(kw)]
+    taps = []
+    for rlo, rhi in rows:
+        row_taps = []
+        for clo, chi in cols:
+            if rlo > rhi or clo > chi:
+                row_taps.append(jnp.zeros((d.shape[-1],), jnp.float32))
+                continue
+            row_taps.append(integ[rhi + 1, chi + 1] - integ[rlo, chi + 1]
+                            - integ[rhi + 1, clo] + integ[rlo, clo])
+        taps.append(jnp.stack(row_taps))
+    rect = jnp.stack(taps)                               # (kh, kw, O)
+    return jnp.einsum("abio,abo->i", w_hwio.astype(jnp.float32), rect)
+
+
+@functools.lru_cache(maxsize=None)
+def _elided_conv(strides, padding, dilate):
+    """NHWC x HWIO conv whose backward-data is replaced by the exact
+    sum-preserving constant broadcast (see module comment above)."""
+
+    def conv(x, w):
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+        return lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilate, dimension_numbers=dn)
+
+    @jax.custom_vjp
+    def f(x, w):
+        return conv(x, w)
+
+    def f_fwd(x, w):
+        return conv(x, w), (x, w)
+
+    def f_bwd(res, dy):
+        x, w = res
+        _, wvjp = jax.vjp(lambda ww: conv(x, ww), w)
+        (dw,) = wvjp(dy)
+        s = _dx_channel_sums(dy, w, strides, padding, dilate,
+                             x.shape[1], x.shape[2])
+        m = x.shape[0] * x.shape[1] * x.shape[2]
+        dx = jnp.broadcast_to((s / m).astype(x.dtype), x.shape)
+        return dx, dw
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def elided_conv_apply(attrs, x, w):
+    """Evaluate an elide-planned Convolution node (NHWC activations,
+    reference-OIHW weight), mirroring ops/nn.py `convolution`."""
+    from .nn import _mxu_out
+    kernel = tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = tuple(attrs["stride"]) or (1,) * nd
+    dilate = tuple(attrs["dilate"]) or (1,) * nd
+    pad = tuple(attrs["pad"]) or (0,) * nd
+    w_hwio = jnp.transpose(w, (2, 3, 1, 0))
+    f = _elided_conv(tuple(stride), tuple((p, p) for p in pad),
+                     tuple(dilate))
+    return _mxu_out(f(x, w_hwio).astype(x.dtype))
+
+
 def _stem_eligible(node):
     a = node.attrs
     return (tuple(a.get("kernel") or ()) == (7, 7)
@@ -316,22 +506,34 @@ def _stem_eligible(node):
 
 
 def plan_stem_s2d(topo):
-    """{id(conv node)} for stem convs fed directly by a data variable."""
+    """{id(conv node)} for stem convs fed by the input pipeline: a data
+    variable, possibly through identity/_copy wrappers and/or an input
+    BatchNorm (the reference resnet v2's ``id`` + ``bn_data`` chain —
+    shape-preserving, so the s2d rewrite of the conv stays exact)."""
     out = set()
     for node in topo:
         if node.is_variable or node.op is None:
             continue
         if node.op.name != "Convolution" or not _stem_eligible(node):
             continue
-        src, _ = node.inputs[0]
-        if src.is_variable:
+        src = _follow_passthrough(node.inputs[0][0])
+        if (src is not None and not src.is_variable and src.op is not None
+                and src.op.name == "BatchNorm"):
+            src = _follow_passthrough(src.inputs[0][0])
+        if src is not None and src.is_variable:
             out.add(id(node))
     return out
 
 
-def stem_s2d_conv(x, w):
+def stem_s2d_conv(x, w, elide=False):
     """x: NHWC (N, H, W, 3) with H, W even; w: OIHW (O, C, 7, 7).
-    Returns the identical conv1 output at (N, H/2, W/2, O)."""
+    Returns the identical conv1 output at (N, H/2, W/2, O).
+
+    ``elide=True`` swaps the inner conv's backward-data pass for the
+    exact channel-sum elision (`_elided_conv`); valid only under an
+    active `elide_input_grads` plan.  The sum-preserving fake dX
+    backpropagates through the (bijective) space-to-depth rearrangement,
+    so the upstream BN still receives exact channel sums."""
     nb, h, wd, cin = x.shape
     nout = w.shape[0]
     # space-to-depth 2x2, phase-major channels (ph, pw, i)
@@ -344,6 +546,9 @@ def stem_s2d_conv(x, w):
     w6 = wp.reshape(nout, cin, 4, 2, 4, 2)          # O, C, u, ph, v, pw
     w2 = jnp.transpose(w6, (2, 4, 3, 5, 1, 0))      # u, v, ph, pw, C, O
     w2 = w2.reshape(4, 4, 4 * cin, nout).astype(x.dtype)
+    if elide:
+        f = _elided_conv((1, 1), ((2, 1), (2, 1)), (1, 1))
+        return f(x2, w2)
     import jax.lax as _lax
     dn = _lax.conv_dimension_numbers(x2.shape, w2.shape,
                                      ("NHWC", "HWIO", "NHWC"))

@@ -140,7 +140,7 @@ class ShardedTrainer:
                  momentum=0.9, weight_decay=0.0, initializer=None,
                  dtype="float32", tp_rules=None, seed=0, layout=None,
                  auto_layouts=False, fuse_conv_bn=None,
-                 stem_space_to_depth=None):
+                 stem_space_to_depth=None, elide_input_bn_grad=True):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -187,6 +187,10 @@ class ShardedTrainer:
             stem_space_to_depth = _fused_mod.stem_s2d_enabled()
         self._stem_s2d = bool(stem_space_to_depth) and \
             self._layout == "NHWC"
+        # elide_input_bn_grad: skip backward-data of convs that only feed
+        # an input-BN beta grad (ops/fused.py).  Always sound here: the
+        # trainer's vjp differentiates params only, never batch inputs.
+        self._elide_input_grads = bool(elide_input_bn_grad)
 
         self._topo = symbol._topo()
         if self._layout == "NHWC":
@@ -308,6 +312,7 @@ class ShardedTrainer:
                 for n in self._param_names}
 
         self._step_fn = self._build_step()
+        self._scan_fns = {}
         self._fwd_fn = None
         self._step_count = 0
         self._key = jax.random.PRNGKey(seed)
@@ -403,11 +408,15 @@ class ShardedTrainer:
             def fwd(p32):
                 # compute-precision copies of the f32 masters; the astype
                 # vjp returns f32 grads automatically
-                from ..ops.fused import conv_bn_fusion, stem_s2d
+                from ..ops.fused import (conv_bn_fusion, stem_s2d,
+                                         elide_input_grads)
                 p = {k: v.astype(compute_dtype) for k, v in p32.items()}
                 with image_layout(layout), \
                         conv_bn_fusion(self._fuse_conv_bn), \
-                        stem_s2d(self._stem_s2d):
+                        stem_s2d(self._stem_s2d), \
+                        elide_input_grads(
+                            self._input_names
+                            if self._elide_input_grads else ()):
                     var_values = self._node_value_map(p, batch, aux)
                     heads, aux_upd = eval_graph(topo, entries, var_values,
                                                 is_train=True, key=key,
@@ -451,6 +460,7 @@ class ShardedTrainer:
                     loss = -jnp.mean(jnp.log(jnp.maximum(p, 1e-10)))
             return new_params, new_state, new_aux, loss
 
+        self._py_step = step
         state_sharding = {n: [self._param_sharding[n]] * self._n_slots
                           for n in self._param_names}
         if self._auto_layouts:
@@ -464,7 +474,52 @@ class ShardedTrainer:
                        out_shardings=out_shardings,
                        donate_argnums=(0, 1, 2))
 
-    def _compile_auto_layout(self, step, state_sharding):
+    def _build_multi_step(self, k):
+        """k steps chained inside ONE compiled program via lax.scan.
+
+        Per-step dispatch over a remote backend (the axon tunnel) costs
+        ~2-3 ms; chaining steps in-program removes it entirely and lets
+        XLA keep params/state resident between iterations.  lr and t are
+        (k,) arrays (the host-side lr_scheduler is evaluated per step up
+        front), so schedules behave exactly as in :meth:`step`.
+        """
+        import jax
+        from jax import lax
+
+        step = self._py_step
+
+        def multi(params, opt_state, aux, batch, key, lrs, ts):
+            def body(carry, xs):
+                p, s, a, ky = carry
+                lr, t = xs
+                ky, sub = jax.random.split(ky)
+                p, s, a, loss = step(p, s, a, batch, sub, lr, t)
+                return (p, s, a, ky), loss
+
+            (params, opt_state, aux, _), losses = lax.scan(
+                body, (params, opt_state, aux, key), (lrs, ts), length=k)
+            return params, opt_state, aux, losses
+
+        state_sharding = {n: [self._param_sharding[n]] * self._n_slots
+                          for n in self._param_names}
+        if self._auto_layouts:
+            import jax.numpy as jnp
+            return self._compile_auto_layout(
+                multi, state_sharding,
+                lr_example=jnp.zeros((k,), jnp.float32),
+                t_example=jnp.ones((k,), jnp.float32),
+                migrate=False)
+        in_shardings = (self._param_sharding, state_sharding,
+                        self._aux_sharding, self._batch_sharding,
+                        None, None, None)
+        out_shardings = (self._param_sharding, state_sharding,
+                         self._aux_sharding, None)
+        return jax.jit(multi, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1, 2))
+
+    def _compile_auto_layout(self, step, state_sharding, lr_example=None,
+                             t_example=None, migrate=True):
         """Compile the step with XLA-chosen parameter/state layouts.
 
         jit pins donated I/O to default layouts, so every step pays
@@ -474,6 +529,11 @@ class ShardedTrainer:
         in its preferred tilings ACROSS steps (the state is donated, so
         the layout round-trips for free); the one-time device_put below
         migrates the live state into the chosen formats.
+
+        Each AOT compile may choose different layouts, so the chosen
+        formats are recorded on the compiled object (``_state_formats``)
+        and callers re-migrate via :meth:`_ensure_state_formats` when
+        switching between compiled entry points (step vs run_steps).
         """
         import jax
         import jax.numpy as jnp
@@ -506,18 +566,63 @@ class ShardedTrainer:
             return jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
 
+        if lr_example is None:
+            lr_example = jnp.float32(0.0)
+        if t_example is None:
+            t_example = jnp.float32(1.0)
         example = (as_spec(self.params), as_spec(self.opt_state),
                    as_spec(self.aux), zero_batch, jax.random.PRNGKey(0),
-                   jnp.float32(0.0), jnp.float32(1.0))
+                   lr_example, t_example)
         compiled = jf.lower(*example).compile()
         fmts = compiled.input_formats[0]
-        # migrate live state into the chosen layouts (one-time copies)
+        compiled._state_formats = (fmts[0], fmts[1], fmts[2])
+        if migrate:
+            # migrate live state into the chosen layouts (one-time copies)
+            self._migrate_state(compiled._state_formats)
+        return compiled
+
+    def _migrate_state(self, fmts):
+        import jax
         self.params = jax.device_put(self.params, fmts[0])
         self.opt_state = jax.device_put(self.opt_state, fmts[1])
         self.aux = jax.device_put(self.aux, fmts[2])
-        return compiled
+        self._live_formats = fmts
+
+    def _ensure_state_formats(self, compiled):
+        """Under auto_layouts, move live state into the layouts the given
+        compiled entry point was lowered with (no-op when they match)."""
+        fmts = getattr(compiled, "_state_formats", None)
+        if fmts is not None and \
+                getattr(self, "_live_formats", None) is not fmts:
+            self._migrate_state(fmts)
 
     # ------------------------------------------------------------------ api
+    def _maybe_rebuild(self):
+        """Recompile when optimizer hyperparameters changed.
+
+        The reference Optimizer reads lr_mult/wd_mult/rescale on every
+        update; they are baked into the compiled step here, so post-build
+        set_lr_mult()/set_wd_mult()/rescale changes are honored by
+        recompiling (and reallocating slots if the rule changed)."""
+        import jax
+        opt = self.optimizer
+        if self._hyper_state() == self._hyper_snapshot:
+            return
+        self._rescale = opt.rescale_grad
+        old_slots = self._n_slots
+        self._n_slots, self._update_rule = _make_update_rule(opt)
+        if self._n_slots != old_slots:
+            with self.mesh:
+                self.opt_state = {
+                    n: [jax.device_put(
+                            np.zeros(self._arg_shapes[n], np.float32),
+                            self._param_sharding[n])
+                        for _ in range(self._n_slots)]
+                    for n in self._param_names}
+        self._step_fn = self._build_step()
+        self._scan_fns = {}
+        self._hyper_snapshot = self._hyper_state()
+
     def _cast_batch(self, batch):
         """Data inputs follow the compute dtype (bf16 training) and the
         active layout; labels keep their own dtype."""
@@ -552,23 +657,7 @@ class ShardedTrainer:
         else:
             dev_batch = self.put_batch(batch)
         opt = self.optimizer
-        # reference Optimizer reads lr_mult/wd_mult/rescale on every update;
-        # they are baked into the compiled step here, so honor post-build
-        # set_lr_mult()/set_wd_mult()/rescale changes by recompiling
-        if self._hyper_state() != self._hyper_snapshot:
-            self._rescale = opt.rescale_grad
-            old_slots = self._n_slots
-            self._n_slots, self._update_rule = _make_update_rule(opt)
-            if self._n_slots != old_slots:
-                with self.mesh:
-                    self.opt_state = {
-                        n: [jax.device_put(
-                                np.zeros(self._arg_shapes[n], np.float32),
-                                self._param_sharding[n])
-                            for _ in range(self._n_slots)]
-                        for n in self._param_names}
-            self._step_fn = self._build_step()
-            self._hyper_snapshot = self._hyper_state()
+        self._maybe_rebuild()
         self._step_count += 1
         # num_update honors begin_num_update so lr schedule AND adam bias
         # correction continue consistently across resume
@@ -576,10 +665,55 @@ class ShardedTrainer:
                              + self._step_count)
         lr = (opt.lr_scheduler(opt.num_update)
               if opt.lr_scheduler is not None else opt.lr)
+        self._ensure_state_formats(self._step_fn)
         self.params, self.opt_state, self.aux, loss = self._step_fn(
             self.params, self.opt_state, self.aux, dev_batch, sub,
             jnp.float32(lr), jnp.float32(opt.num_update))
         return loss
+
+    def run_steps(self, batch, num_steps):
+        """``num_steps`` fused training steps in ONE device program.
+
+        The scan-chained equivalent of calling :meth:`step` in a loop on
+        the same batch: per-step host dispatch (~2-3 ms over a remote
+        tunnel) disappears and XLA keeps the donated state resident
+        between iterations.  lr schedules advance per inner step exactly
+        as in :meth:`step` (the scheduler is evaluated on host into a
+        (num_steps,) lr array).  Returns the per-step loss array.
+
+        Use for throughput-critical loops where the batch is staged once
+        (benchmarks, synthetic-data soak runs); for distinct batches per
+        step, stage the next batch with :meth:`put_batch` while the chip
+        runs (double buffering) and call :meth:`step` per batch.
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+
+        first = next(iter(batch.values()))
+        dev_batch = batch if isinstance(first, jax.Array) \
+            else self.put_batch(batch)
+        self._maybe_rebuild()
+        fn = self._scan_fns.get(num_steps)
+        if fn is None:
+            fn = self._build_multi_step(num_steps)
+            self._scan_fns[num_steps] = fn
+        opt = self.optimizer
+        ts, lrs = [], []
+        for _ in range(num_steps):
+            self._step_count += 1
+            opt.num_update = max(opt.num_update, opt.begin_num_update
+                                 + self._step_count)
+            ts.append(opt.num_update)
+            lrs.append(opt.lr_scheduler(opt.num_update)
+                       if opt.lr_scheduler is not None else opt.lr)
+        self._key, sub = jax.random.split(self._key)
+        self._ensure_state_formats(fn)
+        self.params, self.opt_state, self.aux, losses = fn(
+            self.params, self.opt_state, self.aux, dev_batch, sub,
+            jnp.asarray(_np.asarray(lrs, _np.float32)),
+            jnp.asarray(_np.asarray(ts, _np.float32)))
+        return losses
 
     def forward(self, batch, is_train=False):
         """Jitted inference forward returning head arrays."""

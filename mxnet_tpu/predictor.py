@@ -114,3 +114,21 @@ class Predictor:
         self._executor = self._executor.reshape(allow_up_sizing=True,
                                                 **input_shapes)
         return self
+
+    def reshaped(self, input_shapes):
+        """Return a NEW Predictor bound at ``input_shapes``, leaving this
+        one untouched.
+
+        Reference MXPredReshape (c_predict_api.cc:228-270) hands the caller
+        a fresh handle backed by a new executor while the original handle
+        keeps working at its original shapes (weights are shared); this is
+        the method the native ABI calls so one handle per batch size works.
+        """
+        clone = object.__new__(Predictor)
+        clone._symbol = self._symbol
+        # partial reshape keeps the full input set (reference allows
+        # reshaping a subset of inputs; the others keep their shapes)
+        clone._input_names = list(self._input_names)
+        clone._executor = self._executor.reshape(allow_up_sizing=True,
+                                                 **input_shapes)
+        return clone

@@ -42,9 +42,13 @@ class Rtc:
         if callable(kernel):
             self._kernel = kernel
         else:
-            # cache by (name, source), as mxrtc.cc caches PTX by source:
-            # re-creating an Rtc with identical source skips the compile
-            key = (name, kernel)
+            # cache by (name, source, arg names), as mxrtc.cc caches PTX
+            # by source: re-creating an Rtc with identical source skips
+            # the compile.  Arg names are part of the key because the
+            # compiled function's parameters are built from them — same
+            # source with different variable names is a different kernel.
+            key = (name, kernel,
+                   tuple(self._in_names), tuple(self._out_names))
             cached = _CACHE.get(key)
             if cached is None:
                 cached = self._compile_source(kernel)

@@ -129,6 +129,7 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
     # their input values to the consuming BatchNorm node
     fuse_plan, fuse_skip = {}, set()
     stem_plan = set()
+    elide_plan = set()
     if is_train and not device_map:
         from .ops import fused as _fused
         from .ops.nn import current_image_layout
@@ -138,6 +139,11 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
                     topo, entries)
             if _fused.stem_s2d_enabled():
                 stem_plan = _fused.plan_stem_s2d(topo)
+            if _fused.elide_names():
+                # convs whose backward-data exists only to feed an input
+                # BN's beta grad (ops/fused.py input-BN dX elision)
+                elide_plan = _fused.plan_input_bn_elide(
+                    topo, entries, _fused.elide_names())
 
     for i, node in enumerate(topo):
         if node.is_variable:
@@ -177,9 +183,16 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
             sx = s_ins[0]
             if sx.ndim == 4 and sx.shape[1] % 2 == 0 \
                     and sx.shape[2] % 2 == 0:
-                vals[id(node)] = (_fused.stem_s2d_conv(sx, s_ins[1]),)
+                vals[id(node)] = (_fused.stem_s2d_conv(
+                    sx, s_ins[1], elide=id(node) in elide_plan),)
                 continue
             # odd spatial size: fall through to the direct conv
+        if id(node) in elide_plan:
+            from .ops import fused as _fused
+            e_ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
+            vals[id(node)] = (_fused.elided_conv_apply(
+                node.attrs, e_ins[0], e_ins[1]),)
+            continue
         ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
         dev = device_map.get(id(node))
         if dev is not None:

@@ -88,7 +88,16 @@ class _TorchModuleOp(_op.CustomOp):
 class _TorchModuleProp(_op.CustomOpProp):
     def __init__(self, module_key):
         super().__init__(need_top_grad=True)
-        self.module = _MODULES[str(module_key)]
+        try:
+            self.module = _MODULES[str(module_key)]
+        except KeyError:
+            raise MXNetError(
+                "TorchModule symbol refers to a live torch.nn.Module "
+                "(key %r) that is not registered in this process.  Torch "
+                "bridge symbols are NOT serializable: a graph saved with "
+                "tojson()/save() or re-created in another process must "
+                "rebuild the symbol with mx.sym.TorchModule(...) so the "
+                "module object is re-registered." % str(module_key))
         self._params = list(self.module.parameters())
 
     def list_arguments(self):
@@ -130,6 +139,11 @@ class _TorchCriterionOp(_op.CustomOp):
         x = th.from_numpy(in_data[0].asnumpy().copy())
         with th.no_grad():
             loss = self.criterion(x, self._label(th, in_data[1]))
+        if loss.dim() > 0:
+            # criterions configured with reduction='none' return a
+            # per-sample vector; the op contract is a scalar loss
+            # broadcast per sample (torch_criterion-inl.h), so reduce
+            loss = loss.mean()
         n = in_data[0].shape[0]
         self.assign(out_data[0], req[0],
                     np.full((n,), float(loss), np.float32))
@@ -140,6 +154,8 @@ class _TorchCriterionOp(_op.CustomOp):
         x = th.from_numpy(in_data[0].asnumpy().copy())
         x.requires_grad_(True)
         loss = self.criterion(x, self._label(th, in_data[1]))
+        if loss.dim() > 0:
+            loss = loss.mean()
         (gx,) = th.autograd.grad(loss, [x])
         self.assign(in_grad[0], req[0], gx.numpy())
         self.assign(in_grad[1], req[1],
@@ -150,7 +166,14 @@ class _TorchCriterionOp(_op.CustomOp):
 class _TorchCriterionProp(_op.CustomOpProp):
     def __init__(self, criterion_key, label_shape="", label_dtype="long"):
         super().__init__(need_top_grad=False)
-        self.criterion = _CRITERIA[str(criterion_key)]
+        try:
+            self.criterion = _CRITERIA[str(criterion_key)]
+        except KeyError:
+            raise MXNetError(
+                "TorchCriterion symbol refers to a live torch criterion "
+                "(key %r) not registered in this process; rebuild the "
+                "symbol with mx.sym.TorchCriterion(...) — torch bridge "
+                "symbols are not serializable." % str(criterion_key))
         self.label_dtype = str(label_dtype)
 
     def list_arguments(self):

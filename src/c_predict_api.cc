@@ -116,14 +116,26 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
     PyObject *mod = PyImport_ImportModule("mxnet_tpu");
     if (!mod) { SetPyError(); return -1; }
     PyObject *ctx_mod = PyObject_GetAttrString(mod, "context");
+    if (!ctx_mod) { SetPyError(); Py_DECREF(mod); return -1; }
     PyObject *ctx = PyObject_CallMethod(ctx_mod, "Context", "si",
                                         DevName(dev_type), dev_id);
-    if (!ctx) { SetPyError(); return -1; }
+    if (!ctx) {
+      SetPyError();
+      Py_DECREF(ctx_mod);
+      Py_DECREF(mod);
+      return -1;
+    }
 
     PyObject *shapes = BuildShapesDict(num_input_nodes, input_keys,
                                        input_shape_indptr,
                                        input_shape_data);
-    if (!shapes) { SetPyError(); return -1; }
+    if (!shapes) {
+      SetPyError();
+      Py_DECREF(ctx);
+      Py_DECREF(ctx_mod);
+      Py_DECREF(mod);
+      return -1;
+    }
     auto rec = new PredRecord();
     for (mx_uint i = 0; i < num_input_nodes; ++i) {
       rec->input_keys.emplace_back(input_keys[i]);
@@ -143,6 +155,9 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
       Py_XDECREF(cls);
       Py_XDECREF(pred_mod);
       Py_DECREF(shapes);
+      Py_DECREF(ctx);
+      Py_DECREF(ctx_mod);
+      Py_DECREF(mod);
       delete rec;
       return -1;
     }
@@ -179,6 +194,7 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
   if (!np) { SetPyError(); return -1; }
   PyObject *bytes = PyBytes_FromStringAndSize(
       reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+  if (!bytes) { SetPyError(); Py_DECREF(np); return -1; }
   PyObject *flat = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
                                        "float32");
   Py_DECREF(bytes);
@@ -278,16 +294,15 @@ int MXPredReshape(PredictorHandle handle, mx_uint num_input_nodes,
                                      input_shape_indptr,
                                      input_shape_data);
   if (!shapes) { SetPyError(); return -1; }
-  PyObject *r = PyObject_CallMethod(rec->predictor, "reshape", "O",
-                                    shapes);
+  // reference semantics: the caller owns a NEW handle backed by a new
+  // executor; the old handle stays usable at its original shapes (the
+  // weights are shared).  Predictor.reshaped returns that new object.
+  PyObject *fresh_pred = PyObject_CallMethod(rec->predictor, "reshaped",
+                                             "O", shapes);
   Py_DECREF(shapes);
-  if (!r) { SetPyError(); return -1; }
-  Py_DECREF(r);
-  // reference semantics: the caller owns a NEW handle and frees both the
-  // old and the new one independently
+  if (!fresh_pred) { SetPyError(); return -1; }
   auto fresh = new PredRecord();
-  fresh->predictor = rec->predictor;
-  Py_INCREF(fresh->predictor);
+  fresh->predictor = fresh_pred;
   fresh->input_keys = rec->input_keys;
   *out = fresh;
   return 0;
