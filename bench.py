@@ -57,7 +57,9 @@ def main():
         auto_layouts=os.environ.get("BENCH_AUTO_LAYOUT", "1") == "1",
         # exact 4x4/s1 space-to-depth rewrite of the 7x7/s2 stem
         # (ops/fused.py; ~+1%, parity-tested)
-        stem_space_to_depth=os.environ.get("BENCH_STEM_S2D", "1") == "1")
+        stem_space_to_depth=os.environ.get("BENCH_STEM_S2D", "1") == "1",
+        # measured-off (docs/perf.md): phase-decomposed stride-2 backward
+        strided_bwd_phase=os.environ.get("BENCH_PHASE_BWD", "0") == "1")
 
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)

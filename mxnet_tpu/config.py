@@ -46,6 +46,9 @@ _CATALOG = {
         "measured slower on v5e; off by default)"),
     "MXNET_STEM_S2D": ("0", "honored",
         "space-to-depth rewrite of 7x7/s2 stem convs in ShardedTrainer"),
+    "MXNET_PHASE_BWD": ("0", "honored",
+        "phase-decomposed stride-2 conv backward-data (docs/perf.md: "
+        "measured slower on v5e; off by default)"),
     "MXNET_PROFILER_AUTOSTART": ("0", "honored", "see profiler.py"),
     "MXNET_PROFILER_MODE": ("0", "honored", ""),
     "MXNET_PROFILER_FILENAME": ("profile.json", "honored", ""),

@@ -31,30 +31,37 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-_FUSE = None  # tri-state: None -> env, True/False -> forced
+def _trace_flag(env_var, doc):
+    """(context_manager_class, enabled_fn) for a tri-state trace flag:
+    None -> the env var decides, True/False -> forced by the context."""
+    state = {"v": None}
+
+    class Ctx:
+        def __init__(self, enable):
+            self.enable = enable
+
+        def __enter__(self):
+            self._prev = state["v"]
+            state["v"] = self.enable
+            return self
+
+        def __exit__(self, *exc):
+            state["v"] = self._prev
+
+    Ctx.__doc__ = doc
+
+    def enabled():
+        if state["v"] is not None:
+            return bool(state["v"])
+        return os.environ.get(env_var, "0") == "1"
+
+    return Ctx, enabled
 
 
-class conv_bn_fusion:
-    """Context manager enabling/disabling the fusion during a trace."""
-
-    def __init__(self, enable):
-        self.enable = enable
-
-    def __enter__(self):
-        global _FUSE
-        self._prev = _FUSE
-        _FUSE = self.enable
-        return self
-
-    def __exit__(self, *exc):
-        global _FUSE
-        _FUSE = self._prev
-
-
-def fusion_enabled():
-    if _FUSE is not None:
-        return bool(_FUSE)
-    return os.environ.get("MXNET_FUSE_CONV_BN", "0") == "1"
+conv_bn_fusion, fusion_enabled = _trace_flag(
+    "MXNET_FUSE_CONV_BN",
+    "Context manager enabling/disabling the conv1x1+BN fusion during a "
+    "trace.")
 
 
 # ------------------------------------------------------------ the kernel
@@ -272,6 +279,120 @@ def plan_conv_bn_fusion(topo, entries=()):
     return plan, skip
 
 
+# --------------------------------- phase-decomposed stride-2 backward
+# XLA computes backward-data of a strided conv as a conv over the
+# lhs-dilated cotangent: for stride 2, ~3/4 of the MACs multiply
+# inserted zeros.  The exact phase decomposition removes every wasted
+# MAC: output positions of parity (r_h, r_w) only receive kernel taps of
+# matching parity, so dX splits into 4 dense stride-1 convs of dY with
+# the parity sub-kernels, interleaved back (depth-to-space).  Derivation
+# (per dim, stride 2, pad P, kernel k):
+#
+#   dX[i] = sum_{a ≡ (i+P) mod 2} dY[(i+P-a)/2] * W[a]
+#         = sum_u dY[q-u] * W[r+2u],  q = floor((i+P)/2), r = (i+P) mod 2
+#
+# — a correlation of dY with the reversed parity-r sub-kernel, offset so
+# q' = q - ku + 1 (left pad ku-1-q_lo, right pad q_max-Ho+1; negative
+# pads crop).  Mathematically exact; bitwise it differs from the dilated
+# form only in f32 accumulation order.  Enabled per-trace by the
+# ``phase_bwd`` context (ShardedTrainer strided_bwd_phase=True).
+phase_bwd, phase_bwd_enabled = _trace_flag(
+    "MXNET_PHASE_BWD",
+    "Context manager enabling the stride-2 backward decomposition.")
+
+
+def _phase_ranges(k, pad, h_in, h_out):
+    """Per-parity (ku, q_lo, pad_l, pad_r, i0) for one spatial dim."""
+    out = []
+    for r in (0, 1):
+        ku = max(0, (k - r + 1) // 2)          # taps a = r, r+2, ... < k
+        # i = 2q + r - pad ranges over [0, h_in): q in [q_lo, q_lo + h/2)
+        q_lo = max(0, (pad - r + 1) // 2)
+        i0 = 2 * q_lo + r - pad
+        n = h_in // 2
+        q_max = q_lo + n - 1
+        pad_l = ku - 1 - q_lo
+        pad_r = q_max - h_out + 1
+        out.append((ku, q_lo, pad_l, pad_r, i0))
+    return out
+
+
+def _phase_bwd_dx(dy, w_hwio, pads, x_shape):
+    """Exact dX of a stride-2 NHWC/HWIO conv via phase decomposition."""
+    kh, kw = w_hwio.shape[0], w_hwio.shape[1]
+    nb, h, wd, cin = x_shape
+    ho, wo = dy.shape[1], dy.shape[2]
+    wt = jnp.transpose(w_hwio, (0, 1, 3, 2))     # contraction over cout
+    rows = _phase_ranges(kh, pads[0][0], h, ho)
+    cols = _phase_ranges(kw, pads[1][0], wd, wo)
+    # phases keyed by output-row parity i0 (each is 0 or 1 exactly once)
+    zs = {}
+    for (kuh, _qh, plh, prh, i0h) in rows:
+        for (kuw, _qw, plw, prw, i0w) in cols:
+            rh = (i0h + pads[0][0]) % 2
+            rw = (i0w + pads[1][0]) % 2
+            if kuh == 0 or kuw == 0:
+                zs[(i0h, i0w)] = jnp.zeros(
+                    (nb, h // 2, wd // 2, cin), dy.dtype)
+                continue
+            sub = wt[rh::2, rw::2]               # (kuh, kuw, cout, cin)
+            sub = sub[::-1, ::-1]                # reversed correlation
+            dn = lax.conv_dimension_numbers(dy.shape, sub.shape,
+                                            ("NHWC", "HWIO", "NHWC"))
+            zs[(i0h, i0w)] = lax.conv_general_dilated(
+                dy, sub, window_strides=(1, 1),
+                padding=((plh, prh), (plw, prw)),
+                dimension_numbers=dn)
+    # interleave: dX[:, 2q+i0h, 2p+i0w, :] = zs[(i0h, i0w)][:, q, p, :]
+    w_even = jnp.stack([zs[(0, 0)], zs[(0, 1)]], axis=3)
+    w_odd = jnp.stack([zs[(1, 0)], zs[(1, 1)]], axis=3)
+    row_even = w_even.reshape(nb, h // 2, wd, cin)
+    row_odd = w_odd.reshape(nb, h // 2, wd, cin)
+    full = jnp.stack([row_even, row_odd], axis=2)
+    return full.reshape(nb, h, wd, cin)
+
+
+@functools.lru_cache(maxsize=None)
+def _phase_bwd_conv(pads):
+    """Stride-2 NHWC x HWIO conv whose backward-data uses the phase
+    decomposition (backward-filter unchanged)."""
+
+    def conv(x, w):
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+        return lax.conv_general_dilated(
+            x, w, window_strides=(2, 2), padding=pads,
+            dimension_numbers=dn)
+
+    @jax.custom_vjp
+    def f(x, w):
+        return conv(x, w)
+
+    def f_fwd(x, w):
+        return conv(x, w), (x, w)
+
+    def f_bwd(res, dy):
+        x, w = res
+        _, wvjp = jax.vjp(lambda ww: conv(x, ww), w)
+        (dw,) = wvjp(dy)
+        dx = _phase_bwd_dx(dy, w, pads, x.shape)
+        return dx.astype(x.dtype), dw
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def phase_bwd_eligible(x_shape, kernel, stride, pad, dilate, num_group):
+    return (len(kernel) == 2 and tuple(stride) == (2, 2)
+            and tuple(dilate) == (1, 1) and int(num_group) == 1
+            and x_shape[1] % 2 == 0 and x_shape[2] % 2 == 0)
+
+
+def phase_bwd_conv_nhwc(x, w_hwio, pads):
+    """Entry point for ops/nn.py: stride-2 conv with decomposed bwd."""
+    return _phase_bwd_conv(tuple(pads))(x, w_hwio)
+
+
 # ------------------------------------------- space-to-depth stem conv
 # MLPerf-style stem optimization: the 7x7/s2 conv on C=3 input wastes
 # the 128-wide MXU (3 input channels).  Factor-2 space-to-depth turns it
@@ -280,30 +401,9 @@ def plan_conv_bn_fusion(topo, entries=()):
 #   out(x,y) = sum W[a,b] X[2x+a-3, 2y+b-3]
 #            = sum_{u,v,ph,pw} W[2u+ph+3, 2v+pw+3] X2[x+u, y+v, (ph,pw,:)]
 # i.e. a 4x4 conv (u,v in -2..1) with asymmetric padding (2,1).
-_STEM = None
-
-
-class stem_s2d:
-    """Context manager enabling the stem rewrite during a trace."""
-
-    def __init__(self, enable):
-        self.enable = enable
-
-    def __enter__(self):
-        global _STEM
-        self._prev = _STEM
-        _STEM = self.enable
-        return self
-
-    def __exit__(self, *exc):
-        global _STEM
-        _STEM = self._prev
-
-
-def stem_s2d_enabled():
-    if _STEM is not None:
-        return bool(_STEM)
-    return os.environ.get("MXNET_STEM_S2D", "0") == "1"
+stem_s2d, stem_s2d_enabled = _trace_flag(
+    "MXNET_STEM_S2D",
+    "Context manager enabling the stem rewrite during a trace.")
 
 
 # ------------------------------------------- input-BN conv dX elision

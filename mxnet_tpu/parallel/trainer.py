@@ -140,7 +140,8 @@ class ShardedTrainer:
                  momentum=0.9, weight_decay=0.0, initializer=None,
                  dtype="float32", tp_rules=None, seed=0, layout=None,
                  auto_layouts=False, fuse_conv_bn=None,
-                 stem_space_to_depth=None, elide_input_bn_grad=True):
+                 stem_space_to_depth=None, elide_input_bn_grad=True,
+                 strided_bwd_phase=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -191,6 +192,17 @@ class ShardedTrainer:
         # an input-BN beta grad (ops/fused.py).  Always sound here: the
         # trainer's vjp differentiates params only, never batch inputs.
         self._elide_input_grads = bool(elide_input_bn_grad)
+        # strided_bwd_phase: phase-decomposed backward-data for stride-2
+        # convs (ops/fused.py) — exact, NHWC only.  None -> the
+        # MXNET_PHASE_BWD env default (off: measured 6% SLOWER end-to-end
+        # on ResNet-50/v5e — XLA:TPU's dilated backward already skips the
+        # inserted zeros; the 4 small sub-convs + interleave cost more
+        # than they save, docs/perf.md)
+        if strided_bwd_phase is None:
+            from ..ops import fused as _fused_mod
+            strided_bwd_phase = _fused_mod.phase_bwd_enabled()
+        self._phase_bwd = bool(strided_bwd_phase) and \
+            self._layout == "NHWC"
 
         self._topo = symbol._topo()
         if self._layout == "NHWC":
@@ -409,11 +421,12 @@ class ShardedTrainer:
                 # compute-precision copies of the f32 masters; the astype
                 # vjp returns f32 grads automatically
                 from ..ops.fused import (conv_bn_fusion, stem_s2d,
-                                         elide_input_grads)
+                                         elide_input_grads, phase_bwd)
                 p = {k: v.astype(compute_dtype) for k, v in p32.items()}
                 with image_layout(layout), \
                         conv_bn_fusion(self._fuse_conv_bn), \
                         stem_s2d(self._stem_s2d), \
+                        phase_bwd(self._phase_bwd), \
                         elide_input_grads(
                             self._input_names
                             if self._elide_input_grads else ()):
