@@ -25,9 +25,14 @@ def largest_tp_factor(n, cap=8):
     return tp
 
 
-def build_mesh(n_devices=None, tp=1, axis_names=("data", "model"),
+def build_mesh(n_devices=None, tp=1, pp=1, axis_names=None,
                devices=None):
-    """Build a (data, model) Mesh over the first n_devices jax devices."""
+    """Build a Mesh over the first n_devices jax devices.
+
+    tp > 1 -> ('data', 'model') axes (tensor parallel inner);
+    pp > 1 -> ('data', 'pipe') axes (pipeline stages inner; tp must be
+    1 — packed pipeline stage params cannot also be tensor-sharded).
+    """
     import jax
     from jax.sharding import Mesh
     if devices is None:
@@ -35,6 +40,12 @@ def build_mesh(n_devices=None, tp=1, axis_names=("data", "model"),
         if n_devices is not None:
             devices = devices[:n_devices]
     n = len(devices)
+    if pp > 1:
+        assert tp == 1, "tp and pp cannot both exceed 1 in build_mesh"
+        assert n % pp == 0, "n_devices %d not divisible by pp %d" % (n, pp)
+        arr = np.array(devices).reshape(n // pp, pp)
+        return Mesh(arr, axis_names=axis_names or ("data", "pipe"))
+    axis_names = axis_names or ("data", "model")
     assert n % tp == 0, "n_devices %d not divisible by tp %d" % (n, tp)
     if len(axis_names) == 1:
         assert tp == 1, "single-axis mesh cannot have tp > 1"
@@ -42,6 +53,23 @@ def build_mesh(n_devices=None, tp=1, axis_names=("data", "model"),
     else:
         arr = np.array(devices).reshape(n // tp, tp)
     return Mesh(arr, axis_names=axis_names)
+
+
+def shard_map_nocheck(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across jax versions
+    (check_rep in <=0.7 / check_vma in >=0.8)."""
+    import jax
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as esm
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def data_parallel_spec(mesh):

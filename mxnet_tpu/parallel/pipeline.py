@@ -124,3 +124,193 @@ def pipeline_grad(loss_fn, stage_fn, params_stack, x, labels, mesh,
         return loss_fn(y, labels)
 
     return jax.value_and_grad(full)(params_stack)
+
+
+# ===================================================================
+# Heterogeneous stages: arbitrary per-stage functions/params/shapes.
+#
+# The uniform path above stacks identical stage params; real models
+# (ResNet stages, embed->blocks->head transformers) have per-stage
+# pytrees of different shapes and different boundary activations.  The
+# SPMD-compatible encoding:
+#
+# * each stage's (compute-dtype) params are flattened and concatenated
+#   into one vector, padded to the max stage length, stacked (N, L) and
+#   sharded over ``pipe`` — every device holds ONLY its stage's packed
+#   params (no replication);
+# * boundary activations are flattened per sample and padded to the max
+#   boundary width W, so the ring carries one (B_u, W) buffer;
+# * the per-device stage body is ``lax.switch(stage_id, branches)`` —
+#   each branch statically unpacks ITS stage's params/input shape, runs
+#   the stage, and re-packs.  Only the resident branch executes on each
+#   device, so compute and memory stay per-stage.
+#
+# The GPipe schedule (fill, steady state, drain over M + N - 1 ticks)
+# and its reverse-mode transpose are the same as the uniform path.
+# ===================================================================
+
+def plan_pipeline_stages(topo, entries, batch_names, n_stages,
+                         cost_of=None, legal_cut=None):
+    """Partition a Symbol graph into ``n_stages`` contiguous segments.
+
+    Cuts are only legal where exactly ONE tensor crosses the boundary
+    (single-live-tensor positions — between residual blocks, transformer
+    layers, stacked stages); ``legal_cut((node, out_idx)) -> bool`` can
+    veto candidates further (the trainer rejects boundaries whose
+    leading dim is not the microbatch row count).  Segments are balanced
+    by ``cost_of`` (node -> float; default: 1 per node — callers with
+    shape information pass a params+activations proxy).
+
+    Returns a list of per-stage dicts:
+      nodes         — the segment's non-variable nodes, topo order
+      boundary_in   — (node, out_idx) produced by the previous segment
+                      (None for stage 0)
+      param_names   — names of weight variables consumed by the segment
+      batch_names   — batch variables consumed by the segment (stage 0
+                      gets the data; later stages e.g. the loss labels)
+    Raises MXNetError when the graph has no n_stages-1 legal cuts or
+    when a segment node carries auxiliary state (BatchNorm moving stats
+    — GPipe microbatching would change their semantics).
+    """
+    from ..base import MXNetError
+
+    nodes = [n for n in topo if not n.is_variable]
+    if len(nodes) < n_stages:
+        raise MXNetError("graph has %d op nodes < %d pipeline stages"
+                         % (len(nodes), n_stages))
+    pos = {id(n): i for i, n in enumerate(nodes)}
+    end = len(nodes)
+
+    # last consumer position of every (producer, out_idx)
+    last_use = {}
+    for i, n in enumerate(nodes):
+        for (src, idx) in n.inputs:
+            if not src.is_variable:
+                last_use[(id(src), idx)] = i
+    for (n, idx) in entries:
+        last_use[(id(n), idx)] = end
+
+    # legal cut positions: after node i, exactly one value crosses
+    id2node = {id(n): n for n in nodes}
+    crossings = {}
+    for i in range(len(nodes) - 1):
+        live = [(pid, idx) for (pid, idx), lu in last_use.items()
+                if pos[pid] <= i < lu]
+        if len(live) == 1:
+            pid, idx = live[0]
+            if legal_cut is None or legal_cut((id2node[pid], idx)):
+                crossings[i] = live[0]
+
+    if cost_of is None:
+        def cost_of(node):
+            return 1.0
+    prefix = []
+    acc = 0.0
+    for n in nodes:
+        acc += float(cost_of(n))
+        prefix.append(acc)
+    total = acc
+
+    cuts = []
+    prev = -1
+    cands = sorted(crossings)
+    for s in range(1, n_stages):
+        target = total * s / n_stages
+        best = None
+        for c in cands:
+            if c <= prev or (cuts and c <= cuts[-1]):
+                continue
+            # keep enough remaining cut positions for later stages
+            remaining = sum(1 for cc in cands if cc > c)
+            if remaining < n_stages - 1 - s:
+                continue
+            if best is None or abs(prefix[c] - target) < \
+                    abs(prefix[best] - target):
+                best = c
+        if best is None:
+            raise MXNetError(
+                "cannot cut the graph into %d pipeline stages: only %d "
+                "single-live-tensor positions available" %
+                (n_stages, len(cands)))
+        cuts.append(best)
+        prev = best
+
+    stages = []
+    bounds = [-1] + cuts + [len(nodes) - 1]
+    for s in range(n_stages):
+        seg = nodes[bounds[s] + 1: bounds[s + 1] + 1]
+        pnames, bnames = [], []
+        for n in seg:
+            if len(n.inputs) > n.num_args:
+                raise MXNetError(
+                    "pipeline stage %d contains %r which carries "
+                    "auxiliary state; GPipe microbatching would change "
+                    "its semantics (BatchNorm moving stats are per-"
+                    "microbatch) — use LayerNorm-style models or fewer "
+                    "stages" % (s, n.name))
+            stoch = n.op.stochastic
+            if callable(stoch):
+                stoch = stoch(n.attrs)
+            if stoch:
+                raise MXNetError(
+                    "pipeline stage %d contains stochastic op %r; the "
+                    "pipelined trace does not thread PRNG keys — set "
+                    "dropout to 0 for pipeline training" % (s, n.name))
+            for (src, _i) in n.inputs:
+                if src.is_variable:
+                    if src.name in batch_names:
+                        if src.name not in bnames:
+                            bnames.append(src.name)
+                    elif src.name not in pnames:
+                        pnames.append(src.name)
+        boundary_in = None
+        if s > 0:
+            pid, idx = crossings[cuts[s - 1]]
+            boundary_in = (id2node[pid], idx)
+        stages.append({"nodes": seg, "boundary_in": boundary_in,
+                       "param_names": pnames, "batch_names": bnames})
+    return stages
+
+
+def hetero_pipeline_loss(branches, x_stack, params_stack, microbatches,
+                         axis_name="pipe", remat=True):
+    """GPipe schedule over heterogeneous stage branches (per-device body
+    — call under shard_map).
+
+    branches: list of N fns ``(packed_params_row, x_flat, mb) ->
+    (y_flat, loss)`` — branch s unpacks its own stage statically; all
+    return the common padded buffer width and a scalar loss (nonzero
+    only from the last stage).  x_stack: (M, B_u, W) microbatched input
+    (consumed by stage 0).  params_stack: (1, L) this device's packed
+    stage params.  Returns summed loss over microbatches (nonzero on
+    the last stage; psum over ``axis_name`` to broadcast).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    sid = lax.axis_index(axis_name)
+    m = x_stack.shape[0]
+    row = params_stack[0]
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    def run_stage(x_t, mb):
+        fns = [jax.checkpoint(f) if remat else f for f in branches]
+        return lax.switch(sid, fns, row, x_t, mb)
+
+    def tick(carry, t):
+        inbuf, loss_acc = carry
+        mb = t - sid
+        active = (mb >= 0) & (mb < m)
+        x_t = jnp.where(sid == 0, x_stack[jnp.clip(t, 0, m - 1)], inbuf)
+        y, loss_c = run_stage(x_t, jnp.clip(mb, 0, m - 1))
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        loss_acc = loss_acc + jnp.where(active, loss_c, 0.0)
+        nxt = lax.ppermute(y, axis_name, shift)
+        return (nxt, loss_acc), None
+
+    inbuf0 = jnp.zeros_like(x_stack[0])
+    (_, loss), _ = lax.scan(tick, (inbuf0, jnp.float32(0.0)),
+                            jnp.arange(m + n - 1))
+    return loss

@@ -141,7 +141,8 @@ class ShardedTrainer:
                  dtype="float32", tp_rules=None, seed=0, layout=None,
                  auto_layouts=False, fuse_conv_bn=None,
                  stem_space_to_depth=None, elide_input_bn_grad=True,
-                 strided_bwd_phase=None):
+                 strided_bwd_phase=None, pipeline_stages=1,
+                 pipeline_microbatches=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -203,6 +204,27 @@ class ShardedTrainer:
             strided_bwd_phase = _fused_mod.phase_bwd_enabled()
         self._phase_bwd = bool(strided_bwd_phase) and \
             self._layout == "NHWC"
+        # pipeline_stages > 1: GPipe over the mesh's 'pipe' axis — the
+        # graph is cut into stages at single-live-tensor positions and
+        # the step streams microbatches stage-to-stage over ICI
+        # (parallel/pipeline.py heterogeneous schedule)
+        self._pp = int(pipeline_stages)
+        if self._pp > 1:
+            if mesh.shape.get("pipe", 1) != self._pp:
+                raise MXNetError(
+                    "pipeline_stages=%d needs a mesh with a 'pipe' axis "
+                    "of that size (build_mesh(pp=%d)); mesh has %r"
+                    % (self._pp, self._pp, dict(mesh.shape)))
+            if mesh.shape.get("model", 1) != 1:
+                raise MXNetError("pipeline_stages cannot combine with "
+                                 "tensor parallelism (packed stage "
+                                 "params cannot also be tensor-sharded)")
+        self._pp_microbatches = int(pipeline_microbatches or
+                                    (2 * self._pp if self._pp > 1 else 1))
+        if self._pp > 1:
+            # the pipelined step manages its own sharding; AUTO-layout
+            # AOT compilation is not composed with it
+            self._auto_layouts = False
 
         self._topo = symbol._topo()
         if self._layout == "NHWC":
@@ -402,9 +424,317 @@ class ShardedTrainer:
         wd_mult = opt.wd_mult.get(name, 1.0)
         return lr_mult, wd_mult * opt.wd
 
+    def _abstract_node_shapes(self, micro_bsz):
+        """{(id(node), out_idx): shape} for every op-node output, traced
+        abstractly at microbatch size (no FLOPs; jax.eval_shape)."""
+        import jax
+        import jax.numpy as jnp
+        from ..symbol import eval_graph
+
+        shapes = {}
+        name2ni = {}
+        for node in self._topo:
+            if node.is_variable or node.op is None:
+                continue
+            for i, on in enumerate(node.output_names()):
+                name2ni[on] = (id(node), i)
+
+        def mon(name, val):
+            k = name2ni.get(name)
+            if k is not None:
+                shapes[k] = tuple(val.shape)
+
+        def absfwd():
+            vv = {}
+            for node in self._arg_nodes:
+                nm = node.name
+                if nm in self._input_names:
+                    shp = (micro_bsz,) + tuple(self._input_shapes[nm][1:])
+                    dt = jnp.float32 if "label" in nm \
+                        else jnp.dtype(self.dtype)
+                else:
+                    shp = self._arg_shapes[nm]
+                    dt = jnp.dtype(self.dtype)
+                vv[id(node)] = jnp.zeros(shp, dt)
+            for node in self._aux_nodes:
+                vv[id(node)] = jnp.zeros(self._aux_shapes[node.name],
+                                         jnp.float32)
+            with image_layout(self._layout):
+                eval_graph(self._topo, self.symbol._entries, vv,
+                           is_train=False, key=None, monitor=mon,
+                           batch_size=micro_bsz)
+            return 0
+
+        jax.eval_shape(absfwd)
+        return shapes
+
+    def _build_pipeline_step(self):
+        """GPipe step: the graph cut into ``pipeline_stages`` segments,
+        each stage's packed params resident on its 'pipe'-axis device,
+        microbatches streamed stage-to-stage over ICI (ppermute), all
+        inside ONE jit.  See parallel/pipeline.py for the schedule and
+        the packing encoding.  Composes with data parallelism over the
+        mesh's 'data' axis (shard_map transposition inserts the grad
+        psum).  Successor of the reference's per-device layer placement
+        (example/model-parallel-lstm/lstm.py:142-205)."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .mesh import shard_map_nocheck
+        from ..symbol import eval_graph
+        from .pipeline import plan_pipeline_stages, hetero_pipeline_loss
+
+        n_pp, m_micro = self._pp, self._pp_microbatches
+        mesh = self.mesh
+        dp = mesh.shape.get("data", 1)
+        topo, entries = self._topo, self.symbol._entries
+
+        if len(entries) != 1 or entries[0][0].op is None \
+                or entries[0][0].op.name != "SoftmaxOutput":
+            raise MXNetError(
+                "the pipeline-parallel trainer currently supports a "
+                "single SoftmaxOutput loss head (its custom vjp is "
+                "cotangent-independent, so pipelined gradients are "
+                "reference-exact); got %r"
+                % [e[0].op.name if e[0].op else "var" for e in entries])
+        hattrs = entries[0][0].attrs
+        if float(hattrs.get("grad_scale", 1.0)) != 1.0 or \
+                hattrs.get("normalization", "null") != "null" or \
+                hattrs.get("use_ignore") or hattrs.get("multi_output"):
+            raise MXNetError("pipeline path supports SoftmaxOutput with "
+                             "default grad_scale/normalization/"
+                             "multi_output only")
+        head_label_var = entries[0][0].inputs[1][0]
+        if not head_label_var.is_variable:
+            raise MXNetError("pipeline path needs the loss label to be "
+                             "a batch variable (got a computed input)")
+        label_name = head_label_var.name
+        if len(self._data_names) != 1:
+            raise MXNetError("pipeline path supports one data input")
+        dname = self._data_names[0]
+        gbatch = self._input_shapes[dname][0]
+        if gbatch % (dp * m_micro):
+            raise MXNetError(
+                "global batch %d not divisible by data-parallel size %d "
+                "x %d microbatches" % (gbatch, dp, m_micro))
+        bu = gbatch // (dp * m_micro)
+
+        shapes = self._abstract_node_shapes(bu)
+
+        def nelem(shp):
+            n = 1
+            for d in shp:
+                n *= int(d)
+            return n
+
+        def cost_of(node):
+            c = float(nelem(shapes.get((id(node), 0), (1,))))
+            for (src, _i) in node.inputs:
+                if src.is_variable and src.name in self._arg_shapes \
+                        and src.name not in self._input_names:
+                    c += float(nelem(self._arg_shapes[src.name]))
+            return c
+
+        def legal_cut(bound):
+            # the ring buffer is (microbatch_rows, W): a boundary whose
+            # leading dim is not the microbatch row count (e.g. after a
+            # batch-folding Reshape) cannot ride it
+            shp = shapes.get((id(bound[0]), bound[1]))
+            return shp is not None and len(shp) >= 1 and shp[0] == bu
+
+        stages = plan_pipeline_stages(topo, entries,
+                                      set(self._input_names), n_pp,
+                                      cost_of=cost_of,
+                                      legal_cut=legal_cut)
+
+        # boundary widths -> the common ring buffer width W
+        widths = [nelem(self._input_shapes[dname][1:])]
+        for s in stages[1:]:
+            bnode, bidx = s["boundary_in"]
+            widths.append(nelem(shapes[(id(bnode), bidx)][1:]))
+        buf_w = max(widths)
+
+        # packed per-stage parameter layouts
+        layouts, lens = [], []
+        for s in stages:
+            off, lay = 0, []
+            for nm in s["param_names"]:
+                shp = self._arg_shapes[nm]
+                lay.append((nm, tuple(shp), off, nelem(shp)))
+                off += nelem(shp)
+            layouts.append(lay)
+            lens.append(off)
+        pack_l = max(lens + [1])
+
+        side_names = []
+        for si, s in enumerate(stages):
+            for nm in s["batch_names"]:
+                if si == 0 and nm == dname:
+                    continue
+                if nm not in side_names:
+                    side_names.append(nm)
+
+        compute_dtype = jnp.dtype(self.dtype)
+        layout = self._layout
+        name2arg = {n.name: n for n in self._arg_nodes}
+
+        head_node = entries[0][0]
+
+        def make_branch(si):
+            meta = stages[si]
+            lay = layouts[si]
+            is_last = si == n_pp - 1
+            if si == 0:
+                in_feat = tuple(self._input_shapes[dname][1:])
+            else:
+                bnode, bidx = meta["boundary_in"]
+                in_feat = tuple(shapes[(id(bnode), bidx)][1:])
+            insize = nelem(in_feat)
+            # Last stage stops BEFORE the SoftmaxOutput head and computes
+            # softmax + summed CE manually: the gradient is identically
+            # (p - onehot) (the head's reference convention at
+            # grad_scale=1/normalization null), but it flows through
+            # standard autodiff — the head's cotangent-IGNORING
+            # custom_vjp would inject gradients from the schedule's
+            # inactive fill/drain ticks that the active-mask cannot zero.
+            seg_nodes = meta["nodes"] if not is_last else \
+                [n for n in meta["nodes"] if n is not head_node]
+            seg_entries = [head_node.inputs[0]] if is_last \
+                else [stages[si + 1]["boundary_in"]]
+            # eval_graph binds variables by iterating them in topo order
+            seg_vars, seen = [], set()
+            for n in seg_nodes:
+                for (src, _i) in n.inputs:
+                    if src.is_variable and id(src) not in seen:
+                        seen.add(id(src))
+                        seg_vars.append(src)
+            seg_topo = seg_vars + seg_nodes
+
+            def branch(row, x_flat, mb, side):
+                p = {nm: row[off:off + sz].reshape(shp)
+                     for (nm, shp, off, sz) in lay}
+                nb = x_flat.shape[0]
+                x = x_flat[:, :insize].reshape((nb,) + in_feat)
+                var_values = {id(name2arg[nm]): v for nm, v in p.items()}
+                seed = {}
+                if si == 0:
+                    var_values[id(name2arg[dname])] = x
+                else:
+                    bnode, bidx = meta["boundary_in"]
+                    seed[id(bnode)] = tuple(
+                        x if j == bidx else None
+                        for j in range(bnode.num_outputs()))
+                label = None
+                for nm in meta["batch_names"]:
+                    if si == 0 and nm == dname:
+                        continue
+                    sv = side[side_names.index(nm)]
+                    v = lax.dynamic_index_in_dim(sv, mb, 0,
+                                                 keepdims=False)
+                    var_values[id(name2arg[nm])] = v
+                    if nm == label_name:
+                        label = v
+                with image_layout(layout):
+                    heads, _aux = eval_graph(
+                        seg_topo, seg_entries, var_values,
+                        is_train=True, key=None, batch_size=nb,
+                        seed_vals=seed)
+                if is_last:
+                    logits = heads[0].astype(jnp.float32)
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    idx = label.astype(jnp.int32).reshape((-1, 1))
+                    psel = jnp.take_along_axis(logp, idx, axis=1,
+                                               mode="clip")[:, 0]
+                    loss = -jnp.sum(psel)
+                    y_flat = jnp.zeros((nb, buf_w), compute_dtype)
+                else:
+                    y = heads[0]
+                    y2 = y.reshape(nb, -1).astype(compute_dtype)
+                    y_flat = jnp.pad(y2,
+                                     ((0, 0), (0, buf_w - y2.shape[1])))
+                    loss = jnp.float32(0.0)
+                return y_flat, loss
+
+            return branch
+
+        branches = [make_branch(si) for si in range(n_pp)]
+        rescale = self._rescale
+        rule = self._update_rule
+        hyper = {k: self._per_param_hyper(k) for k in self._param_names}
+        # metric divisor: the summed CE covers every head row (per-token
+        # labels have gbatch*k rows); match the plain path's mean
+        label_rows = self._input_shapes.get(label_name, (gbatch,))[0]
+
+        x_side_specs = tuple(
+            P(*([None, "data"] +
+                [None] * (len(self._input_shapes[nm]) - 1)))
+            for nm in side_names)
+
+        def step(params, opt_state, aux, batch, key, lr, t):
+            def loss_fn(p32):
+                p = {k: v.astype(compute_dtype) for k, v in p32.items()}
+                rows = []
+                for si in range(n_pp):
+                    parts = [p[nm].reshape(-1)
+                             for (nm, _s, _o, _z) in layouts[si]]
+                    row = jnp.concatenate(parts) if parts else \
+                        jnp.zeros((0,), compute_dtype)
+                    rows.append(jnp.pad(row, (0, pack_l - row.shape[0])))
+                stacked = lax.with_sharding_constraint(
+                    jnp.stack(rows), NamedSharding(mesh, P("pipe", None)))
+                x = batch[dname].astype(compute_dtype)
+                xs = x.reshape((m_micro, gbatch // m_micro, -1))
+                xs = jnp.pad(xs, ((0, 0), (0, 0),
+                                  (0, buf_w - xs.shape[2])))
+                # side arrays microbatch on dim 0; a leading dim of
+                # gbatch*k (e.g. per-token labels (batch*seq,)) splits
+                # row-major into (M, local*k) consistently with the data
+                side = tuple(
+                    batch[nm].reshape((m_micro, -1)
+                                      + tuple(batch[nm].shape[1:]))
+                    for nm in side_names)
+
+                def smbody(ps, xs_, sd):
+                    br = [(lambda f: (lambda row, xx, mb:
+                                      f(row, xx, mb, sd)))(f)
+                          for f in branches]
+                    local = hetero_pipeline_loss(br, xs_, ps, m_micro)
+                    return lax.psum(lax.psum(local, "pipe"), "data")
+
+                return shard_map_nocheck(
+                    smbody, mesh,
+                    (P("pipe", None), P(None, "data", None),
+                     x_side_specs), P())(stacked, xs, side)
+
+            loss_sum, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_state = {}, {}
+            for k, w in params.items():
+                lr_mult, wd_eff = hyper[k]
+                g = grads[k].astype(jnp.float32) * rescale
+                new_params[k], new_state[k] = rule(
+                    w, g, opt_state[k], lr * lr_mult, wd_eff, t)
+            new_aux = {n.name: aux[n.name] for n in self._aux_nodes}
+            return new_params, new_state, new_aux, loss_sum / label_rows
+
+        self._py_step = step
+        state_sharding = {n: [self._param_sharding[n]] * self._n_slots
+                          for n in self._param_names}
+        in_shardings = (self._param_sharding, state_sharding,
+                        self._aux_sharding, self._batch_sharding,
+                        None, None, None)
+        out_shardings = (self._param_sharding, state_sharding,
+                         self._aux_sharding, None)
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=(0, 1, 2))
+
     def _build_step(self):
         import jax
         import jax.numpy as jnp
+        if self._pp > 1:
+            return self._build_pipeline_step()
 
         topo, entries = self._topo, self.symbol._entries
         head_is_loss = [bool(n.op is not None and n.op.is_loss)

@@ -103,7 +103,8 @@ def _classify_vars(topo):
 
 
 def eval_graph(topo, entries, var_values, is_train=False, key=None,
-               monitor=None, batch_size=None, device_map=None):
+               monitor=None, batch_size=None, device_map=None,
+               seed_vals=None):
     """Execute the DAG as a pure function.
 
     ``var_values``: dict id(var-node) -> array.  Returns (head values,
@@ -121,7 +122,9 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
     per-device segments).
     """
     import jax
-    vals = {}
+    # seed_vals: id(node) -> output tuple for nodes evaluated OUTSIDE this
+    # call (the pipeline-parallel path seeds each stage's boundary input)
+    vals = {} if seed_vals is None else dict(seed_vals)
     aux_updates = {}
     device_map = device_map or {}
 
