@@ -305,14 +305,12 @@ class ShardedTrainer:
 
         tp_size = mesh.shape.get("model", 1)
         if tp_rules is None:
-            tp_rules = {}
-            for name in self._param_names:
-                shp = self._arg_shapes[name]
-                # output-parallel sharding for large FC weights
-                if (name.endswith("_weight") and len(shp) == 2 and
-                        shp[0] % tp_size == 0 and shp[0] >= tp_size and
-                        tp_size > 1):
-                    tp_rules[name] = 0
+            # graph-derived Megatron-style defaults: column/row-parallel
+            # FC pairing (QKV/out-proj, ff1/ff2) + conv output-channel
+            # sharding (parallel/tp_rules.py); {} when tp_size == 1
+            from .tp_rules import derive_tp_rules
+            tp_rules = derive_tp_rules(self._topo, self._arg_shapes,
+                                       tp_size)
         self.tp_rules = tp_rules
 
         def param_spec(name):
