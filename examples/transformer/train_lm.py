@@ -25,8 +25,13 @@ import mxnet_tpu as mx
 
 
 def transformer_block(x, d_model, n_heads, prefix,
-                      ffn_mult=4, dropout=0.1):
-    """Pre-norm block: x + Attn(LN(x)); x + FFN(LN(x))."""
+                      ffn_mult=4, dropout=0.1, attention="flash"):
+    """Pre-norm block: x + Attn(LN(x)); x + FFN(LN(x)).
+
+    attention="ring" swaps in ``_contrib_RingAttention`` — identical
+    math single-chip, and under ShardedTrainer(sequence_parallel=True)
+    the sequence dim shards over the mesh and K/V ride the ICI ring.
+    """
     h = mx.sym.LayerNorm(x, name=prefix + "_ln1")
     qkv = mx.sym.FullyConnected(h, num_hidden=3 * d_model, flatten=False,
                                 name=prefix + "_qkv")
@@ -38,8 +43,9 @@ def transformer_block(x, d_model, n_heads, prefix,
                        shape=(0, 0, -3, -2))
     v = mx.sym.Reshape(mx.sym.slice_axis(qkv, axis=2, begin=2, end=3),
                        shape=(0, 0, -3, -2))
-    att = mx.sym._contrib_FlashAttention(q, k, v, causal=True,
-                                         name=prefix + "_attn")
+    attn_op = (mx.sym._contrib_RingAttention if attention == "ring"
+               else mx.sym._contrib_FlashAttention)
+    att = attn_op(q, k, v, causal=True, name=prefix + "_attn")
     att = mx.sym.Reshape(att, shape=(0, 0, -3))
     att = mx.sym.FullyConnected(att, num_hidden=d_model, flatten=False,
                                 name=prefix + "_proj")
@@ -59,7 +65,7 @@ def transformer_block(x, d_model, n_heads, prefix,
 
 
 def gpt_symbol(vocab_size, seq_len, d_model=128, n_heads=4, n_layers=2,
-               dropout=0.1):
+               dropout=0.1, attention="flash"):
     data = mx.sym.Variable("data")              # (batch, seq)
     label = mx.sym.Variable("softmax_label")
     tok = mx.sym.Embedding(data, input_dim=vocab_size,
@@ -71,7 +77,7 @@ def gpt_symbol(vocab_size, seq_len, d_model=128, n_heads=4, n_layers=2,
     x = mx.sym.broadcast_add(tok, mx.sym.expand_dims(pos, axis=0))
     for i in range(n_layers):
         x = transformer_block(x, d_model, n_heads, "block%d" % i,
-                              dropout=dropout)
+                              dropout=dropout, attention=attention)
     x = mx.sym.LayerNorm(x, name="ln_f")
     x = mx.sym.Reshape(x, shape=(-1, d_model))
     logits = mx.sym.FullyConnected(x, num_hidden=vocab_size,
@@ -116,9 +122,56 @@ def train(epochs=5, batch_size=16, seq_len=64, vocab_size=64,
     return ppl, float(np.exp(h))
 
 
+def train_sequence_parallel(sp=2, steps=120, batch_size=8, seq_len=64,
+                            vocab_size=64, d_model=64, n_heads=4,
+                            n_layers=2):
+    """Sequence-parallel training: the sequence dim sharded ``sp`` ways
+    over the mesh 'model' axis, attention via ``_contrib_RingAttention``
+    (K/V blocks rotate over the ICI ring; per-device attention memory is
+    O(seq/sp)).  Data parallelism rides the 'data' axis at the same
+    time when the mesh has more devices than ``sp``.
+
+    Returns (first_loss, last_loss) of the fused training run.
+    """
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    net = gpt_symbol(vocab_size, seq_len, d_model, n_heads, n_layers,
+                     dropout=0.0, attention="ring")
+    mesh = build_mesh(tp=sp)  # 'model' axis carries the sequence shards
+    trainer = ShardedTrainer(
+        net, mesh,
+        data_shapes={"data": (batch_size, seq_len)},
+        label_shapes={"softmax_label": (batch_size, seq_len)},
+        optimizer="adam", learning_rate=3e-3,
+        sequence_parallel=True)
+
+    it, _trans = markov_batches(steps * batch_size * seq_len + seq_len,
+                                vocab_size, seq_len, batch_size)
+    losses = []
+    for epoch in range(2):
+        it.reset()
+        for b in it:
+            losses.append(float(trainer.step(
+                {"data": b.data[0].asnumpy(),
+                 "softmax_label": b.label[0].asnumpy()})))
+            if len(losses) >= steps:
+                break
+        if len(losses) >= steps:
+            break
+    logging.info("sequence-parallel (sp=%d): loss %.3f -> %.3f over %d "
+                 "steps", sp, losses[0], losses[-1], len(losses))
+    return losses[0], losses[-1]
+
+
 if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO)
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--ring", type=int, default=0,
+                   help="train sequence-parallel with this many "
+                        "sequence shards (needs >= that many devices)")
     a = p.parse_args()
-    train(epochs=a.epochs)
+    if a.ring > 1:
+        train_sequence_parallel(sp=a.ring)
+    else:
+        train(epochs=a.epochs)

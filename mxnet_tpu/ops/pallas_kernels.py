@@ -137,3 +137,32 @@ def flash_attention_op(attrs, ctx, q, k, v):
     # ragged tails (seq not a multiple of the Q block) and cross-attention
     # (tk != tq) take the jnp path rather than failing; XLA still fuses it
     return _attention_jnp(q, k, v, causal)
+
+
+@register("_contrib_RingAttention", arg_names=("q", "k", "v"),
+          params={"causal": False})
+def ring_attention_op(attrs, ctx, q, k, v):
+    """Sequence-parallel attention over (batch, seq, heads, head_dim).
+
+    Under an active ``parallel.sequence.sequence_parallel(mesh, axis)``
+    context (ShardedTrainer(sequence_parallel=True) sets one), the seq
+    dim is sharded over the mesh axis and K/V blocks rotate around the
+    ICI ring with an online-softmax merge (parallel/sequence.py) — per-
+    device attention memory is O(T/n).  Without a context the op IS
+    plain attention (flash kernel on TPU, jnp elsewhere), so the same
+    Symbol trains single-chip and sequence-parallel unchanged.
+
+    New TPU-native capability: the reference's long-sequence story is
+    bucketing (SURVEY §5.7); ring attention is this framework's
+    first-class long-context translation.
+    """
+    from ..parallel import sequence as _seq
+    sp = _seq.active_context()
+    if sp is not None:
+        mesh, axis, batch_axis = sp
+        return _seq.ring_attention(q, k, v, mesh=mesh, seq_axis=axis,
+                                   causal=bool(attrs["causal"]),
+                                   batch_axis=batch_axis)
+    # no context: the op IS plain attention — same dispatch as the
+    # flash op (one shared implementation keeps the equivalence exact)
+    return flash_attention_op(attrs, ctx, q, k, v)

@@ -17,7 +17,38 @@ import math
 
 import numpy as np
 
-__all__ = ["ring_attention", "attention_reference"]
+__all__ = ["ring_attention", "attention_reference", "sequence_parallel",
+           "active_context"]
+
+# trace-time routing for the _contrib_RingAttention operator: when a
+# (mesh, axis) context is active, the op runs the sequence-parallel ring
+# schedule; otherwise it falls back to single-device attention — one
+# Symbol serves both deployments (ops/pallas_kernels.py ring_attention_op)
+_ACTIVE = None
+
+
+class sequence_parallel:
+    """Context manager activating sequence-parallel attention for ops
+    traced within; ``mesh=None`` deactivates (single-device fallback).
+    ``batch_axis`` names the mesh axis the batch dim is sharded over
+    (None = replicated), so dp x sp composition shards both dims."""
+
+    def __init__(self, mesh, axis="model", batch_axis="data"):
+        self.ctx = (mesh, axis, batch_axis) if mesh is not None else None
+
+    def __enter__(self):
+        global _ACTIVE
+        self._prev = _ACTIVE
+        _ACTIVE = self.ctx
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        _ACTIVE = self._prev
+
+
+def active_context():
+    return _ACTIVE
 
 
 def attention_reference(q, k, v, causal=False):
@@ -35,7 +66,7 @@ def attention_reference(q, k, v, causal=False):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _ring_attention_local(q, k, v, axis_name, causal):
+def _ring_attention_local(q, k, v, axis_name, causal, batch_axis=None):
     """Per-shard body under shard_map: rotate K/V around the ring."""
     import jax
     import jax.numpy as jnp
@@ -52,8 +83,9 @@ def _ring_attention_local(q, k, v, axis_name, causal):
     l = jnp.zeros((b, h, t_local), jnp.float32)       # softmax denominator
     m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)  # running max
     # mark accumulators device-varying for shard_map's scan typing
-    o, l, m = (lax.pcast(x, (axis_name,), to="varying")
-               for x in (o, l, m))
+    # (over the batch axis too when dp composes with the ring)
+    vary = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
+    o, l, m = (lax.pcast(x, vary, to="varying") for x in (o, l, m))
 
     q_pos = my_idx * t_local + jnp.arange(t_local)
 
@@ -86,21 +118,25 @@ def _ring_attention_local(q, k, v, axis_name, causal):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, seq_axis="data", causal=False):
+def ring_attention(q, k, v, mesh, seq_axis="data", causal=False,
+                   batch_axis=None):
     """Sequence-parallel attention.
 
     q/k/v: (batch, seq, heads, head_dim) with ``seq`` sharded over
-    ``seq_axis`` of ``mesh``.  Returns the attention output with the same
-    sharding.  K/V blocks ride the ICI ring; each of the n steps computes a
+    ``seq_axis`` of ``mesh`` (and optionally batch over ``batch_axis``
+    for dp x sp composition — otherwise a dp mesh would all-gather the
+    batch at the shard_map boundary and duplicate attention work per
+    data shard).  Returns the attention output with the same sharding.
+    K/V blocks ride the ICI ring; each of the n steps computes a
     (T/n × T/n) block and the online softmax merges it.
     """
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, None, None)
     body = functools.partial(_ring_attention_local, axis_name=seq_axis,
-                             causal=causal)
+                             causal=causal, batch_axis=batch_axis)
     f = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                   out_specs=spec)
     return f(q, k, v)

@@ -142,7 +142,7 @@ class ShardedTrainer:
                  auto_layouts=False, fuse_conv_bn=None,
                  stem_space_to_depth=None, elide_input_bn_grad=True,
                  strided_bwd_phase=None, pipeline_stages=1,
-                 pipeline_microbatches=None):
+                 pipeline_microbatches=None, sequence_parallel=False):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -225,6 +225,28 @@ class ShardedTrainer:
             # the pipelined step manages its own sharding; AUTO-layout
             # AOT compilation is not composed with it
             self._auto_layouts = False
+        # sequence_parallel: shard data inputs' dim 1 (the sequence) over
+        # the 'model' axis and activate the ring-attention context, so
+        # _contrib_RingAttention nodes run the ICI ring schedule
+        # (parallel/sequence.py).  Weights stay replicated over 'model'
+        # (tp_rules default {}): the axis carries sequence shards.
+        self._seq_parallel = bool(sequence_parallel)
+        if self._seq_parallel:
+            sp_size = mesh.shape.get("model", 1)
+            if sp_size <= 1:
+                raise MXNetError(
+                    "sequence_parallel=True needs a mesh 'model' axis of "
+                    "size > 1 to shard the sequence over (build_mesh(tp="
+                    "n) — the axis carries sequence shards here)")
+            if self._pp > 1:
+                raise MXNetError("sequence_parallel does not compose "
+                                 "with pipeline_stages yet")
+            for n, s in data_shapes.items():
+                if len(s) >= 2 and s[1] % sp_size:
+                    raise MXNetError(
+                        "sequence_parallel: input %r sequence dim %d is "
+                        "not divisible by the %d sequence shards"
+                        % (n, s[1], sp_size))
 
         self._topo = symbol._topo()
         if self._layout == "NHWC":
@@ -305,12 +327,16 @@ class ShardedTrainer:
 
         tp_size = mesh.shape.get("model", 1)
         if tp_rules is None:
-            # graph-derived Megatron-style defaults: column/row-parallel
-            # FC pairing (QKV/out-proj, ff1/ff2) + conv output-channel
-            # sharding (parallel/tp_rules.py); {} when tp_size == 1
-            from .tp_rules import derive_tp_rules
-            tp_rules = derive_tp_rules(self._topo, self._arg_shapes,
-                                       tp_size)
+            if self._seq_parallel:
+                # the model axis carries sequence shards; weights replicate
+                tp_rules = {}
+            else:
+                # graph-derived Megatron-style defaults: column/row-
+                # parallel FC pairing (QKV/out-proj, ff1/ff2) + conv
+                # output-channel sharding (parallel/tp_rules.py)
+                from .tp_rules import derive_tp_rules
+                tp_rules = derive_tp_rules(self._topo, self._arg_shapes,
+                                           tp_size)
         self.tp_rules = tp_rules
 
         def param_spec(name):
@@ -325,9 +351,15 @@ class ShardedTrainer:
         self._aux_sharding = {
             n: NamedSharding(mesh, P(*([None] * len(self._aux_shapes[n]))))
             for n in self._aux_names}
+        def batch_spec(n):
+            dims = ["data"] + [None] * (len(shapes[n]) - 1)
+            if self._seq_parallel and n in self._data_names \
+                    and len(dims) >= 2:
+                dims[1] = "model"       # the sequence dim
+            return P(*dims)
+
         self._batch_sharding = {
-            n: NamedSharding(
-                mesh, P(*(["data"] + [None] * (len(shapes[n]) - 1))))
+            n: NamedSharding(mesh, batch_spec(n))
             for n in self._input_names}
 
         with mesh:
@@ -750,11 +782,14 @@ class ShardedTrainer:
                 # vjp returns f32 grads automatically
                 from ..ops.fused import (conv_bn_fusion, stem_s2d,
                                          elide_input_grads, phase_bwd)
+                from .sequence import sequence_parallel as seq_ctx
                 p = {k: v.astype(compute_dtype) for k, v in p32.items()}
                 with image_layout(layout), \
                         conv_bn_fusion(self._fuse_conv_bn), \
                         stem_s2d(self._stem_s2d), \
                         phase_bwd(self._phase_bwd), \
+                        seq_ctx(self.mesh if self._seq_parallel
+                                else None), \
                         elide_input_grads(
                             self._input_names
                             if self._elide_input_grads else ()):
@@ -791,6 +826,11 @@ class ShardedTrainer:
                     label = batch[nm]
             if label is not None and head_is_loss[0]:
                 probs = heads[0]
+                if probs.ndim == 2 and label.ndim >= 2 and \
+                        label.size == probs.shape[0]:
+                    # per-token labels fed as (batch, seq): the head
+                    # flattened rows row-major, labels follow
+                    label = label.reshape((-1,))
                 if probs.ndim == 2 and label.ndim == 1:
                     idx = label.astype(jnp.int32).reshape((-1, 1))
                     # mode="clip": jit's default fill mode turns an
@@ -1066,6 +1106,7 @@ class ShardedTrainer:
             compute_dtype = jnp.dtype(self.dtype)
 
             def fwd(params, aux, batch):
+                from .sequence import sequence_parallel as seq_ctx
                 p = {k: v.astype(compute_dtype) for k, v in params.items()}
                 bsz = next(iter(batch.values())).shape[0]
                 # loss heads still take label inputs at inference; their
@@ -1075,7 +1116,9 @@ class ShardedTrainer:
                     if n not in full:
                         full[n] = jnp.zeros((bsz,) + tuple(s[1:]),
                                             jnp.float32)
-                with image_layout(layout):
+                with image_layout(layout), \
+                        seq_ctx(self.mesh if self._seq_parallel
+                                else None):
                     var_values = self._node_value_map(p, full, aux)
                     heads, _ = eval_graph(topo, entries, var_values,
                                           is_train=False, key=None,
