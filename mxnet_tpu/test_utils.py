@@ -37,35 +37,48 @@ def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
                                err_msg=f"{names[0]} != {names[1]}")
 
 
+def numeric_grad_one(f, inputs, i, eps=1e-3):
+    """Central finite differences of scalar f w.r.t. inputs[i].
+
+    Elements are perturbed through direct indexing (not a flattened
+    view): reshape(-1) of a non-contiguous array is a COPY, which would
+    silently leave f's input unperturbed and return zero gradients."""
+    x = inputs[i]
+    g = np.zeros_like(x, dtype=np.float64)
+    for idx in np.ndindex(*x.shape):
+        orig = x[idx]
+        x[idx] = orig + eps
+        fp = f(inputs)
+        x[idx] = orig - eps
+        fm = f(inputs)
+        x[idx] = orig
+        g[idx] = (fp - fm) / (2 * eps)
+    return g
+
+
 def numeric_grad(f, inputs, eps=1e-3):
     """Central finite differences of scalar-valued f over list of np arrays."""
-    grads = []
-    for i, x in enumerate(inputs):
-        g = np.zeros_like(x, dtype=np.float64)
-        flat = x.reshape(-1)
-        gflat = g.reshape(-1)
-        for j in range(flat.size):
-            orig = flat[j]
-            flat[j] = orig + eps
-            fp = f(inputs)
-            flat[j] = orig - eps
-            fm = f(inputs)
-            flat[j] = orig
-            gflat[j] = (fp - fm) / (2 * eps)
-        grads.append(g)
-    return grads
+    return [numeric_grad_one(f, inputs, i, eps)
+            for i in range(len(inputs))]
 
 
 def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
-                           atol=1e-3, eps=1e-3, sum_output=True):
+                           atol=1e-3, eps=1e-3, sum_output=True,
+                           wrt=None):
     """Backward (autograd tape over the op) vs finite differences.
 
     Reference: test_utils.check_numeric_gradient — the primary operator test
     pattern of tests/python/unittest/test_operator.py.
+
+    ``wrt``: indices of the inputs whose gradients are compared (default
+    all).  Index-like inputs (take/Embedding/gather indices) must be
+    excluded — perturbing 2.0 by eps flips the truncated integer index,
+    so their central difference is meaningless.
     """
     from . import ops
     attrs = attrs or {}
     inputs = [np.asarray(a, np.float64) for a in input_arrays]
+    wrt = list(range(len(inputs))) if wrt is None else list(wrt)
 
     def f(xs):
         arrs = [nd.array(x.astype("float32")) for x in xs]
@@ -75,7 +88,7 @@ def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
             out = out[0]
         return float(out.asnumpy().astype(np.float64).sum())
 
-    expected = numeric_grad(f, inputs, eps)
+    expected = {i: numeric_grad_one(f, inputs, i, eps) for i in wrt}
 
     arrs = [nd.array(x.astype("float32")) for x in inputs]
     grads = [nd.zeros_like(a) for a in arrs]
@@ -86,8 +99,9 @@ def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
             out = out[0]
         loss = out.sum()
     autograd.backward([loss])
-    for i, (g, e) in enumerate(zip(grads, expected)):
-        np.testing.assert_allclose(g.asnumpy(), e, rtol=rtol, atol=atol,
+    for i in wrt:
+        np.testing.assert_allclose(grads[i].asnumpy(), expected[i],
+                                   rtol=rtol, atol=atol,
                                    err_msg=f"gradient mismatch on input {i} "
                                            f"of {op_name}")
 
