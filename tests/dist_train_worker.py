@@ -1,0 +1,101 @@
+"""Worker for the multi-process distributed TRAINING convergence test.
+
+Reference: tests/nightly/dist_lenet.py — train a model to threshold
+under ``tools/launch.py --launcher local`` with kvstore dist_sync, every
+worker on its own shard of the data, then prove the replicas stayed
+identical.  Here the model is the reference test_mlp net on the
+class-separated synthetic digits corpus (real MNIST is not available
+offline); gradients ride the jitted pytree AllReduce of
+parallel/dist_kvstore.py.
+
+Replica identity is asserted distributively: every rank pushes its
+flattened parameters x and x^2; zero cross-rank variance
+(sum(x^2)/n - (sum(x)/n)^2 == 0) on every element proves all ranks
+hold the same weights without shipping them to a master.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=32)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+_PROTOS = np.random.RandomState(42).rand(10, 64).astype("f")
+
+
+def _digits(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = (_PROTOS[y] + rng.randn(n, 64).astype("f") * 0.25).astype("f")
+    return x, y.astype("f")
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+
+    # every worker sees its own contiguous shard (reference
+    # num_parts/part_index splitting)
+    xtr, ytr = _digits(1600, seed=0)
+    shard = slice(rank * (1600 // nworker), (rank + 1) * (1600 // nworker))
+    train = mx.io.NDArrayIter(xtr[shard], ytr[shard], batch_size=50,
+                              shuffle=True, label_name="softmax_label")
+    xva, yva = _digits(400, seed=1)
+    val = mx.io.NDArrayIter(xva, yva, batch_size=50,
+                            label_name="softmax_label")
+
+    np.random.seed(7)   # identical initialization on every rank
+    mx.random.seed(7)
+    mod = mx.module.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=3, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.initializer.Xavier(),
+            eval_data=val)
+
+    acc = mod.score(val, mx.metric.Accuracy())[0][1]
+    assert acc > 0.9, "rank %d accuracy %.3f" % (rank, acc)
+
+    # ---- identical-replica proof: zero cross-rank parameter variance
+    arg_params, _aux = mod.get_params()
+    vec = np.concatenate([arg_params[k].asnumpy().reshape(-1)
+                          for k in sorted(arg_params)]).astype("f")
+    key_s, key_sq = 501, 502
+    kv.init(key_s, mx.nd.zeros(vec.shape))
+    kv.init(key_sq, mx.nd.zeros(vec.shape))
+    # identity optimizer: pull returns the straight pushed sum
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=1.0))
+    kv.push(key_s, mx.nd.array(vec))
+    kv.push(key_sq, mx.nd.array(vec * vec))
+    s = mx.nd.zeros(vec.shape)
+    sq = mx.nd.zeros(vec.shape)
+    kv.pull(key_s, out=s)
+    kv.pull(key_sq, out=sq)
+    mean = s.asnumpy() / nworker
+    var = sq.asnumpy() / nworker - mean * mean
+    max_var = float(np.abs(var).max())
+    assert max_var < 1e-9, "rank %d replica divergence: var %g" \
+        % (rank, max_var)
+
+    kv.barrier()
+    print("dist-train worker %d/%d OK acc=%.3f var=%.2e"
+          % (rank, nworker, acc, max_var))
+
+
+if __name__ == "__main__":
+    main()
