@@ -142,7 +142,8 @@ class ShardedTrainer:
                  auto_layouts=False, fuse_conv_bn=None,
                  stem_space_to_depth=None, elide_input_bn_grad=True,
                  strided_bwd_phase=None, pipeline_stages=1,
-                 pipeline_microbatches=None, sequence_parallel=False):
+                 pipeline_microbatches=None, sequence_parallel=False,
+                 input_mean=None, input_std=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -169,6 +170,14 @@ class ShardedTrainer:
         self.symbol = symbol
         self.mesh = mesh
         self.dtype = dtype
+        self._stage_fns = {}      # lazy per-input device staging programs
+        # input_mean/input_std: per-channel (or scalar) normalization
+        # applied ON DEVICE to uint8 data inputs staged via put_batch —
+        # the raw_uint8 ingest path (native reader ships bytes, the chip
+        # does (x - mean)/std; the reference normalizes on the host,
+        # src/io/iter_normalize.h)
+        self._input_mean = input_mean
+        self._input_std = input_std
         # auto_layouts: let XLA choose persistent param/state layouts
         # (Layout.AUTO) instead of jit's default-pinned I/O layouts —
         # kills the per-step relayout copies (docs/perf.md)
@@ -1005,25 +1014,94 @@ class ShardedTrainer:
         self._hyper_snapshot = self._hyper_state()
 
     def _cast_batch(self, batch):
-        """Data inputs follow the compute dtype (bf16 training) and the
-        active layout; labels keep their own dtype."""
+        """Data inputs follow the compute dtype (bf16 training); labels
+        keep their own dtype.  No layout work happens on the host — the
+        NCHW->NHWC transpose runs on device in :meth:`put_batch` (a host
+        transpose of a full image batch costs hundreds of ms on small
+        hosts and doubles peak host memory)."""
         out = {}
         for k, v in batch.items():
             v = np.asarray(v)
-            if k in self._nhwc_inputs and v.ndim == 4:
-                v = np.ascontiguousarray(v.transpose(0, 2, 3, 1))
             if "label" not in k and v.dtype.kind == "f":
                 v = v.astype(self.dtype)
             out[k] = v
         return out
 
     def put_batch(self, batch):
-        """Stage a host batch onto the mesh (sharded device arrays).
-        Use with :meth:`step` to overlap host IO with compute, or to
-        reuse a batch without re-transfer."""
+        """Stage a host batch (reference NCHW convention) onto the mesh
+        as sharded device arrays in the trainer's active layout.  Use
+        with :meth:`step` to overlap host IO with compute, or to reuse a
+        batch without re-transfer.  Under layout='NHWC' the image
+        transpose happens ON DEVICE after the (layout-untouched) host
+        bytes land — XLA transposes in microseconds what numpy pays
+        hundreds of ms for."""
         import jax
-        return {k: jax.device_put(v, self._batch_sharding[k])
-                for k, v in self._cast_batch(batch).items()}
+        import numpy as _np
+        out = {}
+        normalize = (self._input_mean is not None
+                     or self._input_std is not None)
+        for k, v in self._cast_batch(batch).items():
+            # batch dim may differ (partial tail batches): compare the
+            # feature dims only to detect a host-NCHW image batch
+            needs_transpose = (k in self._nhwc_inputs and v.ndim == 4
+                               and tuple(v.shape[1:])
+                               != tuple(self._input_shapes[k][1:]))
+            # uint8 inputs are normalized on device ONLY when the
+            # trainer was configured for it; otherwise they reach the
+            # graph unchanged (integer data, in-graph normalization)
+            is_u8 = (v.dtype == _np.uint8 and k in self._data_names
+                     and normalize)
+            if needs_transpose or is_u8:
+                fn, sharding = self._get_stage_fn(k, needs_transpose,
+                                                  is_u8, v.ndim)
+                out[k] = fn(jax.device_put(v, sharding))
+            else:
+                out[k] = jax.device_put(v, self._batch_sharding[k])
+        return out
+
+    def _get_stage_fn(self, name, needs_transpose, is_u8, ndim):
+        """Jitted on-device staging program for one input: NCHW->NHWC
+        transpose and/or uint8 -> (x - mean)/std -> compute dtype."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (name, needs_transpose, is_u8, ndim)
+        hit = self._stage_fns.get(key)
+        if hit is not None:
+            return hit
+        # the RAW host layout lands batch-sharded; the staged result
+        # takes the input's full batch sharding (seq-parallel inputs
+        # keep their dim-1 'model' shard)
+        in_sharding = NamedSharding(self.mesh, P("data"))
+        out_sharding = self._batch_sharding[name]
+        compute_dtype = jnp.dtype(self.dtype)
+        mean, std = self._input_mean, self._input_std
+        ch_axis = -1 if (self._layout == "NHWC" or needs_transpose) else 1
+
+        def reshape_stat(s, x_ndim):
+            a = jnp.asarray(s, jnp.float32)
+            if a.ndim == 0:
+                return a
+            shape = [1] * x_ndim
+            shape[ch_axis] = a.shape[0]
+            return a.reshape(shape)
+
+        def stage(a):
+            if needs_transpose:
+                a = jnp.transpose(a, (0, 2, 3, 1))
+            if is_u8:
+                x = a.astype(jnp.float32)
+                if mean is not None:
+                    x = x - reshape_stat(mean, x.ndim)
+                if std is not None:
+                    x = x / reshape_stat(std, x.ndim)
+                return x.astype(compute_dtype)
+            return a
+
+        fn = jax.jit(stage, out_shardings=out_sharding)
+        self._stage_fns[key] = (fn, in_sharding)
+        return fn, in_sharding
 
     def step(self, batch):
         """One fused training step.  ``batch``: dict name -> host array
@@ -1133,8 +1211,7 @@ class ShardedTrainer:
         if isinstance(first, jax.Array):
             dev_batch = batch  # already staged via put_batch
         else:
-            dev_batch = {k: jax.device_put(v, self._batch_sharding[k])
-                         for k, v in self._cast_batch(batch).items()}
+            dev_batch = self.put_batch(batch)
         return self._fwd_fn(self.params, self.aux, dev_batch)
 
 

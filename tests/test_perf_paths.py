@@ -245,6 +245,45 @@ def test_phase_bwd_trainer_parity():
             rtol=5e-4, atol=5e-5, err_msg=name)
 
 
+# ------------------------------------------- raw-uint8 device ingest
+def test_uint8_device_normalize_matches_host_floats():
+    """put_batch of raw uint8 NHWC batches (the native reader's
+    raw_uint8 output) with device-side (x-mean)/std equals staging
+    host-normalized floats — same training trajectory."""
+    from mxnet_tpu import models
+    mesh = build_mesh(tp=1)
+    mean = (123.68, 116.779, 103.939)
+    std = (58.393, 57.12, 57.375)
+
+    def make(**kw):
+        np.random.seed(37)
+        net = models.get_model("resnet18", num_classes=10,
+                               image_shape="3,32,32")
+        return ShardedTrainer(
+            net, mesh, data_shapes={"data": (8, 3, 32, 32)},
+            label_shapes={"softmax_label": (8,)},
+            layout="NHWC", seed=9, learning_rate=0.1, momentum=0.9,
+            **kw)
+
+    a = make()
+    b = make(input_mean=mean, input_std=std)
+    rng = np.random.RandomState(0)
+    u8_nhwc = rng.randint(0, 256, (8, 32, 32, 3)).astype(np.uint8)
+    y = rng.randint(0, 10, 8).astype("f")
+    host_norm = ((u8_nhwc.astype("f") - np.asarray(mean, "f"))
+                 / np.asarray(std, "f")).transpose(0, 3, 1, 2)
+
+    for _ in range(2):
+        la = float(a.step({"data": host_norm, "softmax_label": y}))
+        lb = float(b.step(b.put_batch(
+            {"data": u8_nhwc, "softmax_label": y})))
+        assert np.isclose(la, lb, rtol=1e-3), (la, lb)
+    for name in a.params:
+        np.testing.assert_allclose(
+            np.asarray(a.params[name]), np.asarray(b.params[name]),
+            rtol=1e-3, atol=1e-4, err_msg=name)
+
+
 # ------------------------------------------------- fused fit CLI path
 def test_fused_fit_cli(tmp_path):
     """examples/image_classification fit --fused 1: the CLI surface
