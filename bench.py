@@ -59,7 +59,9 @@ def main():
         # (ops/fused.py; ~+1%, parity-tested)
         stem_space_to_depth=os.environ.get("BENCH_STEM_S2D", "1") == "1",
         # measured-off (docs/perf.md): phase-decomposed stride-2 backward
-        strided_bwd_phase=os.environ.get("BENCH_PHASE_BWD", "0") == "1")
+        strided_bwd_phase=os.environ.get("BENCH_PHASE_BWD", "0") == "1",
+        # pointwise convs lowered as fusible dots (ops/fused.py)
+        conv1x1_as_dot=os.environ.get("BENCH_CONV1X1_DOT", "0") == "1")
 
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)

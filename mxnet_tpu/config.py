@@ -49,6 +49,9 @@ _CATALOG = {
     "MXNET_PHASE_BWD": ("0", "honored",
         "phase-decomposed stride-2 conv backward-data (docs/perf.md: "
         "measured slower on v5e; off by default)"),
+    "MXNET_CONV1X1_DOT": ("0", "honored",
+        "lower pointwise convs as dots (docs/perf.md: measured neutral "
+        "on v5e; off by default)"),
     "MXNET_PROFILER_AUTOSTART": ("0", "honored", "see profiler.py"),
     "MXNET_PROFILER_MODE": ("0", "honored", ""),
     "MXNET_PROFILER_FILENAME": ("profile.json", "honored", ""),

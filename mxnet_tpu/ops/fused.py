@@ -279,6 +279,26 @@ def plan_conv_bn_fusion(topo, entries=()):
     return plan, skip
 
 
+# ------------------------------------------- pointwise conv as a dot
+# A 1x1/s1/p0 conv IS a GEMM over flattened spatial positions.  XLA:TPU
+# lowers convolutions through the conv library (opaque to fusion) but
+# dots through the standard MXU emitter, which CAN fuse elementwise
+# producers/consumers — the BN normalize/ReLU passes around ResNet's 40
+# pointwise convs could fold into the GEMM's operand reads.
+conv1x1_dot, conv1x1_dot_enabled = _trace_flag(
+    "MXNET_CONV1X1_DOT",
+    "Context manager lowering eligible pointwise convs as dots.")
+
+
+def conv1x1_as_dot(x, w_hwio):
+    """x NHWC, w (1, 1, I, O) -> conv output via a flattened dot."""
+    nb, h, wd, cin = x.shape
+    nout = w_hwio.shape[3]
+    y = jnp.dot(x.reshape(nb * h * wd, cin),
+                w_hwio.reshape(cin, nout))
+    return y.reshape(nb, h, wd, nout).astype(x.dtype)
+
+
 # --------------------------------- phase-decomposed stride-2 backward
 # XLA computes backward-data of a strided conv as a conv over the
 # lhs-dilated cotangent: for stride 2, ~3/4 of the MACs multiply

@@ -143,7 +143,7 @@ class ShardedTrainer:
                  stem_space_to_depth=None, elide_input_bn_grad=True,
                  strided_bwd_phase=None, pipeline_stages=1,
                  pipeline_microbatches=None, sequence_parallel=False,
-                 input_mean=None, input_std=None):
+                 input_mean=None, input_std=None, conv1x1_as_dot=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -212,6 +212,13 @@ class ShardedTrainer:
             from ..ops import fused as _fused_mod
             strided_bwd_phase = _fused_mod.phase_bwd_enabled()
         self._phase_bwd = bool(strided_bwd_phase) and \
+            self._layout == "NHWC"
+        # conv1x1_as_dot: lower pointwise convs as fusible dots
+        # (ops/fused.py); None -> MXNET_CONV1X1_DOT env default
+        if conv1x1_as_dot is None:
+            from ..ops import fused as _fused_mod
+            conv1x1_as_dot = _fused_mod.conv1x1_dot_enabled()
+        self._conv1x1_dot = bool(conv1x1_as_dot) and \
             self._layout == "NHWC"
         # pipeline_stages > 1: GPipe over the mesh's 'pipe' axis — the
         # graph is cut into stages at single-live-tensor positions and
@@ -790,13 +797,15 @@ class ShardedTrainer:
                 # compute-precision copies of the f32 masters; the astype
                 # vjp returns f32 grads automatically
                 from ..ops.fused import (conv_bn_fusion, stem_s2d,
-                                         elide_input_grads, phase_bwd)
+                                         elide_input_grads, phase_bwd,
+                                         conv1x1_dot)
                 from .sequence import sequence_parallel as seq_ctx
                 p = {k: v.astype(compute_dtype) for k, v in p32.items()}
                 with image_layout(layout), \
                         conv_bn_fusion(self._fuse_conv_bn), \
                         stem_s2d(self._stem_s2d), \
                         phase_bwd(self._phase_bwd), \
+                        conv1x1_dot(self._conv1x1_dot), \
                         seq_ctx(self.mesh if self._seq_parallel
                                 else None), \
                         elide_input_grads(

@@ -245,6 +245,34 @@ def test_phase_bwd_trainer_parity():
             rtol=5e-4, atol=5e-5, err_msg=name)
 
 
+def test_conv1x1_as_dot_parity():
+    """Pointwise convs lowered as dots train identically to the conv
+    lowering (ResNet-50's bottleneck blocks are mostly 1x1 convs)."""
+    from mxnet_tpu import models
+    mesh = build_mesh(tp=1)
+
+    def make(enable):
+        np.random.seed(53)
+        net = models.get_model("resnet50", num_classes=10,
+                               image_shape="3,64,64")
+        return ShardedTrainer(
+            net, mesh, data_shapes={"data": (8, 3, 64, 64)},
+            label_shapes={"softmax_label": (8,)},
+            layout="NHWC", seed=5, learning_rate=0.1, momentum=0.9,
+            conv1x1_as_dot=enable)
+
+    a, b = make(False), make(True)
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.uniform(-1, 1, (8, 3, 64, 64)).astype("f"),
+             "softmax_label": rng.randint(0, 10, 8).astype("f")}
+    la, lb = float(a.step(batch)), float(b.step(batch))
+    assert np.isclose(la, lb, rtol=5e-4)
+    for name in a.params:
+        np.testing.assert_allclose(
+            np.asarray(a.params[name]), np.asarray(b.params[name]),
+            rtol=5e-4, atol=5e-5, err_msg=name)
+
+
 # ------------------------------------------- raw-uint8 device ingest
 def test_uint8_device_normalize_matches_host_floats():
     """put_batch of raw uint8 NHWC batches (the native reader's
