@@ -75,9 +75,12 @@ def main():
     float(trainer.step(batch_dict))
     float(trainer.step(batch_dict))
 
-    # BENCH_SCAN>1: chain that many steps inside one device program
-    # (ShardedTrainer.run_steps) — removes per-step dispatch entirely
-    scan = int(os.environ.get("BENCH_SCAN", "1"))
+    # BENCH_SCAN>1 (default 10): chain that many full optimizer steps
+    # inside one device program (ShardedTrainer.run_steps) — removes
+    # per-step host dispatch; each inner step is a complete training
+    # update (forward+backward+optimizer+aux).  BENCH_SCAN=1 for the
+    # per-step dispatch path.
+    scan = int(os.environ.get("BENCH_SCAN", "10"))
     if scan > 1:
         steps = max(scan, (steps // scan) * scan)
         float(np.asarray(trainer.run_steps(batch_dict, scan))[-1])  # compile

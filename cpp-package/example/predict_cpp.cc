@@ -1,0 +1,61 @@
+// C++ binding example: load a checkpoint, predict, print outputs.
+//
+// Role parity: cpp-package/example/inference in the reference.  Build
+// (after `make -C src libmxtpu_predict.so`):
+//
+//   g++ -O2 -std=c++17 cpp-package/example/predict_cpp.cc \
+//       -Icpp-package/include -Lsrc -lmxtpu_predict -Wl,-rpath,src \
+//       -o predict_cpp
+//   PYTHONPATH=. ./predict_cpp net-symbol.json net-0000.params \
+//       x.f32 BATCH FEAT
+//
+// Prints "shape d0 d1 ..." then one output value per line (the same
+// contract as tests/c_predict_test.c, so the python test harness can
+// drive either binary).
+#include <cstdio>
+#include <fstream>
+#include <iterator>
+#include <string>
+#include <vector>
+
+#include <mxnet_tpu_cpp/predictor.hpp>
+
+static std::string slurp(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw mxtpu::Error(std::string("cannot open ") + path);
+  return std::string(std::istreambuf_iterator<char>(f),
+                     std::istreambuf_iterator<char>());
+}
+
+int main(int argc, char **argv) {
+  if (argc != 6) {
+    std::fprintf(stderr,
+                 "usage: %s symbol.json params x.f32 batch feat\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    const std::string symbol = slurp(argv[1]);
+    const std::string params = slurp(argv[2]);
+    const std::string xbytes = slurp(argv[3]);
+    const mx_uint batch = static_cast<mx_uint>(std::stoul(argv[4]));
+    const mx_uint feat = static_cast<mx_uint>(std::stoul(argv[5]));
+
+    mxtpu::Predictor pred(symbol, params,
+                          {{"data", {batch, feat}}}, mxtpu::kCPU);
+    pred.SetInput("data",
+                  reinterpret_cast<const float *>(xbytes.data()),
+                  xbytes.size() / sizeof(float));
+    pred.Forward();
+
+    const auto shape = pred.GetOutputShape(0);
+    std::printf("shape");
+    for (mx_uint d : shape) std::printf(" %u", d);
+    std::printf("\n");
+    for (float v : pred.GetOutput(0)) std::printf("%.6f\n", v);
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,148 @@
+/*
+ * C++ inference binding for mxnet_tpu — header-only wrapper over the
+ * native prediction ABI (libmxtpu_predict.so).
+ *
+ * Role parity: the reference's `cpp-package/` (MXNet C++ API) at its
+ * deployment scope.  The reference cpp-package also wraps training
+ * (~150 C API functions); this framework is python-first for training
+ * (PARITY.md), so the C++ surface covers what C++ applications ship:
+ * load a checkpoint, feed inputs, run, read outputs — with RAII
+ * handles and exceptions instead of manual MXPred* calls.
+ *
+ *   #include <mxnet_tpu_cpp/predictor.hpp>
+ *   mxtpu::Predictor pred(symbol_json, params_blob,
+ *                         {{"data", {1, 3, 224, 224}}}, mxtpu::kTPU);
+ *   pred.SetInput("data", img.data(), img.size());
+ *   pred.Forward();
+ *   std::vector<float> probs = pred.GetOutput(0);
+ *
+ * Link: -lmxtpu_predict (see src/Makefile; the library embeds CPython,
+ * so run with MXTPU_PYTHONHOME/PYTHONPATH as tests/test_c_predict.py
+ * demonstrates).
+ */
+#ifndef MXNET_TPU_CPP_PREDICTOR_HPP_
+#define MXNET_TPU_CPP_PREDICTOR_HPP_
+
+#include <cstddef>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../include/mxnet_tpu/c_predict_api.h"
+
+namespace mxtpu {
+
+enum DeviceType { kCPU = 1, kGPU = 2, kTPU = 3 };
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc, const char *op) {
+  if (rc != 0) {
+    throw Error(std::string(op) + ": " + MXGetLastError());
+  }
+}
+
+/* One named input and its shape. */
+struct InputDesc {
+  std::string name;
+  std::vector<mx_uint> shape;
+};
+
+class Predictor {
+ public:
+  /* symbol_json: contents of *-symbol.json; params: raw bytes of the
+   * *.params file; inputs: name -> shape. */
+  Predictor(const std::string &symbol_json, const std::string &params,
+            const std::vector<InputDesc> &inputs,
+            DeviceType dev = kCPU, int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shapes;
+    for (const auto &in : inputs) {
+      keys.push_back(in.name.c_str());
+      for (mx_uint d : in.shape) shapes.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shapes.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), params.data(),
+                       static_cast<int>(params.size()),
+                       static_cast<int>(dev), dev_id,
+                       static_cast<mx_uint>(inputs.size()), keys.data(),
+                       indptr.data(), shapes.data(), &handle_),
+          "MXPredCreate");
+  }
+
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  Predictor(Predictor &&o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  Predictor &operator=(Predictor &&o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string &name, const float *data,
+                std::size_t size) {
+    Check(MXPredSetInput(handle_, name.c_str(), data,
+                         static_cast<mx_uint>(size)),
+          "MXPredSetInput");
+  }
+
+  void Forward() { Check(MXPredForward(handle_), "MXPredForward"); }
+
+  std::vector<mx_uint> GetOutputShape(mx_uint index = 0) {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &shape, &ndim),
+          "MXPredGetOutputShape");
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<float> GetOutput(mx_uint index = 0) {
+    auto shape = GetOutputShape(index);
+    std::size_t n = std::accumulate(shape.begin(), shape.end(),
+                                    std::size_t(1),
+                                    std::multiplies<std::size_t>());
+    std::vector<float> out(n);
+    Check(MXPredGetOutput(handle_, index, out.data(),
+                          static_cast<mx_uint>(n)),
+          "MXPredGetOutput");
+    return out;
+  }
+
+  /* New handle bound at new input shapes; this predictor stays usable
+   * at its original shapes (weights shared — reference MXPredReshape
+   * semantics). */
+  Predictor Reshaped(const std::vector<InputDesc> &inputs) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shapes;
+    for (const auto &in : inputs) {
+      keys.push_back(in.name.c_str());
+      for (mx_uint d : in.shape) shapes.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shapes.size()));
+    }
+    PredictorHandle fresh = nullptr;
+    Check(MXPredReshape(handle_,
+                        static_cast<mx_uint>(inputs.size()), keys.data(),
+                        indptr.data(), shapes.data(), &fresh),
+          "MXPredReshape");
+    return Predictor(fresh);
+  }
+
+ private:
+  explicit Predictor(PredictorHandle h) : handle_(h) {}
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_PREDICTOR_HPP_

@@ -34,6 +34,20 @@ def _build():
     return exe
 
 
+def _build_cpp():
+    """The header-only C++ binding example (cpp-package role)."""
+    subprocess.run(["make", "libmxtpu_predict.so"], cwd=SRC, check=True,
+                   capture_output=True)
+    exe = os.path.join(SRC, "predict_cpp_test")
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17",
+         os.path.join(ROOT, "cpp-package", "example", "predict_cpp.cc"),
+         "-o", exe, "-I" + os.path.join(ROOT, "cpp-package", "include"),
+         "-L" + SRC, "-lmxtpu_predict", "-Wl,-rpath," + SRC],
+        check=True, capture_output=True)
+    return exe
+
+
 def test_c_predict_matches_python():
     exe = _build()
     rng = np.random.RandomState(0)
@@ -75,3 +89,48 @@ def test_c_predict_matches_python():
         pred.forward(data=x)
         py_vals = pred.get_output(0)
     np.testing.assert_allclose(c_vals, py_vals, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_binding_matches_python():
+    """The C++ RAII binding (cpp-package/) drives the same ABI: a C++
+    program loads a python-trained checkpoint and reproduces the
+    in-process predictions."""
+    exe = _build_cpp()
+    rng = np.random.RandomState(1)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=12, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "net")
+        mod.save_checkpoint(prefix, 0)
+        x = rng.randn(4, 6).astype("f")
+        xfile = os.path.join(d, "x.f32")
+        x.tofile(xfile)
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ROOT + ":" + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [exe, prefix + "-symbol.json", prefix + "-0000.params",
+             xfile, "4", "6"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out.returncode == 0, out.stderr
+        lines = out.stdout.strip().split("\n")
+        assert lines[0].split() == ["shape", "4", "3"], lines[0]
+        cpp_vals = np.array([float(v) for v in lines[1:]]).reshape(4, 3)
+
+        pred = mx.predictor.Predictor(
+            open(prefix + "-symbol.json").read(),
+            prefix + "-0000.params", {"data": (4, 6)})
+        pred.forward(data=x)
+        py_vals = pred.get_output(0)
+    np.testing.assert_allclose(cpp_vals, py_vals, rtol=1e-4, atol=1e-5)
