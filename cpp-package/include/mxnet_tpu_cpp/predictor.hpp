@@ -24,6 +24,7 @@
 #define MXNET_TPU_CPP_PREDICTOR_HPP_
 
 #include <cstddef>
+#include <functional>
 #include <numeric>
 #include <stdexcept>
 #include <string>
