@@ -52,15 +52,46 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=20,
     return num_batches * batch_size / (time.time() - tic)
 
 
+def score_fused(network, batch_size, image_shape=(3, 224, 224),
+                num_batches=20, dtype="bfloat16"):
+    """Inference through the fused path: one jitted forward program,
+    bf16 NHWC (the TPU-native serving configuration); batch staged once
+    so the number isolates device throughput like `score` does."""
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+    net = models.get_model(network, num_classes=1000,
+                           image_shape=",".join(map(str, image_shape)))
+    data_shape = (batch_size,) + image_shape
+    trainer = ShardedTrainer(
+        net, build_mesh(tp=1),
+        data_shapes={"data": data_shape},
+        label_shapes={"softmax_label": (batch_size,)},
+        dtype=dtype, layout="NHWC")
+    x = np.random.rand(*data_shape).astype(np.float32)
+    dev = trainer.put_batch({"data": x})
+    float(np.asarray(trainer.forward(dev)[0]).sum())   # warm compile
+    tic = time.time()
+    for _ in range(num_batches):
+        out = trainer.forward(dev)
+    float(np.asarray(out[0]).sum())  # value fetch closes the chain
+    return num_batches * batch_size / (time.time() - tic)
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="score inference speed")
     parser.add_argument("--networks", type=str,
                         default="alexnet,vgg16,inception_bn,resnet50")
     parser.add_argument("--batch-sizes", type=str, default="1,2,4,8,16,32")
+    parser.add_argument("--fused", type=int, default=0,
+                        help="1: score the fused bf16 NHWC path")
+    parser.add_argument("--dtype", type=str, default="bfloat16")
     args = parser.parse_args()
     for net in args.networks.split(","):
         shape = (3, 299, 299) if net == "inception_v3" else (3, 224, 224)
         logging.info("network: %s", net)
         for b in (int(x) for x in args.batch_sizes.split(",")):
-            speed = score(net, b, image_shape=shape)
+            if args.fused:
+                speed = score_fused(net, b, image_shape=shape,
+                                    dtype=args.dtype)
+            else:
+                speed = score(net, b, image_shape=shape)
             logging.info("batch size %2d, image/sec: %f", b, speed)
