@@ -219,3 +219,28 @@ def test_pipeline_checkpoint_roundtrip(tmp_path):
     tr.save_checkpoint(prefix, 1)
     sym2, arg_p, aux_p = mx.model.load_checkpoint(prefix, 1)
     assert sorted(arg_p) == sorted(tr.params)
+
+
+def test_pipeline_run_steps_matches_step_loop():
+    """run_steps (scan chaining) composes with the pipelined step."""
+    sym_a, sym_b = _mlp_tower(), _mlp_tower()
+    bsz = 16
+
+    def make(sym):
+        np.random.seed(47)
+        return ShardedTrainer(
+            sym, build_mesh(n_devices=4, pp=2),
+            data_shapes={"data": (bsz, 12)},
+            label_shapes={"softmax_label": (bsz,)},
+            learning_rate=0.1, momentum=0.9, seed=7,
+            pipeline_stages=2, pipeline_microbatches=2)
+
+    a, b = make(sym_a), make(sym_b)
+    batch = _batch(bsz, 12, 8, seed=0)
+    losses_a = [float(a.step(batch)) for _ in range(3)]
+    losses_b = np.asarray(b.run_steps(batch, 3))
+    np.testing.assert_allclose(losses_b, losses_a, rtol=1e-5)
+    for name in a.params:
+        np.testing.assert_allclose(
+            np.asarray(a.params[name]), np.asarray(b.params[name]),
+            rtol=1e-5, atol=1e-6, err_msg=name)
