@@ -110,8 +110,11 @@ class Predictor:
 
     def reshape(self, input_shapes):
         # the C predict API reallocates freely on reshape
-        # (c_predict_api.cc MXPredReshape), so growing inputs is allowed
+        # (c_predict_api.cc MXPredReshape), so growing inputs is
+        # allowed; partial_shaping covers implied changes (an inert
+        # label head's batch dim follows the data input)
         self._executor = self._executor.reshape(allow_up_sizing=True,
+                                                partial_shaping=True,
                                                 **input_shapes)
         return self
 
@@ -130,5 +133,6 @@ class Predictor:
         # reshaping a subset of inputs; the others keep their shapes)
         clone._input_names = list(self._input_names)
         clone._executor = self._executor.reshape(allow_up_sizing=True,
+                                                 partial_shaping=True,
                                                  **input_shapes)
         return clone

@@ -134,3 +134,37 @@ def test_cpp_binding_matches_python():
         pred.forward(data=x)
         py_vals = pred.get_output(0)
     np.testing.assert_allclose(cpp_vals, py_vals, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_reshaped_independent_handles():
+    """Regression for the round-2 advisor finding: reshaping must hand
+    back a NEW predictor while the original keeps its shapes (one
+    handle per batch size is the documented reference pattern)."""
+    rng = np.random.RandomState(2)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 7))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "net")
+        mod.save_checkpoint(prefix, 0)
+        pred = mx.predictor.Predictor(
+            open(prefix + "-symbol.json").read(),
+            prefix + "-0000.params", {"data": (2, 7)})
+
+        big = pred.reshaped({"data": (6, 7)})
+        x2 = rng.randn(2, 7).astype("f")
+        x6 = rng.randn(6, 7).astype("f")
+        # the ORIGINAL still works at its original shape
+        out2 = pred.forward(data=x2).get_output(0)
+        assert out2.shape == (2, 5)
+        # the new handle runs the new batch size with shared weights
+        out6 = big.forward(data=x6).get_output(0)
+        assert out6.shape == (6, 5)
+        np.testing.assert_allclose(
+            big.forward(data=np.concatenate([x2, x2, x2])).get_output(0)[:2],
+            out2, rtol=1e-5, atol=1e-6)
