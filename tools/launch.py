@@ -7,7 +7,8 @@ workers with DMLC_* env.  TPU-native design (SURVEY §5.8): there are no
 parameter servers — every host runs the SAME script and joins one
 ``jax.distributed`` job; this launcher sets the coordinator env
 (MXNET_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID, consumed by
-``mxnet_tpu.parallel.dist_kvstore.DistKVStore.init_env``) and forks local
+``mxnet_tpu.parallel.multihost.ensure_initialized`` — called by both
+``ShardedTrainer`` workers and ``mx.kv.create("dist_*")``) and forks local
 workers (``--launcher local``, the reference's single-host test mode for
 multi-node semantics) or SSHes to hosts (``--launcher ssh``).
 """
