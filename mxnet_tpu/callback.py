@@ -1,4 +1,11 @@
-"""Training callbacks (reference: ``python/mxnet/callback.py``)."""
+"""Training callbacks.
+
+API parity with ``python/mxnet/callback.py`` (reference): the same
+callable names and signatures, invoked by ``BaseModule.fit`` /
+``model.FeedForward`` with a ``BatchEndParam``-shaped namedtuple
+(epoch, nbatch, eval_metric, locals).  Implementations here are
+original; only the call contracts are mirrored.
+"""
 from __future__ import annotations
 
 import logging
@@ -10,97 +17,107 @@ __all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "module_checkpoint",
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint the Module's params every ``period`` epochs
-    (reference callback.py module_checkpoint)."""
-    period = int(max(1, period))
+    """Epoch-end callback saving a Module's state every ``period``
+    epochs (role of reference callback.py module_checkpoint)."""
+    every = max(1, int(period))
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+        done = iter_no + 1
+        if done % every == 0:
+            mod.save_checkpoint(prefix, done, save_optimizer_states)
     return _callback
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end checkpoint writer (reference callback.py:38)."""
+    """Epoch-end callback writing ``prefix-symbol.json`` +
+    ``prefix-%04d.params`` every ``period`` epochs (role of reference
+    callback.py do_checkpoint)."""
     from .model import save_checkpoint
-    period = int(max(1, period))
+    every = max(1, int(period))
 
     def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        done = iter_no + 1
+        if done % every == 0:
+            save_checkpoint(prefix, done, sym, arg, aux)
     return _callback
 
 
 def log_train_metric(period, auto_reset=False):
-    """Log metric every ``period`` batches (reference log_train_metric)."""
+    """Batch-end callback printing the running training metric every
+    ``period`` batches (role of reference log_train_metric)."""
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        metric = param.eval_metric
+        if metric is None or param.nbatch % period != 0:
+            return
+        for name, value in metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            metric.reset()
     return _callback
 
 
 class Speedometer:
-    """samples/sec logging (reference callback.py Speedometer)."""
+    """Batch-end callback logging training throughput (samples/sec)
+    every ``frequent`` batches, resetting the metric between reports
+    (role of reference callback.py Speedometer)."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._mark = None          # (monotonic time, nbatch) of last report
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
-                    for name, value in name_value:
-                        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f "
-                                     "samples/sec\tTrain-%s=%f",
-                                     param.epoch, count, speed, name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        now = time.monotonic()
+        if self._mark is None or param.nbatch < self._mark[1]:
+            # first batch of a run, or a new epoch rewound the counter
+            self._mark = (now, param.nbatch)
+            return
+        if param.nbatch % self.frequent != 0:
+            return
+        elapsed = now - self._mark[0]
+        batches = param.nbatch - self._mark[1]
+        self._mark = (now, param.nbatch)
+        if elapsed <= 0 or batches <= 0:
+            return
+        speed = batches * self.batch_size / elapsed
+        metric = param.eval_metric
+        if metric is not None:
+            pairs = metric.get_name_value()
+            metric.reset()
+            for name, value in pairs:
+                logging.info(
+                    "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    "\tTrain-%s=%f",
+                    param.epoch, param.nbatch, speed, name, value)
         else:
-            self.init = True
-            self.tic = time.time()
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, param.nbatch, speed)
 
 
 class ProgressBar:
-    """ASCII progress bar (reference callback.py ProgressBar)."""
+    """Batch-end callback drawing an ASCII progress bar over ``total``
+    batches (role of reference callback.py ProgressBar)."""
 
     def __init__(self, total, length=80):
         self.bar_len = length
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = param.nbatch / float(self.total)
+        cells = int(round(self.bar_len * frac))
+        logging.info("[%s] %s%%\r",
+                     "=" * cells + "-" * (self.bar_len - cells),
+                     math.ceil(100.0 * frac))
 
 
 class LogValidationMetricsCallback:
-    """Log validation metrics at epoch end (reference callback.py)."""
+    """Epoch-end callback printing every validation metric (role of
+    reference callback.py LogValidationMetricsCallback)."""
 
     def __call__(self, param):
         if not param.eval_metric:
             return
         for name, value in param.eval_metric.get_name_value():
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
-                         value)
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -29,18 +29,20 @@ def _as_list(obj):
 
 
 def _check_input_names(symbol, names, typename, throw):
-    """Verify that names occur in the symbol's arguments
-    (reference base_module.py _check_input_names)."""
+    """Every requested input name must be a symbol argument; on a miss,
+    suggest the non-aux arguments (same diagnostic contract as reference
+    base_module.py _check_input_names)."""
     args = symbol.list_arguments()
-    for name in names:
-        if name in args:
-            continue
-        candidates = [arg for arg in args if arg not in
-                      symbol.list_auxiliary_states()]
-        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
-              "input with name '%s' is not found in symbol.list_arguments(). " \
-              "Did you mean one of:\n\t%s\033[0m" % (
-                  typename, str(names), name, "\n\t".join(candidates))
+    missing = [n for n in names if n not in args]
+    if not missing:
+        return
+    suggestions = "\n\t".join(
+        a for a in args if a not in symbol.list_auxiliary_states())
+    for name in missing:
+        msg = ("\033[91mYou created Module with Module(..., %s_names=%s) "
+               "but input with name '%s' is not found in "
+               "symbol.list_arguments(). Did you mean one of:\n\t%s\033[0m"
+               % (typename, names, name, suggestions))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
@@ -67,35 +69,37 @@ class BaseModule:
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        """Run prediction on eval_data and evaluate (reference
-        base_module.py score)."""
+        """Evaluate ``eval_metric`` over (up to ``num_batch`` batches of)
+        ``eval_data`` with inference forwards; same contract as reference
+        base_module.py score."""
         assert self.binded and self.params_initialized
-
         if reset:
             eval_data.reset()
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
 
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
+        seen = 0
+        for eval_batch in eval_data:
+            if num_batch is not None and seen == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+                # locals() of THIS frame: callbacks reading
+                # param.locals['eval_batch'] (reference pattern) keep
+                # working
+                info = BatchEndParam(epoch=epoch, nbatch=seen,
+                                     eval_metric=eval_metric,
+                                     locals=locals())
+                for cb in _as_list(batch_end_callback):
+                    cb(info)
+            seen += 1
+        if score_end_callback is not None:
+            info = BatchEndParam(epoch=epoch, nbatch=seen,
+                                 eval_metric=eval_metric, locals=locals())
+            for cb in _as_list(score_end_callback):
+                cb(info)
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
@@ -113,38 +117,23 @@ class BaseModule:
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        """Run prediction, collecting outputs (reference base_module.py
-        predict)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-
-        if len(output_list) == 0:
-            return output_list
-
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches: mismatched output counts"
-            from .. import ndarray as nd
-            output_list2 = [
-                nd.array(np.concatenate(
-                    [out[i].asnumpy() for out in output_list]))
-                for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        """Collect inference outputs over ``eval_data``, de-padded per
+        batch; ``merge_batches`` concatenates along the batch dim.  Same
+        contract as reference base_module.py predict."""
+        per_batch = [outs for outs, _n, _b
+                     in self.iter_predict(eval_data, num_batch=num_batch,
+                                          reset=reset)]
+        if not per_batch or not merge_batches:
+            return per_batch
+        counts = {len(outs) for outs in per_batch}
+        assert len(counts) == 1, \
+            "Cannot merge batches: mismatched output counts %s" % counts
+        from .. import ndarray as nd
+        merged = [nd.array(np.concatenate([o.asnumpy() for o in outs]))
+                  for outs in zip(*per_batch)]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
@@ -155,7 +144,15 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """The training loop (reference base_module.py:375-533)."""
+        """The high-level training loop: bind + init from the iterator's
+        shapes, then per epoch run fused step + update + metric +
+        callbacks over every batch, sync params off the devices, and
+        optionally score a validation set.  Call contract (argument
+        surface, callback firing points, log lines) matches reference
+        base_module.py:375-533; the loop itself is a plain for — the
+        reference's one-ahead batch prefetch fed a host pipeline this
+        backend doesn't need (XLA dispatch is already async).
+        """
         assert num_epoch is not None, "please specify number of epochs"
 
         self.bind(data_shapes=train_data.provide_data,
@@ -174,63 +171,46 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
-        # training loop
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            started = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            for nbatch, batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
+                self.forward_backward(batch)
                 self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                except StopIteration:
-                    end_of_batch = True
-
-                self.update_metric(eval_metric, data_batch.label)
-
+                self.update_metric(eval_metric, batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-
                 if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+                    info = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                         eval_metric=eval_metric,
+                                         locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(info)
 
-            # one epoch of training is finished
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - started)
 
-            # sync aux params across devices
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
+            # pull the trained values off the devices so get_params()
+            # callers (and the epoch callbacks below) see current weights
+            args, auxs = self.get_params()
+            self.set_params(args, auxs)
             if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, args, auxs)
 
-            # evaluation on validation set
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
 
-            # end of epoch, reset the data-iter for another epoch
             train_data.reset()
 
     # ------------------------------------------------------------ symbol

@@ -281,65 +281,66 @@ class Module(BaseModule):
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        """Create optimizer + kvstore (reference module.py:432-510)."""
+        """Set up the update machinery: resolve the kvstore (and whether
+        updates run on it), build the Optimizer with reference-parity
+        gradient scaling, and seed the store with the initial weights.
+        Semantics of reference module.py:432-510.
+        """
         assert self.binded and self.params_initialized
-
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
 
-        (kvstore, update_on_kvstore) = _create_kvstore(
-            kvstore, len(self._context), self._arg_params)
-
-        batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and \
-                "_sync" in kvstore.type:
-            batch_size *= kvstore.num_workers
-        rescale_grad = 1.0 / batch_size
+        store, on_store = _create_kvstore(kvstore, len(self._context),
+                                          self._arg_params)
+        # gradients are averaged over the GLOBAL batch: every worker of a
+        # dist_sync job contributes its own local batch to the sum
+        effective_batch = self._exec_group.batch_size
+        if store and store.type.startswith("dist") and "_sync" in store.type:
+            effective_batch *= store.num_workers
 
         if isinstance(optimizer, str):
-            idx2name = {}
-            if update_on_kvstore:
-                idx2name.update(enumerate(self._exec_group.param_names))
-            else:
-                for k in range(len(self._context)):
-                    idx2name.update(
-                        {i * len(self._context) + k: n
-                         for i, n in enumerate(self._exec_group.param_names)})
-            optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
+            # updater callbacks receive integer indices; in the local
+            # multi-device layout each device replica of a param gets its
+            # own slot (index i*ndev+k), all mapping to one name so
+            # lr_mult/wd_mult resolve identically on every replica
+            names = self._exec_group.param_names
+            ndev = 1 if on_store else len(self._context)
+            slot2name = {i * ndev + k: n
+                         for i, n in enumerate(names) for k in range(ndev)}
+            kw = dict(optimizer_params)
+            kw.setdefault("rescale_grad", 1.0 / effective_batch)
             optimizer = opt_mod.create(optimizer, sym=self.symbol,
-                                       param_idx2name=idx2name,
-                                       **optimizer_params)
+                                       param_idx2name=slot2name, **kw)
         else:
             assert isinstance(optimizer, opt_mod.Optimizer)
-            if optimizer.rescale_grad != rescale_grad:
+            if optimizer.rescale_grad != 1.0 / effective_batch:
                 self.logger.warning(
                     "Optimizer created manually outside Module but "
                     "rescale_grad is not normalized to 1.0/batch_size/"
                     "num_workers (%s vs. %s). Is this intended?",
-                    optimizer.rescale_grad, rescale_grad)
+                    optimizer.rescale_grad, 1.0 / effective_batch)
 
         self._optimizer = optimizer
-        self._kvstore = kvstore
-        self._update_on_kvstore = update_on_kvstore
-        self._updater = None
-
-        if kvstore:
-            # copy initialized local parameters to kvstore
-            _initialize_kvstore(kvstore=kvstore,
+        self._kvstore = store
+        self._update_on_kvstore = on_store
+        # either the store applies updates (set_optimizer) or a local
+        # updater closure does — never both
+        if store:
+            _initialize_kvstore(kvstore=store,
                                 param_arrays=self._exec_group.param_arrays,
                                 arg_params=self._arg_params,
                                 param_names=self._param_names,
-                                update_on_kvstore=update_on_kvstore)
-        if update_on_kvstore:
-            kvstore.set_optimizer(self._optimizer)
+                                update_on_kvstore=on_store)
+        if on_store:
+            self._updater = None
+            store.set_optimizer(optimizer)
         else:
             self._updater = opt_mod.get_updater(optimizer)
-
         self.optimizer_initialized = True
 
+        # Module.load(load_optimizer_states=True) defers the state file
+        # until the optimizer exists — consume it now
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None

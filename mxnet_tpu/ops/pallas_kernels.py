@@ -9,8 +9,12 @@ Used single-chip; the sequence-parallel wrapper
 same math per block.
 
 Exposed as the ``_contrib_FlashAttention`` operator (q, k, v) with layout
-(batch, seq, heads, head_dim); backward is a jnp recompute via custom_vjp
-(the standard Pallas custom-VJP pattern).
+(batch, seq, heads, head_dim).  Backward is a second Pallas kernel
+(custom_vjp): Q blocks stream against the K/V panel, P is reconstituted
+from the forward's saved log-sum-exp, and dK/dV accumulate in VMEM
+across the Q-block grid axis — the (T, T) matrix never touches HBM.
+(Replacing the earlier jnp-recompute backward was worth +11 MFU points
+on the d=1024 LM benchmark, docs/perf.md.)
 """
 from __future__ import annotations
 
@@ -39,7 +43,8 @@ def _attention_jnp(q, k, v, causal):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                  block_q):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -60,10 +65,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
     o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32) / l
     o_ref[0] = o.astype(o_ref.dtype)
+    # log-sum-exp per query row ((block_q, 1) — the trailing unit dim
+    # keeps the block TPU-tileable): the backward kernel reconstitutes
+    # the normalized p = exp(s - lse) without a second softmax pass
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _fold_heads(x):
+    b, t, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+
+def _unfold_heads(x, b, h):
+    bh, t, d = x.shape
+    return jnp.transpose(x.reshape(b, h, t, d), (0, 2, 1, 3))
 
 
 def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
-    """q/k/v: (B, T, H, D) -> (B, T, H, D)."""
+    """q/k/v: (B, T, H, D) -> (o (B, T, H, D), lse (BH, T) f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
@@ -72,14 +91,9 @@ def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
     block_q = min(_BLOCK_Q, t)
     assert t % block_q == 0, "seq length must be a multiple of the Q block"
 
-    # fold heads into batch; kernel works on (BH, T, D)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
                                block_q=block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
         in_specs=[
@@ -87,28 +101,114 @@ def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
             pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+        ],
         interpret=interpret,
-    )(qt, kt, vt)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    )(_fold_heads(q), _fold_heads(k), _fold_heads(v))
+    return _unfold_heads(out, b, h), lse
+
+
+def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, causal, block_q):
+    """One Q block against the full K/V panel; dK/dV accumulate across
+    the Q-block grid axis (their output block revisits per qi)."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    q = q_ref[0].astype(jnp.float32)        # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)        # (T, D)
+    v = v_ref[0].astype(jnp.float32)        # (T, D)
+    do = do_ref[0].astype(jnp.float32)      # (block_q, D)
+    lse = lse_ref[0]                        # (block_q, 1)
+    delta = delta_ref[0]                    # (block_q, 1) = rowsum(do*o)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        t = k.shape[0]
+        row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, t), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
+        s = jnp.where(row >= col, s, -jnp.inf)
+    p = jnp.exp(s - lse)                    # masked entries exp(-inf)=0
+    # dV += P^T dO
+    dv_ref[0] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+    # dP = dO V^T ; dS = P o (dP - delta) * scale
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq_ref[0] = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    dk_ref[0] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+
+def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, interpret):
+    """Flash backward: recompute P per Q block from the saved
+    log-sum-exp, never materializing the (T, T) matrix in HBM — the
+    jnp vjp fallback does, and on long sequences that HBM round trip
+    (not the matmuls) dominates the step (docs/perf.md transformer
+    breakdown)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    block_q = min(_BLOCK_Q, t)
+
+    qt, kt, vt = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    dot = _fold_heads(g)
+    # delta_i = sum_d(dO_i * O_i): rowwise, cheap — computed outside
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * _fold_heads(o).astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    kernel = functools.partial(_flash_bwd_kernel, scale=scale,
+                               causal=causal, block_q=block_q)
+    panel = pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0))
+    qblock = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0))
+    rows = pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0))
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[qblock, panel, panel, qblock, rows, rows],
+        out_specs=[qblock, panel, panel],
+        out_shape=[jax.ShapeDtypeStruct((b * h, t, d), jnp.float32)] * 3,
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    return tuple(_unfold_heads(x, b, h).astype(q.dtype)
+                 for x in (dq, dk, dv))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal=False, interpret=False):
     """Block-wise attention; Pallas on TPU, jnp elsewhere."""
-    return _flash_attention_fwd_pallas(q, k, v, causal, interpret)
+    o, _lse = _flash_attention_fwd_pallas(q, k, v, causal, interpret)
+    return o
 
 
 def _fa_fwd(q, k, v, causal, interpret):
-    return flash_attention(q, k, v, causal, interpret), (q, k, v)
+    o, lse = _flash_attention_fwd_pallas(q, k, v, causal, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _fa_bwd(causal, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _attention_jnp(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal,
+                                       interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -293,6 +293,46 @@ class ShardedTrainer:
                              if n not in self._input_names]
         self._aux_names = [n.name for n in aux_nodes]
 
+        # data inputs consumed as integer indices (Embedding/take/...):
+        # these must NOT be cast to a narrow compute dtype — bf16 rounds
+        # ids above 256, silently corrupting lookups (ADVICE r3).
+        # Carrier tracking walks pass-through (shape-only) ops, so
+        # Embedding(Reshape(data)) still registers the data input.
+        _index_arg_of = {"Embedding": 0, "one_hot": 0, "take": 1,
+                         "gather_nd": 1, "batch_take": 1}
+        _pass_through = frozenset({
+            "Reshape", "Flatten", "expand_dims", "transpose", "BlockGrad",
+            "slice_axis", "slice", "identity", "stop_gradient",
+            "SwapAxis", "squeeze"})
+        carriers = {id(n): n.name for n in self._arg_nodes
+                    if n.name in self._data_names}
+        self._int_inputs = set()
+        self._int_input_bounds = {}   # name -> max Embedding input_dim
+        unbounded = set()             # consumed by a boundless index op
+        for node in self._topo:
+            if node.op is None:
+                continue
+            opname = node.op.name
+            if opname in _pass_through and node.inputs:
+                src = node.inputs[0][0]
+                if id(src) in carriers:
+                    carriers[id(node)] = carriers[id(src)]
+            idx = _index_arg_of.get(opname)
+            if idx is None or idx >= len(node.inputs):
+                continue
+            nm = carriers.get(id(node.inputs[idx][0]))
+            if nm is None:
+                continue
+            self._int_inputs.add(nm)
+            if opname == "Embedding" and nm not in unbounded:
+                self._int_input_bounds[nm] = max(
+                    self._int_input_bounds.get(nm, 0),
+                    int(node.attrs.get("input_dim", 0)))
+            elif opname != "Embedding":
+                # take/one_hot/gather tables carry no declared id range
+                unbounded.add(nm)
+                self._int_input_bounds.pop(nm, None)
+
         # inputs whose activations move to channel-minor under NHWC
         self._nhwc_inputs = set()
         if self._layout == "NHWC":
@@ -375,6 +415,15 @@ class ShardedTrainer:
                 from .tp_rules import derive_tp_rules
                 tp_rules = derive_tp_rules(self._topo, self._arg_shapes,
                                            tp_size)
+                if tp_size > 1 and tp_rules:
+                    # surface the derived layout once: which weights got
+                    # model-axis sharded (and on which dim) decides the
+                    # communication pattern and per-chip memory
+                    import logging
+                    logging.info(
+                        "ShardedTrainer derived tp_rules (Megatron "
+                        "pairing, tp=%d): %s", tp_size,
+                        {k: tp_rules[k] for k in sorted(tp_rules)})
         self.tp_rules = tp_rules
 
         def param_spec(name):
@@ -410,11 +459,7 @@ class ShardedTrainer:
             self.aux = {n: self._put_state(host_aux[n],
                                            self._aux_sharding[n])
                         for n in self._aux_names}
-            self.opt_state = {
-                n: [self._put_state(np.zeros_like(host_params[n]),
-                                    self._param_sharding[n])
-                    for _ in range(self._n_slots)]
-                for n in self._param_names}
+            self.opt_state = self._device_zero_slots()
 
         self._step_fn = self._build_step()
         self._scan_fns = {}
@@ -422,6 +467,26 @@ class ShardedTrainer:
         self._step_count = 0
         self._key = jax.random.PRNGKey(seed)
         self._hyper_snapshot = self._hyper_state()
+
+    def _device_zero_slots(self):
+        """Fresh optimizer slots created ON DEVICE by one jitted program
+        (host-side np.zeros + device_put would ship the whole optimizer
+        state — e.g. 1.5 GB for adam on a 190M-param model — over the
+        host link just to write zeros)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._n_slots == 0:
+            return {n: [] for n in self._param_names}
+
+        def make():
+            return {n: [jnp.zeros(self._arg_shapes[n], jnp.float32)
+                        for _ in range(self._n_slots)]
+                    for n in self._param_names}
+
+        shardings = {n: [self._param_sharding[n]] * self._n_slots
+                     for n in self._param_names}
+        return jax.jit(make, out_shardings=shardings)()
 
     def _put_state(self, value, target):
         """Stage a full host value (identical on every process) as a
@@ -525,12 +590,18 @@ class ShardedTrainer:
             if k is not None:
                 shapes[k] = tuple(val.shape)
 
+        gbatch = self._input_shapes[self._data_names[0]][0]
+
         def absfwd():
             vv = {}
             for node in self._arg_nodes:
                 nm = node.name
                 if nm in self._input_names:
-                    shp = (micro_bsz,) + tuple(self._input_shapes[nm][1:])
+                    # leading dims scale by micro_bsz/gbatch so per-token
+                    # labels declared (batch*seq,) trace at (micro*seq,),
+                    # mirroring the runtime side-array microbatch split
+                    full = self._input_shapes[nm]
+                    shp = (full[0] * micro_bsz // gbatch,) + tuple(full[1:])
                     dt = jnp.float32 if "label" in nm \
                         else jnp.dtype(self.dtype)
                 else:
@@ -595,6 +666,25 @@ class ShardedTrainer:
         if len(self._data_names) != 1:
             raise MXNetError("pipeline path supports one data input")
         dname = self._data_names[0]
+        compute_dtype = jnp.dtype(self.dtype)
+        if compute_dtype.kind == "f" and dname in self._int_inputs:
+            # the pipeline ring buffer carries stage inputs in the
+            # compute dtype; token ids above the dtype's exact-integer
+            # range would be rounded in transit
+            exact = 1 << (jnp.finfo(compute_dtype).nmant + 1)
+            bound = self._int_input_bounds.get(dname)
+            if bound is None or bound > exact:
+                # unknown bound (take/gather consumer) is treated as
+                # over-range: silent id rounding is worse than refusing
+                raise MXNetError(
+                    "pipeline_stages with dtype=%s cannot carry %r as "
+                    "integer ids through the compute-dtype ring buffer: "
+                    "id range %s exceeds (or cannot be proven within) "
+                    "the dtype's exact-integer range %d; use "
+                    "dtype='float32' or a first-stage cut after the "
+                    "lookup" % (self.dtype, dname,
+                                bound if bound is not None else "unknown",
+                                exact))
         gbatch = self._input_shapes[dname][0]
         if gbatch % (dp * m_micro):
             raise MXNetError(
@@ -991,8 +1081,9 @@ class ShardedTrainer:
         # (put_batch would transpose a host NCHW batch a second time)
         zero_batch = {
             n: jax.device_put(
-                jnp.zeros(s, jnp.dtype(self.dtype)
-                          if "label" not in n else jnp.float32),
+                jnp.zeros(s, jnp.float32
+                          if ("label" in n or n in self._int_inputs)
+                          else jnp.dtype(self.dtype)),
                 self._batch_sharding[n])
             for n, s in self._input_shapes.items()}
         def as_spec(tree):
@@ -1047,12 +1138,7 @@ class ShardedTrainer:
         self._n_slots, self._update_rule = _make_update_rule(opt)
         if self._n_slots != old_slots:
             with self.mesh:
-                self.opt_state = {
-                    n: [self._put_state(
-                            np.zeros(self._arg_shapes[n], np.float32),
-                            self._param_sharding[n])
-                        for _ in range(self._n_slots)]
-                    for n in self._param_names}
+                self.opt_state = self._device_zero_slots()
         self._step_fn = self._build_step()
         self._scan_fns = {}
         self._hyper_snapshot = self._hyper_state()
@@ -1066,7 +1152,11 @@ class ShardedTrainer:
         out = {}
         for k, v in batch.items():
             v = np.asarray(v)
-            if "label" not in k and v.dtype.kind == "f":
+            if "label" not in k and v.dtype.kind == "f" \
+                    and k not in self._int_inputs:
+                # integer-semantic inputs (token ids feeding Embedding/
+                # take) stay float32: exact for ids < 2^24, while bf16
+                # rounds ids above 256
                 v = v.astype(self.dtype)
             out[k] = v
         return out

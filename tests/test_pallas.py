@@ -23,6 +23,27 @@ def test_flash_attention_interpret(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_backward_kernel(causal):
+    """The Pallas flash backward (Q-block streaming, dK/dV accumulation
+    over the grid, P reconstituted from the saved log-sum-exp) must
+    match the dense jnp attention vjp."""
+    import jax
+    from mxnet_tpu.ops.pallas_kernels import (flash_attention,
+                                              _attention_jnp)
+    q, k, v = _qkv(T=256)
+    rng = np.random.RandomState(7)
+    g = rng.normal(0, 1, q.shape).astype(np.float32)
+
+    _o, vjp = jax.vjp(lambda q, k, v:
+                      flash_attention(q, k, v, causal, True), q, k, v)
+    _r, ref_vjp = jax.vjp(lambda q, k, v:
+                          _attention_jnp(q, k, v, causal), q, k, v)
+    for got, want in zip(vjp(g), ref_vjp(g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_flash_attention_op_fallback():
     q, k, v = _qkv(T=32)
     out = mx.nd._contrib_FlashAttention(mx.nd.array(q), mx.nd.array(k),

@@ -21,9 +21,16 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--model", default="resnet",
+                    choices=["resnet", "transformer"])
+    ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--image", type=int, default=224)
-    ap.add_argument("--layers", type=int, default=50)
+    ap.add_argument("--layers", type=int, default=None,
+                    help="resnet depth (50) / transformer layers (12)")
+    ap.add_argument("--d-model", type=int, default=1024)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--vocab", type=int, default=16384)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--layout", default="NHWC")
@@ -35,20 +42,38 @@ def main():
     from mxnet_tpu import models
     from mxnet_tpu.parallel import ShardedTrainer, build_mesh
 
-    batch, image = args.batch, args.image
-    net = models.get_model("resnet%d" % args.layers, num_classes=1000,
-                           image_shape="3,%d,%d" % (image, image))
-    trainer = ShardedTrainer(
-        net, build_mesh(tp=1),
-        data_shapes={"data": (batch, 3, image, image)},
-        label_shapes={"softmax_label": (batch,)},
-        learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
-        dtype=args.dtype, layout=args.layout or None)
-
     rng = np.random.RandomState(0)
-    staged = trainer.put_batch({
-        "data": rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32),
-        "softmax_label": rng.randint(0, 1000, batch).astype(np.float32)})
+    if args.model == "transformer":
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "examples", "transformer"))
+        from train_lm import gpt_symbol
+        batch = args.batch or 16
+        layers = args.layers or 12
+        net = gpt_symbol(args.vocab, args.seq, args.d_model, args.heads,
+                         layers, dropout=0.0, attention="flash")
+        trainer = ShardedTrainer(
+            net, build_mesh(tp=1),
+            data_shapes={"data": (batch, args.seq)},
+            label_shapes={"softmax_label": (batch, args.seq)},
+            optimizer="adam", learning_rate=1e-4, dtype=args.dtype)
+        x = rng.randint(0, args.vocab, (batch, args.seq)).astype("f")
+        staged = trainer.put_batch({
+            "data": x, "softmax_label": np.roll(x, -1, 1).copy()})
+    else:
+        batch, image = args.batch or 128, args.image
+        net = models.get_model("resnet%d" % (args.layers or 50),
+                               num_classes=1000,
+                               image_shape="3,%d,%d" % (image, image))
+        trainer = ShardedTrainer(
+            net, build_mesh(tp=1),
+            data_shapes={"data": (batch, 3, image, image)},
+            label_shapes={"softmax_label": (batch,)},
+            learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+            dtype=args.dtype, layout=args.layout or None)
+        staged = trainer.put_batch({
+            "data": rng.uniform(-1, 1, (batch, 3, image, image))
+                       .astype(np.float32),
+            "softmax_label": rng.randint(0, 1000, batch).astype(np.float32)})
     float(trainer.step(staged))  # compile
     float(trainer.step(staged))
 
@@ -118,6 +143,8 @@ def main():
                     cat["copies/slices"] += dur
                 elif nm.startswith("select_and_scatter"):
                     cat["maxpool bwd"] += dur
+                elif nm.startswith("custom-call"):
+                    cat["custom-call (pallas etc)"] += dur
                 else:
                     cat[nm.split(".")[0][:28]] += dur
     total = sum(cat.values())
