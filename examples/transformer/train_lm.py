@@ -86,6 +86,30 @@ def gpt_symbol(vocab_size, seq_len, d_model=128, n_heads=4, n_layers=2,
     return mx.sym.SoftmaxOutput(logits, label=label, name="softmax")
 
 
+def build_bench_trainer(vocab=16384, seq=1024, d_model=1024, heads=16,
+                        layers=12, batch=16, dtype="bfloat16",
+                        auto_layouts=False):
+    """(fused trainer, staged synthetic batch) at benchmark scale — ONE
+    definition shared by tools/transformer_mfu.py and tools/xprof_top.py
+    so the profiled program and the benchmarked program are identical
+    by construction."""
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    net = gpt_symbol(vocab, seq, d_model, heads, layers, dropout=0.0,
+                     attention="flash")
+    trainer = ShardedTrainer(
+        net, build_mesh(n_devices=1),
+        data_shapes={"data": (batch, seq)},
+        label_shapes={"softmax_label": (batch, seq)},
+        optimizer="adam", learning_rate=1e-4, dtype=dtype,
+        auto_layouts=auto_layouts)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, vocab, (batch, seq)).astype("f")
+    staged = trainer.put_batch({"data": x,
+                                "softmax_label": np.roll(x, -1, 1).copy()})
+    return trainer, staged
+
+
 def markov_batches(n_tokens, vocab_size, seq_len, batch_size, seed=0):
     rng = np.random.RandomState(seed)
     trans = np.random.RandomState(42).dirichlet(

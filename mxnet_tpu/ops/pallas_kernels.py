@@ -82,7 +82,9 @@ def _unfold_heads(x, b, h):
 
 
 def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
-    """q/k/v: (B, T, H, D) -> (o (B, T, H, D), lse (BH, T) f32)."""
+    """q/k/v: (B, T, H, D) -> (o (B, T, H, D), lse (BH, T, 1) f32 —
+    the trailing unit dim keeps the backward's row-stat BlockSpecs
+    TPU-tileable)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 

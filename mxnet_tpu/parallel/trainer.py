@@ -673,7 +673,9 @@ class ShardedTrainer:
             # range would be rounded in transit
             exact = 1 << (jnp.finfo(compute_dtype).nmant + 1)
             bound = self._int_input_bounds.get(dname)
-            if bound is None or bound > exact:
+            # ids run 0..input_dim-1, and integers up to `exact` are
+            # representable, so input_dim == exact+1 is still safe
+            if bound is None or bound > exact + 1:
                 # unknown bound (take/gather consumer) is treated as
                 # over-range: silent id rounding is worse than refusing
                 raise MXNetError(

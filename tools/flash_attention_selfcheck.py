@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""On-device flash-attention kernel self-check.
+
+CI validates the Pallas forward+backward kernels in interpret mode
+(tests/test_pallas.py), which exercises the kernel MATH but not the
+Mosaic lowering — in particular the backward's dK/dV accumulation into
+a revisited output block across the Q-block grid axis.  This artifact
+runs the real compiled kernels on the attached chip and checks the
+full vjp against the dense jnp oracle, so a Mosaic/libtpu semantics
+change cannot rot silently while CI stays green.
+
+Usage: python tools/flash_attention_selfcheck.py   # on the TPU host
+Prints one JSON line; nonzero exit on mismatch.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(0)
+    results = {}
+    worst = 0.0
+    for causal in (False, True):
+        for (B, T, Hh, D) in ((4, 1024, 16, 64), (2, 256, 4, 128)):
+            q, k, v, g = (jnp.asarray(rng.randn(B, T, Hh, D),
+                                      jnp.bfloat16) for _ in range(4))
+
+            def f(q, k, v):
+                return jnp.sum(pk.flash_attention(q, k, v, causal)
+                               .astype(jnp.float32)
+                               * g.astype(jnp.float32))
+
+            def r(q, k, v):
+                return jnp.sum(pk._attention_jnp(q, k, v, causal)
+                               .astype(jnp.float32)
+                               * g.astype(jnp.float32))
+
+            got = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+            want = jax.jit(jax.grad(r, argnums=(0, 1, 2)))(q, k, v)
+            errs = []
+            for a, b in zip(got, want):
+                a = np.asarray(a, np.float32)
+                b = np.asarray(b, np.float32)
+                assert np.isfinite(a).all()
+                errs.append(float(np.abs(a - b).max()
+                                  / max(1e-6, np.abs(b).max())))
+            key = "causal=%s_B%dT%dH%dD%d" % (causal, B, T, Hh, D)
+            results[key] = round(max(errs), 5)
+            worst = max(worst, max(errs))
+
+    ok = worst < 2e-2   # bf16 rounding band
+    print(json.dumps({"metric": "flash_attention_vjp_selfcheck",
+                      "ok": ok, "worst_rel_err": round(worst, 5),
+                      "cases": results}))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

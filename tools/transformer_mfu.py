@@ -71,28 +71,17 @@ def main():
     p.add_argument("--json-only", action="store_true")
     a = p.parse_args()
 
-    from train_lm import gpt_symbol
-    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+    from train_lm import build_bench_trainer
 
     def note(msg):
         if not a.json_only:
             print("[mfu] " + msg, flush=True)
 
-    net = gpt_symbol(a.vocab, a.seq, a.d_model, a.heads, a.layers,
-                     dropout=0.0, attention="flash")
-    mesh = build_mesh(n_devices=1)
     note("building trainer (param upload rides the host link)...")
-    trainer = ShardedTrainer(
-        net, mesh,
-        data_shapes={"data": (a.batch, a.seq)},
-        label_shapes={"softmax_label": (a.batch, a.seq)},
-        optimizer="adam", learning_rate=1e-4, dtype=a.dtype,
+    trainer, batch = build_bench_trainer(
+        vocab=a.vocab, seq=a.seq, d_model=a.d_model, heads=a.heads,
+        layers=a.layers, batch=a.batch, dtype=a.dtype,
         auto_layouts=bool(a.auto_layouts))
-
-    rng = np.random.RandomState(0)
-    x = rng.randint(0, a.vocab, (a.batch, a.seq)).astype("f")
-    y = np.roll(x, -1, axis=1).copy()
-    batch = trainer.put_batch({"data": x, "softmax_label": y})
 
     # compile + warm
     note("compiling the %d-step scan + first run..." % a.steps)

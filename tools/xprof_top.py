@@ -46,19 +46,11 @@ def main():
     if args.model == "transformer":
         sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
                                         "examples", "transformer"))
-        from train_lm import gpt_symbol
-        batch = args.batch or 16
-        layers = args.layers or 12
-        net = gpt_symbol(args.vocab, args.seq, args.d_model, args.heads,
-                         layers, dropout=0.0, attention="flash")
-        trainer = ShardedTrainer(
-            net, build_mesh(tp=1),
-            data_shapes={"data": (batch, args.seq)},
-            label_shapes={"softmax_label": (batch, args.seq)},
-            optimizer="adam", learning_rate=1e-4, dtype=args.dtype)
-        x = rng.randint(0, args.vocab, (batch, args.seq)).astype("f")
-        staged = trainer.put_batch({
-            "data": x, "softmax_label": np.roll(x, -1, 1).copy()})
+        from train_lm import build_bench_trainer
+        trainer, staged = build_bench_trainer(
+            vocab=args.vocab, seq=args.seq, d_model=args.d_model,
+            heads=args.heads, layers=args.layers or 12,
+            batch=args.batch or 16, dtype=args.dtype)
     else:
         batch, image = args.batch or 128, args.image
         net = models.get_model("resnet%d" % (args.layers or 50),
