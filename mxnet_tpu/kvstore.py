@@ -159,9 +159,22 @@ class KVStore:
 
 
 def create(name="local"):
-    """Create a KVStore (reference kvstore.cc:17-45 name dispatch)."""
+    """Create a KVStore (reference kvstore.cc:17-45 name dispatch).
+
+    ``dist_sync``: jitted pytree AllReduce over jax.distributed
+    (parallel/dist_kvstore.py).  ``dist_async`` under a launch.py job:
+    the host-driven asynchronous parameter server
+    (parallel/async_kvstore.py — per-push server-side updates, the
+    reference kvstore_dist_server.h:200-208 contract); single-process
+    ``dist_async`` falls through to the sync facade with its warning.
+    """
     if not isinstance(name, str):
         raise TypeError("name must be a string")
+    if "async" in name:
+        from . import config
+        if (config.get_int("MXNET_TPU_NUM_PROCESSES") or 1) > 1:
+            from .parallel.async_kvstore import AsyncKVStore
+            return AsyncKVStore(name)
     if "dist" in name:
         from .parallel.dist_kvstore import DistKVStore
         return DistKVStore(name)

@@ -1,0 +1,324 @@
+"""True ``dist_async``: a host-driven asynchronous parameter server.
+
+Reference: ``src/kvstore/kvstore_dist_server.h:200-208`` — in async
+mode the server applies EVERY push to the weights immediately (no
+aggregation gate), and workers pull whatever the weights are at that
+moment; staleness is the accepted price for never blocking on peers.
+The TPU-native sync path (one jitted psum) replaces dist_sync, but
+async has no collective analogue BY CONSTRUCTION — collectives are
+globally synchronous — so this module keeps the reference's host-side
+architecture: a parameter-server thread in the rank-0 process, workers
+pushing/pulling numpy tensors over TCP, the optimizer running
+server-side per push (``set_optimizer`` ships a pickled optimizer,
+exactly the reference's pickled-command protocol,
+``python/mxnet/kvstore.py:226-270``).  Gradients never touch the
+accelerator on this path — it is a host protocol, as in the reference.
+
+Wire format: 8-byte big-endian length + pickle.  One persistent
+connection per worker; the server runs one thread per connection and
+serializes updates with a lock (the reference server is also a single
+consumer per key, kvstore_dist_server.h ``exec_``).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from ..kvstore import KVStore, _ctype_key_value, _group_kv_pairs
+
+__all__ = ["AsyncKVStore", "ParameterServer"]
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack(">Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class ParameterServer:
+    """The server role (runs as a thread inside the rank-0 process)."""
+
+    def __init__(self, num_workers, port, host="0.0.0.0"):
+        self.num_workers = num_workers
+        self._store = {}
+        self._updater = None
+        self._updater_obj = None
+        self._lock = threading.Lock()
+        self.update_count = 0
+        self._barrier_gen = 0
+        self._barrier_count = 0
+        self._barrier_cv = threading.Condition()
+        self._byes = 0
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            self._listener.bind((host, port))
+        except OSError as e:
+            raise MXNetError(
+                "dist_async parameter server cannot bind %s:%d (%s) — "
+                "set MXNET_TPU_ASYNC_PORT to a free port"
+                % (host, port, e)) from e
+        self._listener.listen(num_workers + 1)
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        for _ in range(self.num_workers):
+            conn, _addr = self._listener.accept()
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._listener.close()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == "init":
+                    from .. import ndarray as _nd
+                    _key, val = msg[1], msg[2]
+                    with self._lock:
+                        # first writer wins (every worker inits); the
+                        # store holds NDArrays — updaters/optimizers
+                        # expect the NDArray surface (context, state
+                        # creation), exactly as on the reference server
+                        self._store.setdefault(_key, _nd.array(val))
+                    _send_msg(conn, ("ok",))
+                elif op == "push":
+                    from .. import ndarray as _nd
+                    _key, grad = msg[1], msg[2]
+                    with self._lock:
+                        if _key not in self._store:
+                            _send_msg(conn, ("err",
+                                             "key %r not inited" % _key))
+                            continue
+                        # ASYNC CONTRACT: applied immediately, per push
+                        if self._updater is not None:
+                            self._updater(_key, _nd.array(grad),
+                                          self._store[_key])
+                        else:
+                            # no updater installed: assign, matching the
+                            # facade's assign-vs-updater contract
+                            self._store[_key] = _nd.array(grad)
+                        self.update_count += 1
+                    _send_msg(conn, ("ok",))
+                elif op == "pull":
+                    with self._lock:
+                        if msg[1] not in self._store:
+                            _send_msg(conn, ("err",
+                                             "key %r not inited" % (msg[1],)))
+                            continue
+                        val = self._store[msg[1]].asnumpy()
+                    _send_msg(conn, ("val", val))
+                elif op == "set_optimizer":
+                    from .. import optimizer as opt_mod
+                    optimizer = pickle.loads(msg[1])
+                    with self._lock:
+                        # idempotent across workers: one shared updater
+                        if self._updater is None:
+                            self._updater_obj = opt_mod.get_updater(
+                                optimizer)
+                            self._updater = self._updater_obj
+                    _send_msg(conn, ("ok",))
+                elif op == "barrier":
+                    with self._barrier_cv:
+                        gen = self._barrier_gen
+                        self._barrier_count += 1
+                        if self._barrier_count == self.num_workers:
+                            self._barrier_count = 0
+                            self._barrier_gen += 1
+                            self._barrier_cv.notify_all()
+                        else:
+                            while self._barrier_gen == gen:
+                                self._barrier_cv.wait()
+                    _send_msg(conn, ("ok",))
+                elif op == "stats":
+                    with self._lock:
+                        _send_msg(conn, ("val",
+                                         {"updates": self.update_count,
+                                          "keys": len(self._store)}))
+                elif op == "opt_states":
+                    with self._lock:
+                        st = (self._updater_obj.get_states()
+                              if self._updater_obj is not None else b"")
+                    _send_msg(conn, ("val", st))
+                elif op == "set_opt_states":
+                    with self._lock:
+                        if self._updater_obj is None:
+                            _send_msg(conn, ("err", "set_optimizer must "
+                                             "run before state restore"))
+                            continue
+                        self._updater_obj.set_states(msg[1])
+                    _send_msg(conn, ("ok",))
+                elif op == "bye":
+                    _send_msg(conn, ("ok",))
+                    with self._lock:
+                        self._byes += 1
+                    return
+                else:
+                    _send_msg(conn, ("err", "unknown op %r" % (op,)))
+        except (ConnectionError, OSError):
+            return
+        except Exception as e:   # surface server-side faults to the worker
+            try:
+                _send_msg(conn, ("err", "server error on %r: %r"
+                                 % (msg[:1], e)))
+            except Exception:
+                pass
+            return
+        finally:
+            conn.close()
+
+
+class AsyncKVStore(KVStore):
+    """Worker-side ``dist_async`` client (reference kvstore_dist.h
+    worker role under ``--launcher`` env, without the sync gate)."""
+
+    def __init__(self, kv_type="dist_async"):
+        super().__init__(kv_type)
+        from .. import config
+
+        self._rank = config.get_int("MXNET_TPU_PROCESS_ID", 0)
+        self._num_workers = config.get_int("MXNET_TPU_NUM_PROCESSES", 1)
+        coordinator = config.get("MXNET_TPU_COORDINATOR") or \
+            "127.0.0.1:8431"
+        host, cport = coordinator.rsplit(":", 1)
+        port = config.get_int("MXNET_TPU_ASYNC_PORT") or int(cport) + 1
+        self._server = None
+        if self._rank == 0:
+            self._server = ParameterServer(self._num_workers, port,
+                                           host="0.0.0.0")
+        self._sock = self._connect(host, port)
+
+    @staticmethod
+    def _connect(host, port, timeout=60.0):
+        deadline = time.time() + timeout
+        while True:
+            try:
+                s = socket.create_connection((host, port), timeout=5)
+                # blocking RPCs (barrier waits on the slowest worker —
+                # the point of async mode) must not inherit the connect
+                # timeout
+                s.settimeout(None)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except OSError:
+                if time.time() > deadline:
+                    raise MXNetError(
+                        "dist_async: cannot reach the parameter server "
+                        "at %s:%d (rank 0 hosts it; launch via "
+                        "tools/launch.py)" % (host, port))
+                time.sleep(0.2)
+
+    def _rpc(self, *msg):
+        _send_msg(self._sock, msg)
+        resp = _recv_msg(self._sock)
+        if resp[0] == "err":
+            raise MXNetError("dist_async server: %s" % resp[1])
+        return resp[1] if len(resp) > 1 else None
+
+    # ------------------------------------------------------------------ api
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            self._rpc("init", k, v.asnumpy())
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        uniq, grouped = _group_kv_pairs(keys, vals)
+        for k, group in zip(uniq, grouped):
+            merged = group[0].asnumpy()
+            for other in group[1:]:
+                merged = merged + other.asnumpy()
+            self._rpc("push", k, merged)
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        cache = {}
+        for k, o in zip(keys, outs):
+            if k not in cache:
+                cache[k] = self._rpc("pull", k)
+            o[:] = cache[k]
+
+    def set_optimizer(self, optimizer):
+        # ship the optimizer to the server (reference pickled-command
+        # protocol); updates happen server-side per push.  The attached
+        # Symbol (attribute hints only) holds op closures — the server
+        # needs the update rule, not the graph, so drop it
+        import copy
+        optimizer = copy.copy(optimizer)
+        optimizer.sym = None
+        self._rpc("set_optimizer", pickle.dumps(optimizer, protocol=4))
+
+    def set_updater(self, updater):
+        raise MXNetError("dist_async applies updates on the server; "
+                         "use set_optimizer")
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def server_stats(self):
+        """{'updates': per-push update count, 'keys': n} — observability
+        for the async contract (updates grow per push, not per round)."""
+        return self._rpc("stats")
+
+    def save_optimizer_states(self, fname):
+        if self._rank != 0:
+            return           # rank 0 writes; no N-way state transfer
+        with open(fname, "wb") as f:
+            f.write(self._rpc("opt_states"))
+
+    def load_optimizer_states(self, fname):
+        # restore SERVER-side updater states (call after set_optimizer,
+        # as Module.init_optimizer's preload path does)
+        with open(fname, "rb") as f:
+            self._rpc("set_opt_states", f.read())
+
+    def close(self):
+        try:
+            self._rpc("bye")
+            self._sock.close()
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

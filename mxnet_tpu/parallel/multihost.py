@@ -51,10 +51,18 @@ def ensure_initialized():
             "MXNET_TPU_NUM_PROCESSES=%d but MXNET_TPU_COORDINATOR is "
             "unset; launch via tools/launch.py or export the "
             "coordinator address" % nproc)
+    kwargs = {}
+    hb = config.get_int("MXNET_TPU_HEARTBEAT_TIMEOUT")
+    if hb:
+        # failure detection: a dead peer is declared failed after this
+        # many seconds without heartbeats (the reference's ps-lite
+        # heartbeat role, kvstore_dist.h:159-169); default 100 s
+        kwargs["heartbeat_timeout_seconds"] = hb
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=nproc,
-        process_id=config.get_int("MXNET_TPU_PROCESS_ID", 0))
+        process_id=config.get_int("MXNET_TPU_PROCESS_ID", 0),
+        **kwargs)
 
 
 def spans_processes(mesh):

@@ -1,0 +1,130 @@
+"""Bucketing acid test (VERDICT r3 #4).
+
+The reference treats BucketingModule as the dynamic-shape acid test
+(docs/how_to/bucketing.md, example/rnn/lstm_bucketing.py): many
+sequence lengths, ONE parameter set, per-bucket executors.  On this
+backend each bucket is a separate jitted program, so the properties
+that must hold are:
+
+* the jit cache is bounded by the bucket count — revisiting buckets
+  across epochs compiles NOTHING new (a recompile per batch would be
+  the classic dynamic-shape failure mode);
+* parameters are genuinely shared — every bucket trains the same
+  arrays, and training on all buckets reaches a perplexity threshold
+  on a corpus with learnable structure;
+* ``switch_bucket`` works mid-training.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+import jax._src.test_util as jtu
+
+BUCKETS = [4, 8, 12, 16]
+VOCAB = 24
+
+
+def _sym_gen(seq_len):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    emb = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=32,
+                           name="embed")
+    cell = mx.rnn.LSTMCell(num_hidden=48, prefix="lstm_")
+    outputs, _ = cell.unroll(seq_len, inputs=emb, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, 48))
+    pred = mx.sym.FullyConnected(pred, num_hidden=VOCAB, name="pred")
+    lab = mx.sym.Reshape(label, shape=(-1,))
+    return (mx.sym.SoftmaxOutput(pred, label=lab, name="softmax"),
+            ("data",), ("softmax_label",))
+
+
+def _corpus(n=400, seed=0):
+    """Deterministic-successor sentences: tok[i+1] = 3*tok[i]+1 mod V
+    (ppl -> 1 for a model that learns it) with varied lengths filling
+    all four buckets."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ln = int(rng.choice([3, 4, 6, 8, 10, 12, 14, 16]))
+        t = int(rng.randint(1, VOCAB))
+        s = [t]
+        for _ in range(ln - 1):
+            t = (3 * t + 1) % VOCAB
+            s.append(max(t, 1))   # 0 is the pad label
+        out.append(s)
+    return out
+
+
+@pytest.mark.timeout(600)
+def test_bucketing_acid():
+    it = mx.rnn.BucketSentenceIter(_corpus(), batch_size=16,
+                                   buckets=list(BUCKETS),
+                                   invalid_label=0)
+    mod = mx.module.BucketingModule(
+        _sym_gen, default_bucket_key=it.default_bucket_key,
+        context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.02})
+    metric = mx.metric.Perplexity(ignore_label=0)
+
+    with jtu.count_jit_and_pmap_lowerings() as lowerings:  # yields a callable
+        ppls = []
+        for epoch in range(6):
+            it.reset()
+            metric.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+                mod.update_metric(metric, batch.label)
+            ppls.append(metric.get()[1])
+            if epoch == 0:
+                after_first_epoch = lowerings()
+        total = lowerings()
+
+    # --- jit-cache bound: everything compiles in epoch 0, and five
+    # more epochs over the same buckets add NOTHING
+    assert len(mod._buckets) == len(BUCKETS), mod._buckets.keys()
+    assert total == after_first_epoch, \
+        "recompilation after epoch 0: %d -> %d lowerings" \
+        % (after_first_epoch, total)
+    # a constant number of programs per bucket (fwd-bwd step, optimizer
+    # update, metric pieces — measured 21 for 4 buckets), NOT per-batch
+    assert total <= 6 * len(BUCKETS), total
+
+    # --- convergence on the learnable successor rule
+    assert ppls[-1] < 1.35, ppls
+    assert ppls[-1] < ppls[0] / 3, ppls
+
+    # --- shared params: every bucket module exposes the same values
+    ref_args, _ = mod.get_params()
+    for key, m in mod._buckets.items():
+        args, _ = m.get_params()
+        assert set(args) == set(ref_args)
+        for name in ref_args:
+            np.testing.assert_array_equal(args[name].asnumpy(),
+                                          ref_args[name].asnumpy(),
+                                          err_msg="%s@%s" % (name, key))
+
+    # --- switch_bucket mid-training: move to a specific bucket, train
+    # a step there, and confirm no new compilation happened
+    with jtu.count_jit_and_pmap_lowerings() as lowerings2:
+        for want in (4, 16, 8):
+            mod.switch_bucket(want, None, None)
+            assert mod._curr_bucket_key == want
+        it.reset()
+        batch = next(iter(it))
+        mod.forward_backward(batch)
+        mod.update()
+    assert lowerings2() == 0, lowerings2()
+
+
+def test_bucketing_default_key_covers_longest():
+    """The default bucket key is the largest bucket (its executor can
+    stand in for shape inference), matching the reference contract."""
+    it = mx.rnn.BucketSentenceIter(_corpus(80), batch_size=8,
+                                   buckets=list(BUCKETS),
+                                   invalid_label=0)
+    assert it.default_bucket_key == max(BUCKETS)

@@ -30,17 +30,62 @@ def dmlc_opts(opts):
 
 def launch_local(opts, command):
     """Fork N workers on this host (reference dmlc_tracker local mode —
-    multi-node semantics without a cluster, SURVEY §4.6)."""
+    multi-node semantics without a cluster, SURVEY §4.6).
+
+    Supervises the job the way the reference tracker does: if any
+    worker dies (crash, OOM kill, nonzero exit), the remaining workers
+    are torn down after a short grace period and the job exits nonzero
+    with a clear message — a synchronous peer would otherwise block in
+    a collective against the dead rank.  Recovery is checkpoint/resume
+    (docs/how_to/multi_device.md)."""
+    import signal
+    import time
+
     procs = []
     base_env = dmlc_opts(opts)
     for rank in range(opts.num_workers):
         env = dict(base_env)
         env["MXNET_TPU_PROCESS_ID"] = str(rank)
-        procs.append(subprocess.Popen(command, shell=True, env=env))
-    code = 0
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
+        # each worker gets its own process group so teardown reaches the
+        # python under the shell=True sh wrapper, not just the wrapper
+        procs.append(subprocess.Popen(command, shell=True, env=env,
+                                      preexec_fn=os.setsid))
+
+    def signal_group(p, sig):
+        try:
+            os.killpg(os.getpgid(p.pid), sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    code, failed_rank = 0, None
+    live = dict(enumerate(procs))
+    while live:
+        for rank in list(live):
+            rc = live[rank].poll()
+            if rc is None:
+                continue
+            del live[rank]
+            if rc != 0 and failed_rank is None:
+                failed_rank, code = rank, rc
+                sys.stderr.write(
+                    "launch.py: worker %d exited with code %d "
+                    "(signal %s); aborting job — surviving workers "
+                    "would block on the dead rank's collectives. "
+                    "Resume from the last checkpoint.\n"
+                    % (rank, rc, -rc if rc < 0 else "none"))
+                sys.stderr.flush()
+                for other in live.values():
+                    signal_group(other, signal.SIGTERM)
+                grace = time.time() + 10
+                for other in live.values():
+                    try:
+                        other.wait(max(0.1, grace - time.time()))
+                    except subprocess.TimeoutExpired:
+                        signal_group(other, signal.SIGKILL)
+            elif rc != 0:
+                code = code or rc
+        if live:
+            time.sleep(0.2)
     return code
 
 
