@@ -22,7 +22,8 @@ from .base import MXNetError
 from . import ndarray
 from .ndarray import NDArray, array
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
+__all__ = ["DataDesc", "DataBatch", "DataIter", "DevicePrefetchIter",
+           "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter"]
 
 
@@ -289,6 +290,113 @@ def _init_data(data, allow_empty, default_name):
     for k, v in data.items():
         out[k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
     return list(sorted(out.items()))
+
+
+class DevicePrefetchIter:
+    """Stage up to ``depth`` batches AHEAD onto the devices.
+
+    The reference prefetcher's role (``src/io/iter_prefetcher.h:27-130``)
+    extended across the device boundary: a background thread pulls host
+    batches from ``it`` and runs ``stage_fn`` — typically
+    ``ShardedTrainer.put_batch`` (device-side transpose/normalize +
+    transfer) — so JPEG decode AND host→device transfer overlap the
+    previous step's compute instead of serializing with it.  Iterating
+    yields whatever ``stage_fn`` returns (a dict of staged device
+    arrays, feedable straight to ``trainer.step``).
+    """
+
+    def __init__(self, it, stage_fn, depth=2):
+        import queue as _queue
+        self._it = it
+        self._stage = stage_fn
+        self._depth = max(1, int(depth))
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._thread = None
+        self._stop = False
+        self._exhausted = False
+        self._start()
+
+    def _to_host_dict(self, batch):
+        out = {}
+        for desc, arr in zip(self._it.provide_data, batch.data):
+            out[desc[0] if not hasattr(desc, "name") else desc.name] = \
+                arr.asnumpy()
+        for desc, arr in zip(self._it.provide_label or [], batch.label):
+            out[desc[0] if not hasattr(desc, "name") else desc.name] = \
+                arr.asnumpy()
+        return out
+
+    def _put(self, item):
+        """Bounded put that gives up when reset() cancels the worker."""
+        import queue as _queue
+        while not self._stop:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _start(self):
+        self._stop = False
+        self._exhausted = False
+
+        def worker():
+            # payloads are tagged, so a stage_fn returning None or a
+            # tuple is never mistaken for a control message
+            try:
+                for batch in self._it:
+                    if self._stop:
+                        return
+                    staged = self._stage(self._to_host_dict(batch))
+                    if not self._put(("item", staged)):
+                        return
+            except BaseException as e:          # surfaced on the consumer
+                self._put(("error", e))
+                return
+            self._put(("end", None))
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration     # iterator protocol: stays exhausted
+        kind, val = self._queue.get()
+        if kind == "end":
+            self._exhausted = True
+            raise StopIteration
+        if kind == "error":
+            self._exhausted = True
+            raise val
+        return val
+
+    next = __next__
+
+    def reset(self):
+        """Cancel the worker (at most ``depth`` staged batches are
+        discarded — a mid-epoch reset must not decode the rest of the
+        epoch), rewind the wrapped iterator, restart.  A worker error
+        that the consumer never saw is re-raised here rather than
+        silently dropped."""
+        import queue as _queue
+        self._stop = True
+        pending_error = None
+        while self._thread.is_alive() or not self._queue.empty():
+            try:
+                kind, val = self._queue.get(timeout=0.1)
+                if kind == "error":
+                    pending_error = val
+            except _queue.Empty:
+                pass
+        self._thread.join()
+        if pending_error is not None:
+            self._exhausted = True
+            raise pending_error
+        self._it.reset()
+        self._start()
 
 
 class NDArrayIter(DataIter):

@@ -1,0 +1,84 @@
+"""DevicePrefetchIter semantics (reference iter_prefetcher.h role).
+
+Perf on the bench host is documented in docs/perf.md (the tunnel is
+the cap there); these tests pin the CONTRACT: staged batches match the
+wrapped iterator's batches in order, epochs end with StopIteration,
+reset restarts cleanly even when the sentinel was already consumed,
+and worker-thread errors surface on the consumer."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _iter(n=24, batch=4):
+    x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    y = np.arange(n, dtype=np.float32)
+    return mx.io.NDArrayIter(x, y, batch_size=batch,
+                             label_name="softmax_label")
+
+
+def _stage(host_dict):
+    # stand-in for ShardedTrainer.put_batch: device arrays per input
+    import jax.numpy as jnp
+    return {k: jnp.asarray(v) for k, v in host_dict.items()}
+
+
+def test_prefetch_order_and_epochs():
+    it = _iter()
+    pre = mx.io.DevicePrefetchIter(it, _stage, depth=2)
+    for epoch in range(3):
+        got = [np.asarray(b["data"])[0, 0] for b in pre]
+        assert got == [0.0, 12.0, 24.0, 36.0, 48.0, 60.0], (epoch, got)
+        pre.reset()
+
+
+def test_prefetch_reset_mid_epoch():
+    pre = mx.io.DevicePrefetchIter(_iter(), _stage, depth=2)
+    next(pre)
+    next(pre)
+    pre.reset()          # worker may be blocked on a full queue here
+    got = [np.asarray(b["data"])[0, 0] for b in pre]
+    assert got[0] == 0.0 and len(got) == 6, got
+
+
+def test_prefetch_propagates_worker_errors():
+    def bad_stage(host_dict):
+        raise RuntimeError("staging exploded")
+    pre = mx.io.DevicePrefetchIter(_iter(), bad_stage, depth=2)
+    with pytest.raises(RuntimeError, match="staging exploded"):
+        next(pre)
+    # exhausted after the error: iterator protocol, no hang
+    with pytest.raises(StopIteration):
+        next(pre)
+
+
+def test_prefetch_exhaustion_is_sticky():
+    pre = mx.io.DevicePrefetchIter(_iter(), _stage, depth=2)
+    list(pre)
+    with pytest.raises(StopIteration):
+        next(pre)          # probing again must not hang
+
+
+def test_prefetch_none_and_tuple_payloads():
+    """stage_fn return values are opaque: None and tuples pass through."""
+    pre = mx.io.DevicePrefetchIter(_iter(), lambda d: None, depth=2)
+    assert [b for b in pre] == [None] * 6
+    pre2 = mx.io.DevicePrefetchIter(
+        _iter(), lambda d: ("x", d["data"]), depth=2)
+    got = list(pre2)
+    assert len(got) == 6 and all(g[0] == "x" for g in got)
+
+
+def test_prefetch_reset_reraises_unseen_worker_error():
+    hits = []
+
+    def flaky(d):
+        hits.append(1)
+        if len(hits) == 2:
+            raise RuntimeError("corrupt record")
+        return d
+    pre = mx.io.DevicePrefetchIter(_iter(), flaky, depth=1)
+    next(pre)
+    with pytest.raises(RuntimeError, match="corrupt record"):
+        pre.reset()

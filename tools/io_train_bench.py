@@ -43,20 +43,34 @@ def make_rec(path, n=2000, size=256):
 
 def decoder_scaling(rec, image, batch):
     import mxnet_tpu as mx
-    print("-- decoder-thread scaling (raw_uint8, no training)")
-    for threads in (1, 2, 4):
+    # warm the page cache first: the first configuration measured would
+    # otherwise pay the cold file read and look artificially slow
+    # (this was the round-3 "208 img/s at 1 thread" artifact)
+    with open(rec, "rb") as f:
+        while f.read(1 << 22):
+            pass
+    print("-- decoder-thread scaling (raw_uint8, no training; "
+          "%d host cores)" % (os.cpu_count() or 1))
+    results = {}
+    for threads in (1, 2, 4, 2, 1):   # repeat configs: order effects
         it = mx.io.ImageRecordIter(
             path_imgrec=rec, data_shape=(3, image, image),
             batch_size=batch, preprocess_threads=threads, raw_uint8=True)
         n = 0
         t0 = time.perf_counter()
+        c0 = time.process_time()
         for b in it:
             n += b.data[0].shape[0]
         dt = time.perf_counter() - t0
-        print("   threads=%d  %7.1f img/s" % (threads, n / dt))
+        cpu = time.process_time() - c0
+        results.setdefault(threads, []).append(n / dt)
+        print("   threads=%d  %7.1f img/s   cpu/wall=%.2f cores"
+              % (threads, n / dt, cpu / dt))
+    return results
 
 
-def train_loop(rec, image, batch, layers, train_batches):
+def train_loop(rec, image, batch, layers, train_batches,
+               prefetch_depth=0):
     import mxnet_tpu as mx
     from mxnet_tpu import models
     from mxnet_tpu.parallel import ShardedTrainer, build_mesh
@@ -76,6 +90,40 @@ def train_loop(rec, image, batch, layers, train_batches):
         path_imgrec=rec, data_shape=(3, image, image), batch_size=batch,
         preprocess_threads=max(2, (os.cpu_count() or 1)),
         raw_uint8=True, shuffle=True)
+
+    if prefetch_depth > 0:
+        # compile the staging programs and the step on the MAIN thread
+        # first: concurrent first-compiles from two threads serialize
+        # badly over the remote tunnel
+        b0 = next(it)
+        float(trainer.step(trainer.put_batch(
+            {"data": b0.data[0].asnumpy(),
+             "softmax_label": b0.label[0].asnumpy()})))
+        it.reset()
+        # decode + host->device staging run on the prefetcher thread,
+        # overlapping the step (reference iter_prefetcher.h role)
+        pre = mx.io.DevicePrefetchIter(it, trainer.put_batch,
+                                       depth=prefetch_depth)
+        n, loss, warm, t_wall = 0, None, 2, None
+        while n < train_batches + warm:
+            try:
+                dev = next(pre)
+            except StopIteration:
+                pre.reset()
+                dev = next(pre)
+            loss = trainer.step(dev)
+            n += 1
+            if n == warm:
+                float(loss)
+                t_wall = time.perf_counter()
+        lval = float(loss)
+        wall = time.perf_counter() - t_wall
+        imgs = train_batches * batch
+        print("-- IO-in-the-loop training (DevicePrefetchIter depth=%d)"
+              % prefetch_depth, flush=True)
+        print("   resnet%d batch %d image %d: %7.1f img/s end-to-end "
+              "(loss %.3f)" % (layers, batch, image, imgs / wall, lval))
+        return imgs / wall
 
     t_iter = t_stage = t_step = 0.0
     n = 0
@@ -115,6 +163,7 @@ def train_loop(rec, image, batch, layers, train_batches):
           "asynchronously)" % (1e3 * t_iter / train_batches,
                                1e3 * t_stage / train_batches,
                                1e3 * t_step / train_batches))
+    return imgs / wall
 
 
 def main():
@@ -125,14 +174,22 @@ def main():
     ap.add_argument("--layers", type=int, default=50)
     ap.add_argument("--train-batches", type=int, default=30)
     ap.add_argument("--skip-scaling", action="store_true")
+    ap.add_argument("--prefetch-depth", type=int, default=2,
+                    help="also run the DevicePrefetchIter mode at this "
+                         "depth (0 = sequential only)")
     args = ap.parse_args()
     if not os.path.exists(args.rec):
         print("synthesizing %s ..." % args.rec)
         make_rec(args.rec)
     if not args.skip_scaling:
         decoder_scaling(args.rec, args.image, args.batch)
-    train_loop(args.rec, args.image, args.batch, args.layers,
-               args.train_batches)
+    seq = train_loop(args.rec, args.image, args.batch, args.layers,
+                     args.train_batches)
+    if args.prefetch_depth > 0:
+        pre = train_loop(args.rec, args.image, args.batch, args.layers,
+                         args.train_batches,
+                         prefetch_depth=args.prefetch_depth)
+        print("   prefetch speedup: %.2fx" % (pre / seq))
 
 
 if __name__ == "__main__":
