@@ -1,0 +1,76 @@
+// C++ training example over the header-only binding (the reference
+// cpp-package's train loop role, e.g. cpp-package/example/mlp.cpp):
+// bind from symbol JSON, overfit one batch with SGD-momentum, assert
+// learning, all without touching Python source.
+#include <cmath>
+#include <cstring>
+#include <cstdio>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu_cpp/trainer.hpp"
+
+static std::string slurp(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+static std::vector<float> slurp_floats(const char *path) {
+  std::string raw = slurp(path);
+  std::vector<float> out(raw.size() / sizeof(float));
+  std::memcpy(out.data(), raw.data(), out.size() * sizeof(float));
+  return out;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 7) {
+    std::fprintf(stderr,
+                 "usage: %s symbol.json x.f32 y.f32 batch dim nclass\n",
+                 argv[0]);
+    return 2;
+  }
+  const mx_uint batch = std::atoi(argv[4]);
+  const mx_uint dim = std::atoi(argv[5]);
+  const mx_uint nclass = std::atoi(argv[6]);
+  auto x = slurp_floats(argv[2]);
+  auto y = slurp_floats(argv[3]);
+
+  try {
+    mxnet_tpu_cpp::Trainer trainer(
+        slurp(argv[1]),
+        {{"data", {batch, dim}}, {"softmax_label", {batch}}},
+        /*dev_type=*/1, /*dev_id=*/0, /*seed=*/7);
+
+    float first = -1.f, last = -1.f;
+    for (int step = 0; step < 30; ++step) {
+      trainer.SetInput("data", x);
+      trainer.SetInput("softmax_label", y);
+      trainer.Forward(true);
+      trainer.Backward();
+      auto probs = trainer.GetOutput(0);
+      float loss = 0.f;
+      for (mx_uint i = 0; i < batch; ++i) {
+        float p = probs[i * nclass + static_cast<mx_uint>(y[i])];
+        loss += -std::log(p < 1e-10f ? 1e-10f : p);
+      }
+      loss /= static_cast<float>(batch);
+      if (step == 0) first = loss;
+      last = loss;
+      trainer.SGDUpdate(0.1f, 0.9f, 0.f, 1.0f / batch);
+    }
+    if (!(last < 0.5f * first)) {
+      std::fprintf(stderr, "did not learn: %.4f -> %.4f\n", first, last);
+      return 1;
+    }
+    std::printf("cpp-train OK loss %.4f -> %.4f\n", first, last);
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,96 @@
+// Header-only C++ TRAINING binding over the C train ABI
+// (include/mxnet_tpu/c_train_api.h) — the role of the reference
+// cpp-package's Executor + Optimizer training loop
+// (cpp-package/include/mxnet-cpp/executor.h): a non-Python application
+// links libmxtpu_train.so and trains through this RAII wrapper.
+#ifndef MXNET_TPU_CPP_TRAINER_HPP_
+#define MXNET_TPU_CPP_TRAINER_HPP_
+
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../../include/mxnet_tpu/c_train_api.h"
+
+namespace mxnet_tpu_cpp {
+
+class Trainer {
+ public:
+  // input_shapes: name -> shape for every data/label input
+  Trainer(const std::string &symbol_json,
+          const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+          int dev_type = 1, int dev_id = 0, int seed = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    Check(MXTrainCreate(symbol_json.c_str(), dev_type, dev_id, seed,
+                        static_cast<mx_uint>(keys.size()), keys.data(),
+                        indptr.data(), data.data(), &handle_));
+  }
+
+  ~Trainer() {
+    if (handle_) MXTrainFree(handle_);
+  }
+  Trainer(const Trainer &) = delete;
+  Trainer &operator=(const Trainer &) = delete;
+
+  void SetInput(const std::string &name, const std::vector<float> &v) {
+    Check(MXTrainSetInput(handle_, name.c_str(), v.data(),
+                          static_cast<mx_uint>(v.size())));
+  }
+
+  void Forward(bool is_train) {
+    Check(MXTrainForward(handle_, is_train ? 1 : 0));
+  }
+
+  void Backward() { Check(MXTrainBackward(handle_)); }
+
+  // rescale_grad: loss heads emit per-example gradient sums; pass
+  // 1/batch for averaged updates (the Module default)
+  void SGDUpdate(float lr, float momentum = 0.f, float wd = 0.f,
+                 float rescale_grad = 1.f) {
+    Check(MXTrainSGDUpdate(handle_, lr, momentum, wd, rescale_grad));
+  }
+
+  std::vector<mx_uint> OutputShape(mx_uint index) {
+    mx_uint *shape = nullptr;
+    mx_uint ndim = 0;
+    Check(MXTrainGetOutputShape(handle_, index, &shape, &ndim));
+    return std::vector<mx_uint>(shape, shape + ndim);
+  }
+
+  std::vector<float> GetOutput(mx_uint index) {
+    auto shape = OutputShape(index);
+    mx_uint n = 1;
+    for (mx_uint d : shape) n *= d;
+    std::vector<float> out(n);
+    Check(MXTrainGetOutput(handle_, index, out.data(), n));
+    return out;
+  }
+
+  // kind: "arg" (weights) or "grad" (their gradients)
+  std::vector<float> GetArray(const std::string &kind,
+                              const std::string &name, mx_uint n) {
+    std::vector<float> out(n);
+    Check(MXTrainGetArray(handle_, kind.c_str(), name.c_str(),
+                          out.data(), n));
+    return out;
+  }
+
+ private:
+  static void Check(int rc) {
+    if (rc != 0) throw std::runtime_error(MXTrainGetLastError());
+  }
+
+  TrainHandle handle_ = nullptr;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_TRAINER_HPP_

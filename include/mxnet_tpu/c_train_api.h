@@ -1,0 +1,89 @@
+/*
+ * C TRAINING ABI slice for mxnet_tpu — the native seam beyond inference.
+ *
+ * Role parity: the executor/optimizer subset of include/mxnet/c_api.h
+ * (MXSymbolCreateFromJSON + MXExecutorForward/Backward + the update
+ * loop the reference cpp-package drives, cpp-package/include/mxnet-cpp/
+ * executor.h).  The reference ABI is ~150 functions; this slice is the
+ * minimum a non-Python embedding needs to TRAIN a net: create a bound
+ * executor from symbol JSON (parameters initialized in-library), feed
+ * inputs, run forward/backward, apply SGD(-momentum), and read
+ * outputs/arguments/gradients.  Under the hood an embedded CPython
+ * drives mxnet_tpu.c_train.TrainSession — the same architecture as the
+ * predict ABI (libmxtpu_predict.so).
+ *
+ * Flow:
+ *   MXTrainCreate(json, "cpu", 0, seed, ins, indptr, data, n, &h)
+ *   loop: MXTrainSetInput(h, "data", x, nx)
+ *         MXTrainSetInput(h, "softmax_label", y, ny)
+ *         MXTrainForward(h, 1)
+ *         MXTrainBackward(h)
+ *         MXTrainSGDUpdate(h, lr, momentum, wd, 1.0f/batch)
+ *   MXTrainGetOutput(h, 0, probs, n)       (inference: Forward(h, 0))
+ *   MXTrainFree(h)
+ *
+ * Every entry point returns 0 on success, -1 on failure; see
+ * MXTrainGetLastError().
+ */
+#ifndef MXNET_TPU_C_TRAIN_API_H_
+#define MXNET_TPU_C_TRAIN_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *TrainHandle;
+
+const char *MXTrainGetLastError();
+
+/* Bind a training executor over symbol JSON.
+ * dev_type 1 = cpu, 2 = gpu, 3 = tpu; parameters are Xavier-initialized
+ * with `seed`; inputs (data + labels) are named in input_keys with
+ * shapes packed CSR-style as in MXPredCreate. */
+int MXTrainCreate(const char *symbol_json_str,
+                  int dev_type, int dev_id, int seed,
+                  mx_uint num_input_nodes,
+                  const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data,
+                  TrainHandle *out);
+
+/* Copy `size` floats into input `key`. */
+int MXTrainSetInput(TrainHandle handle, const char *key,
+                    const mx_float *data, mx_uint size);
+
+/* Forward pass; is_train != 0 runs the training graph (dropout etc.). */
+int MXTrainForward(TrainHandle handle, int is_train);
+
+/* Backward pass (loss heads seed their own gradients, as in the
+ * reference Executor::Backward with no out_grads). */
+int MXTrainBackward(TrainHandle handle);
+
+/* SGD(-momentum) update of every parameter from its gradient.
+ * Loss heads produce per-example gradient SUMS (reference
+ * convention), so pass rescale_grad = 1/batch for averaged updates
+ * (1.0f applies the raw sums). */
+int MXTrainSGDUpdate(TrainHandle handle, mx_float lr, mx_float momentum,
+                     mx_float wd, mx_float rescale_grad);
+
+/* Output count / shape / data.  Shape pointers are valid until the next
+ * call on this handle. */
+int MXTrainGetOutputCount(TrainHandle handle, mx_uint *out);
+int MXTrainGetOutputShape(TrainHandle handle, mx_uint index,
+                          mx_uint **shape_data, mx_uint *shape_ndim);
+int MXTrainGetOutput(TrainHandle handle, mx_uint index, mx_float *data,
+                     mx_uint size);
+
+/* Read a named argument ("arg") or gradient ("grad") array. */
+int MXTrainGetArray(TrainHandle handle, const char *kind,
+                    const char *name, mx_float *data, mx_uint size);
+
+int MXTrainFree(TrainHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_TRAIN_API_H_ */

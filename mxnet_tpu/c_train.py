@@ -1,0 +1,114 @@
+"""Python driver for the C training ABI (src/c_train_api.cc).
+
+The reference exposes ~150 flat C functions over its C++ executor
+(include/mxnet/c_api.h); here the executor is jax/XLA reached through
+Python, so the native training library embeds CPython and drives THIS
+class — the same architecture as the predict ABI
+(`mxnet_tpu/predictor.py` ↔ src/c_predict_api.cc).  The slice covers
+what a non-Python embedding needs for a train loop: bind (with
+initialization), set inputs, forward, backward, read outputs/grads/
+args, and an SGD-momentum update — the
+`cpp-package/include/mxnet-cpp/executor.h` Forward/Backward + optimizer
+Update flow.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import symbol as sym_mod
+from . import ndarray as nd
+from .base import MXNetError
+from .context import Context
+from .initializer import Xavier, InitDesc
+
+__all__ = ["TrainSession"]
+
+
+class TrainSession:
+    """One bound training executor + optimizer state for the C ABI."""
+
+    def __init__(self, symbol_json, input_shapes, dev_type="cpu",
+                 dev_id=0, seed=0):
+        self.symbol = sym_mod.load_json(symbol_json)
+        ctx = Context(dev_type, dev_id)
+        self._input_names = list(input_shapes)
+        arg_names = self.symbol.list_arguments()
+        # inputs get grad_req null; parameters write
+        reqs = {n: ("null" if n in input_shapes else "write")
+                for n in arg_names}
+        self.executor = self.symbol.simple_bind(
+            ctx=ctx, grad_req=reqs,
+            **{k: tuple(v) for k, v in input_shapes.items()})
+
+        self._param_names = [n for n in arg_names
+                             if n not in input_shapes]
+        init = Xavier(rnd_type="gaussian", factor_type="in", magnitude=2)
+        np.random.seed(seed)
+        attrs = self.symbol.attr_dict()
+        # initializers write NDArrays in place (Module.init_params does
+        # the same); aux states too — the name-pattern rules set
+        # moving_var to 1, moving_mean to 0
+        for name in self._param_names:
+            init(InitDesc(name, attrs.get(name)),
+                 self.executor.arg_dict[name])
+        for name, arr in zip(self.symbol.list_auxiliary_states(),
+                             self.executor.aux_arrays):
+            init(InitDesc(name, attrs.get(name)), arr)
+        self._momentum = {}
+
+    # ------------------------------------------------------------- inputs
+    def set_input(self, name, value):
+        if name not in self._input_names:
+            raise MXNetError("unknown input %r (have %s)"
+                             % (name, self._input_names))
+        arr = self.executor.arg_dict[name]
+        value = np.asarray(value, np.float32).reshape(arr.shape)
+        arr[:] = value
+
+    # -------------------------------------------------------------- steps
+    def forward(self, is_train):
+        self.executor.forward(is_train=bool(is_train))
+
+    def backward(self):
+        self.executor.backward()
+
+    def sgd_update(self, lr, momentum=0.0, wd=0.0, rescale_grad=1.0):
+        """Apply one SGD(-momentum) step to every bound parameter from
+        its gradient (the reference cpp-package optimizer Update loop,
+        executor-granular rather than fused).  Loss heads emit
+        per-example gradient SUMS (reference convention), so callers
+        normally pass rescale_grad = 1/batch — exactly the
+        Module.init_optimizer default."""
+        for name in self._param_names:
+            w = self.executor.arg_dict[name]
+            g = self.executor.grad_dict[name].asnumpy() * rescale_grad
+            if wd:
+                g = g + wd * w.asnumpy()
+            if momentum:
+                m = momentum * self._momentum.get(
+                    name, np.zeros(w.shape, np.float32)) - lr * g
+                self._momentum[name] = m
+                w[:] = w.asnumpy() + m
+            else:
+                w[:] = w.asnumpy() - lr * g
+
+    # ------------------------------------------------------------ readout
+    def num_outputs(self):
+        return len(self.executor.outputs)
+
+    def get_output(self, index):
+        return np.ascontiguousarray(
+            self.executor.outputs[index].asnumpy(), np.float32)
+
+    def get_output_shape(self, index):
+        return tuple(self.executor.outputs[index].shape)
+
+    def get_array(self, name, kind):
+        d = (self.executor.arg_dict if kind == "arg"
+             else self.executor.grad_dict)
+        if name not in d or d[name] is None:
+            raise MXNetError("no %s array %r" % (kind, name))
+        return np.ascontiguousarray(d[name].asnumpy(), np.float32)
+
+    def arg_names(self):
+        return list(self._param_names)

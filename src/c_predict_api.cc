@@ -21,58 +21,21 @@
 #include <vector>
 
 #include "../include/mxnet_tpu/c_predict_api.h"
+#include "py_embed_common.h"
 
 namespace {
 
-thread_local std::string g_last_error;
+using mxtpu_embed::DevName;
+using mxtpu_embed::EnsurePython;
+using mxtpu_embed::Gil;
+using mxtpu_embed::SetPyError;
+using mxtpu_embed::g_last_error;
 
 struct PredRecord {
   PyObject *predictor = nullptr;          // mxnet_tpu.predictor.Predictor
   std::vector<std::string> input_keys;
   std::vector<mx_uint> out_shape;         // scratch for GetOutputShape
 };
-
-std::once_flag g_py_once;
-
-void EnsurePython() {
-  std::call_once(g_py_once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
-      // works from any thread (including this one) below
-      PyEval_SaveThread();
-    }
-  });
-}
-
-class Gil {
- public:
-  Gil() { state_ = PyGILState_Ensure(); }
-  ~Gil() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
-void SetPyError() {
-  PyObject *type, *value, *tb;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  PyObject *s = value ? PyObject_Str(value) : nullptr;
-  g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
-  Py_XDECREF(s);
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
-
-const char *DevName(int dev_type) {
-  switch (dev_type) {
-    case 2: return "gpu";
-    case 3: return "tpu";
-    default: return "cpu";
-  }
-}
 
 // shapes dict {key: (d0, d1, ...)} from the indptr-packed C arrays
 PyObject *BuildShapesDict(mx_uint num_input_nodes, const char **input_keys,

@@ -1,0 +1,218 @@
+// C TRAINING ABI slice (include/mxnet_tpu/c_train_api.h) —
+// embedded-Python implementation.
+//
+// Role parity: the MXSymbol*/MXExecutor* training subset of the
+// reference's src/c_api/c_api_executor.cc, as consumed by its
+// cpp-package (cpp-package/include/mxnet-cpp/executor.h Forward/
+// Backward + optimizer Update).  Architecture matches
+// src/c_predict_api.cc: one embedded CPython per process drives
+// mxnet_tpu.c_train.TrainSession; error convention: catch everything,
+// stash for MXTrainGetLastError, return -1.
+//
+// Build: `make libmxtpu_train.so` (src/Makefile); run with PYTHONPATH
+// reaching the mxnet_tpu package (tests/test_c_train.py shows the
+// exact flow from C).
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_train_api.h"
+#include "py_embed_common.h"
+
+namespace {
+
+using mxtpu_embed::DevName;
+using mxtpu_embed::EnsurePython;
+using mxtpu_embed::Gil;
+using mxtpu_embed::Ref;
+using mxtpu_embed::SetPyError;
+using mxtpu_embed::g_last_error;
+
+struct TrainRecord {
+  PyObject *session = nullptr;       // mxnet_tpu.c_train.TrainSession
+  std::vector<mx_uint> out_shape;    // scratch for GetOutputShape
+};
+
+// numpy float32 view of caller floats (copies via frombuffer)
+PyObject *FloatsToNumpy(const mx_float *data, mx_uint size) {
+  Ref np(PyImport_ImportModule("numpy"));
+  if (!np) return nullptr;
+  Ref bytes(PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float)));
+  if (!bytes) return nullptr;
+  return PyObject_CallMethod(np.p, "frombuffer", "Os", bytes.p,
+                             "float32");
+}
+
+// copy a float32-contiguous numpy array out to the caller's buffer;
+// returns copied element count or -1 with the error message set
+long CopyNumpyOut(PyObject *arr, mx_float *data, mx_uint size) {
+  Ref bytes(PyObject_CallMethod(arr, "tobytes", nullptr));
+  if (!bytes) { SetPyError(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes.p, &buf, &n) != 0) {
+    SetPyError();
+    return -1;
+  }
+  const size_t elems = static_cast<size_t>(n) / sizeof(mx_float);
+  if (elems > size) {
+    g_last_error = "destination buffer too small";
+    return -1;
+  }
+  std::memcpy(data, buf, static_cast<size_t>(n));
+  return static_cast<long>(elems);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTrainGetLastError() { return g_last_error.c_str(); }
+
+int MXTrainCreate(const char *symbol_json_str, int dev_type, int dev_id,
+                  int seed, mx_uint num_input_nodes,
+                  const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, TrainHandle *out) {
+  EnsurePython();
+  Gil gil;
+  try {
+    Ref mod(PyImport_ImportModule("mxnet_tpu.c_train"));
+    if (!mod) { SetPyError(); return -1; }
+    Ref cls(PyObject_GetAttrString(mod.p, "TrainSession"));
+    if (!cls) { SetPyError(); return -1; }
+
+    Ref shapes(PyDict_New());
+    if (!shapes) { SetPyError(); return -1; }
+    for (mx_uint i = 0; i < num_input_nodes; ++i) {
+      const mx_uint lo = input_shape_indptr[i];
+      const mx_uint hi = input_shape_indptr[i + 1];
+      Ref shape(PyTuple_New(hi - lo));
+      if (!shape) { SetPyError(); return -1; }
+      for (mx_uint j = lo; j < hi; ++j) {
+        PyTuple_SET_ITEM(shape.p, j - lo,
+                         PyLong_FromUnsignedLong(input_shape_data[j]));
+      }
+      if (PyDict_SetItemString(shapes.p, input_keys[i], shape.p) != 0) {
+        SetPyError();
+        return -1;
+      }
+    }
+
+    Ref session(PyObject_CallFunction(cls.p, "sOsii", symbol_json_str,
+                                      shapes.p, DevName(dev_type),
+                                      dev_id, seed));
+    if (!session) { SetPyError(); return -1; }
+    auto rec = new TrainRecord();
+    rec->session = session.p;
+    Py_INCREF(session.p);
+    *out = rec;
+    return 0;
+  } catch (const std::exception &e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXTrainSetInput(TrainHandle handle, const char *key,
+                    const mx_float *data, mx_uint size) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref flat(FloatsToNumpy(data, size));
+  if (!flat) { SetPyError(); return -1; }
+  Ref r(PyObject_CallMethod(rec->session, "set_input", "sO", key,
+                            flat.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXTrainForward(TrainHandle handle, int is_train) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref r(PyObject_CallMethod(rec->session, "forward", "i", is_train));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXTrainBackward(TrainHandle handle) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref r(PyObject_CallMethod(rec->session, "backward", nullptr));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXTrainSGDUpdate(TrainHandle handle, mx_float lr, mx_float momentum,
+                     mx_float wd, mx_float rescale_grad) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref r(PyObject_CallMethod(rec->session, "sgd_update", "ffff",
+                            static_cast<double>(lr),
+                            static_cast<double>(momentum),
+                            static_cast<double>(wd),
+                            static_cast<double>(rescale_grad)));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXTrainGetOutputCount(TrainHandle handle, mx_uint *out) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref r(PyObject_CallMethod(rec->session, "num_outputs", nullptr));
+  if (!r) { SetPyError(); return -1; }
+  *out = static_cast<mx_uint>(PyLong_AsUnsignedLong(r.p));
+  return 0;
+}
+
+int MXTrainGetOutputShape(TrainHandle handle, mx_uint index,
+                          mx_uint **shape_data, mx_uint *shape_ndim) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref shape(PyObject_CallMethod(rec->session, "get_output_shape", "I",
+                                index));
+  if (!shape) { SetPyError(); return -1; }
+  const Py_ssize_t nd = PyTuple_Size(shape.p);
+  rec->out_shape.clear();
+  for (Py_ssize_t i = 0; i < nd; ++i) {
+    rec->out_shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape.p, i))));
+  }
+  *shape_data = rec->out_shape.data();
+  *shape_ndim = static_cast<mx_uint>(nd);
+  return 0;
+}
+
+int MXTrainGetOutput(TrainHandle handle, mx_uint index, mx_float *data,
+                     mx_uint size) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref arr(PyObject_CallMethod(rec->session, "get_output", "I", index));
+  if (!arr) { SetPyError(); return -1; }
+  return CopyNumpyOut(arr.p, data, size) < 0 ? -1 : 0;
+}
+
+int MXTrainGetArray(TrainHandle handle, const char *kind,
+                    const char *name, mx_float *data, mx_uint size) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Ref arr(PyObject_CallMethod(rec->session, "get_array", "ss", name,
+                              kind));
+  if (!arr) { SetPyError(); return -1; }
+  return CopyNumpyOut(arr.p, data, size) < 0 ? -1 : 0;
+}
+
+int MXTrainFree(TrainHandle handle) {
+  Gil gil;
+  auto rec = static_cast<TrainRecord *>(handle);
+  Py_XDECREF(rec->session);
+  delete rec;
+  return 0;
+}
+
+}  // extern "C"

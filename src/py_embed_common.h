@@ -1,0 +1,70 @@
+// Shared embedded-CPython plumbing for the C ABI shims
+// (c_predict_api.cc and c_train_api.cc build into separate .so files;
+// each gets its own copy of these inline definitions, but the source
+// of truth is single so interpreter setup and error normalization
+// cannot drift between the libraries).
+#ifndef MXNET_TPU_SRC_PY_EMBED_COMMON_H_
+#define MXNET_TPU_SRC_PY_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace mxtpu_embed {
+
+inline thread_local std::string g_last_error;
+
+inline void EnsurePython() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works from any thread (including this one)
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class Gil {
+ public:
+  Gil() { state_ = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// owns one reference
+struct Ref {
+  PyObject *p;
+  explicit Ref(PyObject *o) : p(o) {}
+  ~Ref() { Py_XDECREF(p); }
+  explicit operator bool() const { return p != nullptr; }
+};
+
+inline void SetPyError() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  PyObject *s = value ? PyObject_Str(value) : nullptr;
+  g_last_error = (s && PyUnicode_Check(s)) ? PyUnicode_AsUTF8(s)
+                                           : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+inline const char *DevName(int dev_type) {
+  switch (dev_type) {
+    case 2: return "gpu";
+    case 3: return "tpu";
+    default: return "cpu";
+  }
+}
+
+}  // namespace mxtpu_embed
+
+#endif  // MXNET_TPU_SRC_PY_EMBED_COMMON_H_

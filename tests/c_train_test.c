@@ -1,0 +1,135 @@
+/* End-to-end exercise of the C TRAINING ABI slice (reference
+ * cpp-package executor.h Forward/Backward + optimizer Update flow):
+ * bind a training executor from symbol JSON, overfit one batch with
+ * SGD-momentum, print initial/final loss and train accuracy for the
+ * pytest harness to assert learning happened entirely from C. */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../include/mxnet_tpu/c_train_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(2); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+static float ce_loss(const float *probs, const float *labels,
+                     mx_uint batch, mx_uint nclass) {
+  float total = 0.f;
+  for (mx_uint i = 0; i < batch; ++i) {
+    float p = probs[i * nclass + (mx_uint)labels[i]];
+    total += -logf(p < 1e-10f ? 1e-10f : p);
+  }
+  return total / (float)batch;
+}
+
+static float accuracy(const float *probs, const float *labels,
+                      mx_uint batch, mx_uint nclass) {
+  mx_uint hit = 0;
+  for (mx_uint i = 0; i < batch; ++i) {
+    mx_uint best = 0;
+    for (mx_uint c = 1; c < nclass; ++c) {
+      if (probs[i * nclass + c] > probs[i * nclass + best]) best = c;
+    }
+    if (best == (mx_uint)labels[i]) ++hit;
+  }
+  return (float)hit / (float)batch;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 8) {
+    fprintf(stderr,
+            "usage: %s symbol.json x.f32 y.f32 batch dim nclass steps\n",
+            argv[0]);
+    return 2;
+  }
+  long json_size, x_size, y_size;
+  char *json = read_file(argv[1], &json_size);
+  float *x = (float *)read_file(argv[2], &x_size);
+  float *y = (float *)read_file(argv[3], &y_size);
+  mx_uint batch = (mx_uint)atoi(argv[4]);
+  mx_uint dim = (mx_uint)atoi(argv[5]);
+  mx_uint nclass = (mx_uint)atoi(argv[6]);
+  int steps = atoi(argv[7]);
+
+  const char *keys[] = {"data", "softmax_label"};
+  mx_uint indptr[] = {0, 2, 3};
+  mx_uint shape[] = {batch, dim, batch};
+
+  TrainHandle h = NULL;
+  if (MXTrainCreate(json, 1, 0, 7, 2, keys, indptr, shape, &h) != 0) {
+    fprintf(stderr, "MXTrainCreate: %s\n", MXTrainGetLastError());
+    return 1;
+  }
+  float *probs = (float *)malloc(sizeof(float) * batch * nclass);
+  float first_loss = -1.f, last_loss = -1.f;
+
+  for (int s = 0; s < steps; ++s) {
+    if (MXTrainSetInput(h, "data", x, batch * dim) != 0 ||
+        MXTrainSetInput(h, "softmax_label", y, batch) != 0) {
+      fprintf(stderr, "SetInput: %s\n", MXTrainGetLastError());
+      return 1;
+    }
+    if (MXTrainForward(h, 1) != 0 || MXTrainBackward(h) != 0) {
+      fprintf(stderr, "Fwd/Bwd: %s\n", MXTrainGetLastError());
+      return 1;
+    }
+    if (MXTrainGetOutput(h, 0, probs, batch * nclass) != 0) {
+      fprintf(stderr, "GetOutput: %s\n", MXTrainGetLastError());
+      return 1;
+    }
+    last_loss = ce_loss(probs, y, batch, nclass);
+    if (s == 0) first_loss = last_loss;
+    if (MXTrainSGDUpdate(h, 0.1f, 0.9f, 0.f, 1.0f / batch) != 0) {
+      fprintf(stderr, "SGDUpdate: %s\n", MXTrainGetLastError());
+      return 1;
+    }
+  }
+
+  /* inference pass for the final report */
+  MXTrainSetInput(h, "data", x, batch * dim);
+  MXTrainSetInput(h, "softmax_label", y, batch);
+  if (MXTrainForward(h, 0) != 0 ||
+      MXTrainGetOutput(h, 0, probs, batch * nclass) != 0) {
+    fprintf(stderr, "final fwd: %s\n", MXTrainGetLastError());
+    return 1;
+  }
+  printf("c-train first_loss=%.4f last_loss=%.4f acc=%.3f\n",
+         first_loss, last_loss, accuracy(probs, y, batch, nclass));
+
+  /* gradient readback sanity: fc1 weight grad exists and is finite */
+  {
+    mx_uint count = 0;
+    if (MXTrainGetOutputCount(h, &count) != 0 || count != 1) {
+      fprintf(stderr, "output count: %u\n", count);
+      return 1;
+    }
+    float *gw = (float *)malloc(sizeof(float) * 32 * dim);
+    if (MXTrainGetArray(h, "grad", "fc1_weight", gw, 32 * dim) != 0) {
+      fprintf(stderr, "GetArray(grad): %s\n", MXTrainGetLastError());
+      return 1;
+    }
+    float norm = 0.f;
+    for (mx_uint i = 0; i < 32 * dim; ++i) norm += gw[i] * gw[i];
+    if (!(norm == norm) || norm <= 0.f) {   /* NaN or all-zero */
+      fprintf(stderr, "bad fc1_weight grad norm %f\n", norm);
+      return 1;
+    }
+    free(gw);
+  }
+  MXTrainFree(h);
+  free(probs);
+  free(json);
+  free(x);
+  free(y);
+  return 0;
+}
