@@ -80,5 +80,10 @@ def test_prefetch_reset_reraises_unseen_worker_error():
         return d
     pre = mx.io.DevicePrefetchIter(_iter(), flaky, depth=1)
     next(pre)
+    # reset() cancels pending work by design, so a not-yet-raised error
+    # may legitimately vanish — wait until the worker has actually hit
+    # the failure (thread exit) before asserting reset re-raises it
+    pre._thread.join(timeout=5)
+    assert not pre._thread.is_alive(), "worker never hit the failure"
     with pytest.raises(RuntimeError, match="corrupt record"):
         pre.reset()
