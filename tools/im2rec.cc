@@ -1,0 +1,181 @@
+// Native image-list -> RecordIO packer.
+//
+// Role parity: tools/im2rec.cc in the reference (its OpenCV-based
+// packer); `tools/im2rec.py` is the python twin.  This tool reads a
+// .lst file (the reference format: id \t label... \t relative-path),
+// packs each image file's bytes behind an IRHeader, and writes a .rec
+// in dmlc recordio framing (magic-split continuation records, so JPEG
+// payloads containing the magic word stay seekable) plus an optional
+// .idx for MXIndexedRecordIO.  Pack-time resizing is deliberately
+// absent: this framework resizes at READ time in the native pipeline
+// (src/image_pipeline.cc), so the packer stays a pure byte mover.
+//
+// Build: g++ -O2 -std=c++17 tools/im2rec.cc -o im2rec
+// Usage: im2rec <list.lst> <image-root> <out.rec> [--index]
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLengthMask = (1u << 29) - 1u;
+
+#pragma pack(push, 1)
+struct IRHeader {        // reference recordio IRHeader: "IfQQ"
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+void WritePart(std::ofstream &out, const char *data, size_t len,
+               uint32_t cflag) {
+  const uint32_t lrec =
+      (static_cast<uint32_t>(len) & kLengthMask) | (cflag << 29);
+  out.write(reinterpret_cast<const char *>(&kMagic), 4);
+  out.write(reinterpret_cast<const char *>(&lrec), 4);
+  out.write(data, static_cast<std::streamsize>(len));
+  static const char zeros[4] = {0, 0, 0, 0};
+  const size_t pad = (4 - (len % 4)) % 4;
+  if (pad) out.write(zeros, static_cast<std::streamsize>(pad));
+}
+
+// dmlc framing: split the payload at 4-aligned magic occurrences
+// (dropped here, re-inserted by every reader of this format)
+void WriteRecord(std::ofstream &out, const std::string &buf) {
+  std::vector<std::pair<size_t, size_t>> parts;
+  size_t start = 0;
+  for (size_t pos = 0; pos + 4 <= buf.size();) {
+    const size_t hit = buf.find(
+        reinterpret_cast<const char *>(&kMagic), pos, 4);
+    if (hit == std::string::npos) break;
+    if (hit % 4 == 0) {
+      parts.emplace_back(start, hit - start);
+      start = hit + 4;
+      pos = start;
+    } else {
+      pos = hit + 1;
+    }
+  }
+  parts.emplace_back(start, buf.size() - start);
+  if (parts.size() == 1) {
+    WritePart(out, buf.data(), buf.size(), 0);
+    return;
+  }
+  for (size_t i = 0; i < parts.size(); ++i) {
+    const uint32_t cflag = (i == 0) ? 1 : (i + 1 == parts.size() ? 3 : 2);
+    WritePart(out, buf.data() + parts[i].first, parts[i].second, cflag);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <list.lst> <image-root> <out.rec> [--index]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string lst_path = argv[1];
+  const std::string root = argv[2];
+  const std::string rec_path = argv[3];
+  const bool want_index =
+      argc > 4 && std::strcmp(argv[4], "--index") == 0;
+
+  std::ifstream lst(lst_path);
+  if (!lst) {
+    std::fprintf(stderr, "cannot open %s\n", lst_path.c_str());
+    return 2;
+  }
+  std::ofstream rec(rec_path, std::ios::binary);
+  if (!rec) {
+    std::fprintf(stderr, "cannot write %s\n", rec_path.c_str());
+    return 2;
+  }
+  std::ofstream idx;
+  if (want_index) {
+    // strip the extension of the FILENAME only (a dotted directory
+    // name must not truncate the path)
+    const size_t slash = rec_path.find_last_of('/');
+    const size_t dot = rec_path.rfind('.');
+    const std::string base =
+        (dot != std::string::npos &&
+         (slash == std::string::npos || dot > slash))
+            ? rec_path.substr(0, dot)
+            : rec_path;
+    idx.open(base + ".idx");
+  }
+
+  std::string line;
+  size_t n = 0, skipped = 0;
+  while (std::getline(lst, line)) {
+    if (line.empty()) continue;
+    // id \t label(s)... \t path (reference .lst format; several
+    // label columns pack as a float32 array, like python recordio.pack)
+    std::vector<std::string> cols;
+    std::stringstream ss(line);
+    std::string col;
+    while (std::getline(ss, col, '\t')) cols.push_back(col);
+    if (cols.size() < 3) {
+      std::fprintf(stderr, "bad .lst line: %s\n", line.c_str());
+      return 2;
+    }
+    uint64_t id = 0;
+    std::vector<float> labels;
+    try {
+      id = std::stoull(cols.front());
+      for (size_t c = 1; c + 1 < cols.size(); ++c) {
+        labels.push_back(std::stof(cols[c]));
+      }
+    } catch (const std::exception &) {
+      std::fprintf(stderr, "bad .lst line (non-numeric id/label): %s\n",
+                   line.c_str());
+      return 2;
+    }
+    const std::string img_path = root + "/" + cols.back();
+
+    std::ifstream img(img_path, std::ios::binary);
+    if (!img) {
+      std::fprintf(stderr, "skip unreadable %s\n", img_path.c_str());
+      ++skipped;
+      continue;
+    }
+    std::ostringstream bytes;
+    bytes << img.rdbuf();
+
+    // single label rides the header float; multi-label lists pack
+    // flag=N + a float32 array, matching python recordio.pack
+    IRHeader hdr{0, labels.empty() ? 0.f : labels[0], id, 0};
+    std::string payload;
+    if (labels.size() > 1) {
+      hdr.flag = static_cast<uint32_t>(labels.size());
+      hdr.label = 0.f;
+      payload.assign(reinterpret_cast<const char *>(&hdr), sizeof(hdr));
+      payload.append(reinterpret_cast<const char *>(labels.data()),
+                     labels.size() * sizeof(float));
+    } else {
+      payload.assign(reinterpret_cast<const char *>(&hdr), sizeof(hdr));
+    }
+    payload += bytes.str();
+    if (want_index) idx << id << '\t' << rec.tellp() << '\n';
+    WriteRecord(rec, payload);
+    ++n;
+  }
+  rec.flush();
+  if (!rec.good() || (want_index && !idx.good())) {
+    std::fprintf(stderr, "write failure on %s (disk full?)\n",
+                 rec_path.c_str());
+    return 2;
+  }
+  std::fprintf(stderr, "packed %zu records (%zu skipped) -> %s\n", n,
+               skipped, rec_path.c_str());
+  return n > 0 ? 0 : 1;
+}
