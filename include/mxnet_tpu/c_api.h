@@ -1,0 +1,95 @@
+/*!
+ * Flat C ABI: the MXNDArray* / MXSymbol* subsets of the reference
+ * include/mxnet/c_api.h (impl src/c_api/c_api.cc) — the seam the
+ * reference language bindings (cpp/R/Scala/Perl/JNI) hang off.
+ *
+ * Build: `make libmxtpu.so` (src/Makefile).  Error convention: every
+ * function returns 0 on success, -1 on failure with the message
+ * available from MXGetLastError() (reference API_BEGIN/API_END role).
+ *
+ * Handles are opaque; NDArray handles wrap real mxnet_tpu NDArrays and
+ * Symbol handles real Symbols (not session-local copies), so files
+ * written here are byte-compatible with the python side and vice
+ * versa.  Returned const char* / array pointers stay valid until the
+ * next ABI call on the same handle (string lists) or the next
+ * MXNDArrayLoad / MXSymbolListAtomicSymbolCreators on the same thread
+ * (global scratch), matching the reference's ret-store semantics.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stddef.h>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *AtomicSymbolCreator;
+
+const char *MXGetLastError();
+
+/* dtype codes follow the reference: 0=float32 1=float64 2=float16
+ * 3=uint8 4=int32 5=int8 6=int64 (7=bfloat16, TPU extension) */
+
+/* ---------------------------------------------------------- ndarray */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+/* size is the ELEMENT count (reference convention) */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out);
+/* reference binary container (arg:/aux: keyed or positional) */
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+int MXNDArrayWaitAll();
+int MXNDArrayFree(NDArrayHandle handle);
+
+/* ----------------------------------------------------------- symbol */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+/* binds args into an atomic symbol IN PLACE (reference semantics) */
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array);
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array);
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
+int MXSymbolFree(SymbolHandle symbol);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

@@ -1,0 +1,178 @@
+"""Python driver for the widened flat C ABI (``src/c_api.cc``).
+
+Reference: the ``MXNDArray*`` / ``MXSymbol*`` subsets of
+``include/mxnet/c_api.h`` (impl ``src/c_api/c_api.cc``) — the seam
+every reference language binding hangs off.  The native library embeds
+CPython and calls the helpers here; handles on the C side are owned
+references to the objects these helpers return (NDArray / Symbol /
+composable op stubs), so the ABI manipulates real framework objects,
+not session-local copies.
+
+Kept deliberately thin: the logic lives in ``ndarray.py`` /
+``symbol.py``; this module only adapts calling conventions (flat
+key/value string lists, opaque creator indices) to them.
+"""
+from __future__ import annotations
+
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # honor an explicit CPU pin even where a site TPU plugin prepends
+    # itself to jax_platforms regardless of the env var (the embedded
+    # interpreter has no conftest to do this)
+    import jax as _jax
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .base import MXNetError
+from .context import Context
+from .ops.registry import get_op, has_op, list_ops
+
+
+# ---------------------------------------------------------------- ndarray
+
+def nd_create(shape, dtype, dev_type, dev_id):
+    ctx = Context(dev_type if isinstance(dev_type, str) else
+                  {1: "cpu", 2: "gpu", 3: "tpu"}.get(int(dev_type), "cpu"),
+                  int(dev_id))
+    return nd.zeros(tuple(int(d) for d in shape), ctx=ctx, dtype=dtype)
+
+
+def nd_from_bytes(arr, buf):
+    """SyncCopyFromCPU: write caller bytes into the array in place."""
+    host = np.frombuffer(buf, dtype=arr.dtype).reshape(arr.shape)
+    arr[:] = host
+    return arr
+
+
+def nd_to_bytes(arr):
+    """SyncCopyToCPU: contiguous host bytes of the array."""
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def nd_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+def nd_dtype(arr):
+    return str(np.dtype(arr.dtype))
+
+
+def nd_context(arr):
+    dev = arr.context
+    code = {"cpu": 1, "gpu": 2, "tpu": 3}.get(dev.device_type, 1)
+    return (code, int(dev.device_id))
+
+
+def nd_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def nd_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def nd_save(fname, arrays, keys):
+    """Reference ``MXNDArraySave``: keyed dict when keys given, else a
+    positional list — both in the reference binary container."""
+    if keys:
+        nd.save(fname, {k: a for k, a in zip(keys, arrays)})
+    else:
+        nd.save(fname, list(arrays))
+
+
+def nd_load(fname):
+    """-> (names_or_None, [NDArray]) in file order."""
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return names, [loaded[k] for k in names]
+    return None, list(loaded)
+
+
+# ---------------------------------------------------------------- symbol
+
+def op_names():
+    """Stable op-name list; index+1 is the C-side AtomicSymbolCreator."""
+    return sorted(list_ops())
+
+
+def op_info(name):
+    op = get_op(name)
+    params = op.params or {}
+    return (name, getattr(op, "doc", "") or "", sorted(params.keys()))
+
+
+class _AtomicStub:
+    """An op + attrs awaiting composition (the reference's atomic
+    symbol: created by MXSymbolCreateAtomicSymbol, inputs bound later
+    by MXSymbolCompose)."""
+
+    def __init__(self, op_name, attrs):
+        self.op_name = op_name
+        self.attrs = dict(attrs)
+
+
+def create_atomic(op_name, keys, vals):
+    if not has_op(op_name):
+        raise MXNetError("unknown operator %r" % (op_name,))
+    return _AtomicStub(op_name, dict(zip(keys, vals)))
+
+
+def create_variable(name):
+    return sym_mod.Variable(name)
+
+
+def compose(stub, name, keys, args):
+    """MXSymbolCompose: bind inputs into an atomic stub -> Symbol.
+    ``keys`` empty means positional args (the common case)."""
+    if not isinstance(stub, _AtomicStub):
+        raise MXNetError("compose target is not an atomic symbol")
+    import mxnet_tpu as _mx
+    fn = getattr(_mx.sym, stub.op_name)
+    attrs = dict(stub.attrs)
+    if name:
+        attrs["name"] = name
+    if keys:
+        return fn(**dict(zip(keys, args)), **attrs)
+    return fn(*args, **attrs)
+
+
+def sym_from_json(json_str):
+    return sym_mod.load_json(json_str)
+
+
+def sym_from_file(fname):
+    return sym_mod.load(fname)
+
+
+def sym_to_json(sym):
+    return sym.tojson()
+
+
+def sym_save(sym, fname):
+    sym.save(fname)
+
+
+def sym_name(sym):
+    entries = sym._entries
+    node = entries[0][0]
+    return node.name or ""
+
+
+def sym_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def sym_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def sym_list_aux(sym):
+    return list(sym.list_auxiliary_states())

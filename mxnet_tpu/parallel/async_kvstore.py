@@ -97,6 +97,7 @@ class ParameterServer:
         self._listener.close()
 
     def _serve(self, conn):
+        msg = ("<recv>",)  # so the fault-report path below can never NameError
         try:
             while True:
                 msg = _recv_msg(conn)
@@ -199,7 +200,16 @@ class ParameterServer:
 
 class AsyncKVStore(KVStore):
     """Worker-side ``dist_async`` client (reference kvstore_dist.h
-    worker role under ``--launcher`` env, without the sync gate)."""
+    worker role under ``--launcher`` env, without the sync gate).
+
+    Multi-server sharding (reference ``kvstore_dist.h:273-314``
+    ``EncodeKey``): ``MXNET_TPU_NUM_SERVERS`` (default 1) parameter
+    servers run inside the first N worker processes.  Small keys hash
+    to one server; arrays above ``MXNET_KVSTORE_BIGARRAY_BOUND``
+    elements (reference env var, default 1e6) are sliced into
+    near-equal contiguous flat ranges, one per server, so no single
+    server carries a whole big tensor or its push traffic.
+    """
 
     def __init__(self, kv_type="dist_async"):
         super().__init__(kv_type)
@@ -211,11 +221,30 @@ class AsyncKVStore(KVStore):
             "127.0.0.1:8431"
         host, cport = coordinator.rsplit(":", 1)
         port = config.get_int("MXNET_TPU_ASYNC_PORT") or int(cport) + 1
+        nserv = config.get_int("MXNET_TPU_NUM_SERVERS", 1)
+        if nserv < 1 or nserv > self._num_workers:
+            raise MXNetError(
+                "MXNET_TPU_NUM_SERVERS=%d must be in [1, num_workers=%d]"
+                " (servers run inside the first N worker processes)"
+                % (nserv, self._num_workers))
+        self._num_servers = nserv
+        self._big_bound = config.get_int(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000)
+        hosts_env = config.get("MXNET_TPU_SERVER_HOSTS")
+        server_hosts = (hosts_env.split(",") if hosts_env
+                        else [host] * nserv)
+        if len(server_hosts) != nserv:
+            raise MXNetError("MXNET_TPU_SERVER_HOSTS lists %d hosts for "
+                             "%d servers" % (len(server_hosts), nserv))
         self._server = None
-        if self._rank == 0:
-            self._server = ParameterServer(self._num_workers, port,
+        if self._rank < nserv:
+            self._server = ParameterServer(self._num_workers,
+                                           port + self._rank,
                                            host="0.0.0.0")
-        self._sock = self._connect(host, port)
+        self._socks = [self._connect(h, port + i)
+                       for i, h in enumerate(server_hosts)]
+        self._sock = self._socks[0]  # back-compat alias
+        self._plans = {}             # key -> None (small) | [(lo, hi)]*S
 
     @staticmethod
     def _connect(host, port, timeout=60.0):
@@ -237,12 +266,40 @@ class AsyncKVStore(KVStore):
                         "tools/launch.py)" % (host, port))
                 time.sleep(0.2)
 
-    def _rpc(self, *msg):
-        _send_msg(self._sock, msg)
-        resp = _recv_msg(self._sock)
+    def _rpc_to(self, sidx, *msg):
+        sock = self._socks[sidx]
+        _send_msg(sock, msg)
+        resp = _recv_msg(sock)
         if resp[0] == "err":
-            raise MXNetError("dist_async server: %s" % resp[1])
+            raise MXNetError("dist_async server %d: %s" % (sidx, resp[1]))
         return resp[1] if len(resp) > 1 else None
+
+    def _rpc(self, *msg):
+        return self._rpc_to(0, *msg)
+
+    def _rpc_all(self, *msg):
+        return [self._rpc_to(i, *msg) for i in range(self._num_servers)]
+
+    # --------------------------------------------------- key sharding
+    def _server_of(self, key):
+        import zlib
+        return zlib.crc32(str(key).encode()) % self._num_servers
+
+    def _plan_of(self, key, size):
+        """None for hash-routed small keys; a list of S contiguous flat
+        ranges [lo, hi) for arrays above the bigarray bound (reference
+        EncodeKey slicing, kvstore_dist.h:273-314)."""
+        plan = self._plans.get(key, "?")
+        if plan != "?":
+            return plan
+        if self._num_servers == 1 or size <= self._big_bound:
+            plan = None
+        else:
+            S = self._num_servers
+            edges = [size * i // S for i in range(S + 1)]
+            plan = [(edges[i], edges[i + 1]) for i in range(S)]
+        self._plans[key] = plan
+        return plan
 
     # ------------------------------------------------------------------ api
     @property
@@ -256,7 +313,14 @@ class AsyncKVStore(KVStore):
     def init(self, key, value):
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
-            self._rpc("init", k, v.asnumpy())
+            arr = v.asnumpy()
+            plan = self._plan_of(k, arr.size)
+            if plan is None:
+                self._rpc_to(self._server_of(k), "init", k, arr)
+            else:
+                flat = arr.reshape(-1)
+                for i, (lo, hi) in enumerate(plan):
+                    self._rpc_to(i, "init", "%s#%d" % (k, i), flat[lo:hi])
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
@@ -265,7 +329,13 @@ class AsyncKVStore(KVStore):
             merged = group[0].asnumpy()
             for other in group[1:]:
                 merged = merged + other.asnumpy()
-            self._rpc("push", k, merged)
+            plan = self._plan_of(k, merged.size)
+            if plan is None:
+                self._rpc_to(self._server_of(k), "push", k, merged)
+            else:
+                flat = merged.reshape(-1)
+                for i, (lo, hi) in enumerate(plan):
+                    self._rpc_to(i, "push", "%s#%d" % (k, i), flat[lo:hi])
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
@@ -273,7 +343,15 @@ class AsyncKVStore(KVStore):
         cache = {}
         for k, o in zip(keys, outs):
             if k not in cache:
-                cache[k] = self._rpc("pull", k)
+                plan = self._plan_of(k, int(np.prod(o.shape)))
+                if plan is None:
+                    cache[k] = self._rpc_to(self._server_of(k), "pull", k)
+                else:
+                    parts = [self._rpc_to(i, "pull", "%s#%d" % (k, i))
+                             for i in range(self._num_servers)]
+                    cache[k] = np.concatenate(
+                        [np.asarray(p).reshape(-1) for p in parts]
+                    ).reshape(o.shape)
             o[:] = cache[k]
 
     def set_optimizer(self, optimizer):
@@ -284,38 +362,72 @@ class AsyncKVStore(KVStore):
         import copy
         optimizer = copy.copy(optimizer)
         optimizer.sym = None
-        self._rpc("set_optimizer", pickle.dumps(optimizer, protocol=4))
+        blob = pickle.dumps(optimizer, protocol=4)
+        self._rpc_all("set_optimizer", blob)
 
     def set_updater(self, updater):
         raise MXNetError("dist_async applies updates on the server; "
                          "use set_optimizer")
 
     def barrier(self):
-        self._rpc("barrier")
+        # every server gates on all workers, so the slowest server
+        # bounds the barrier exactly once per generation
+        self._rpc_all("barrier")
 
     def server_stats(self):
         """{'updates': per-push update count, 'keys': n} — observability
         for the async contract (updates grow per push, not per round)."""
-        return self._rpc("stats")
+        per = self._rpc_all("stats")
+        return {"updates": sum(p["updates"] for p in per),
+                "keys": sum(p["keys"] for p in per),
+                "per_server": per}
 
     def save_optimizer_states(self, fname):
+        """Write SERVER-side updater states to ``fname`` (rank 0 only).
+
+        SHARED-STORAGE CONTRACT (same as the fused path's checkpoint
+        helpers): rank 0 writes the file; every rank later reads it in
+        :meth:`load_optimizer_states`, so ``fname`` must live on storage
+        all ranks can see (NFS, GCS fuse, single-host launch).
+        """
         if self._rank != 0:
             return           # rank 0 writes; no N-way state transfer
+        blobs = self._rpc_all("opt_states")
         with open(fname, "wb") as f:
-            f.write(self._rpc("opt_states"))
+            f.write(pickle.dumps({"per_server": blobs}, protocol=4))
 
     def load_optimizer_states(self, fname):
         # restore SERVER-side updater states (call after set_optimizer,
-        # as Module.init_optimizer's preload path does)
+        # as Module.init_optimizer's preload path does).  Shared-storage
+        # contract: see save_optimizer_states.
+        if not os.path.exists(fname):
+            from ..base import MXNetError
+            raise MXNetError(
+                "optimizer-states file %r not found on rank %d: "
+                "save_optimizer_states writes on rank 0 only, so the "
+                "path must be on storage shared by all ranks"
+                % (fname, self._rank))
         with open(fname, "rb") as f:
-            self._rpc("set_opt_states", f.read())
+            raw = f.read()
+        try:
+            blobs = pickle.loads(raw)["per_server"]
+        except Exception:
+            blobs = [raw]    # pre-sharding single-server file
+        if len(blobs) != self._num_servers:
+            raise MXNetError(
+                "optimizer-states file holds %d server shards, job runs "
+                "%d servers" % (len(blobs), self._num_servers))
+        for i, b in enumerate(blobs):
+            self._rpc_to(i, "set_opt_states", b)
 
     def close(self):
-        try:
-            self._rpc("bye")
-            self._sock.close()
-        except Exception:
-            pass
+        for i, sock in enumerate(list(self._socks)):
+            try:
+                self._rpc_to(i, "bye")
+                sock.close()
+            except Exception:
+                pass
+        self._socks = []
 
     def __del__(self):
         try:

@@ -36,6 +36,8 @@ training scripts use this.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..base import MXNetError
@@ -143,7 +145,8 @@ class ShardedTrainer:
                  stem_space_to_depth=None, elide_input_bn_grad=True,
                  strided_bwd_phase=None, pipeline_stages=1,
                  pipeline_microbatches=None, sequence_parallel=False,
-                 input_mean=None, input_std=None, conv1x1_as_dot=None):
+                 input_mean=None, input_std=None, conv1x1_as_dot=None,
+                 native_weight_layout=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -235,6 +238,19 @@ class ShardedTrainer:
             from ..ops import fused as _fused_mod
             conv1x1_as_dot = _fused_mod.conv1x1_dot_enabled()
         self._conv1x1_dot = bool(conv1x1_as_dot) and \
+            self._layout == "NHWC"
+        # native_weight_layout: store conv-weight MASTERS physically as
+        # HWIO (f32) so the default/canonical layout IS the layout the
+        # TPU conv wants.  jit's Layout.AUTO cannot reach lax.scan loop
+        # carries (run_steps), so OIHW masters pay per-step relayout
+        # copies (the xprof "copies" bucket, docs/perf.md); a physical
+        # shape change removes them everywhere.  Checkpoints and the
+        # graph itself still see reference OIHW (converted at the
+        # boundaries), so saved params stay interoperable.
+        if native_weight_layout is None:
+            native_weight_layout = \
+                os.environ.get("MXNET_NATIVE_WEIGHT_LAYOUT", "0") == "1"
+        self._native_weight_layout = bool(native_weight_layout) and \
             self._layout == "NHWC"
         # pipeline_stages > 1: GPipe over the mesh's 'pipe' axis — the
         # graph is cut into stages at single-live-tensor positions and
@@ -386,6 +402,15 @@ class ShardedTrainer:
         self._rescale = optimizer.rescale_grad
         self._n_slots, self._update_rule = _make_update_rule(optimizer)
 
+        # ---- native-layout weight set: conv masters stored HWIO
+        self._native_w = frozenset()
+        if self._native_weight_layout and self._pp == 1:
+            self._native_w = self._derive_native_weights()
+        self._store_shapes = dict(self._arg_shapes)
+        for n in self._native_w:
+            o, i, h, w = self._arg_shapes[n]
+            self._store_shapes[n] = (h, w, i, o)
+
         # ---- init params on host (f32 masters), device_put with shardings.
         # Initializer errors propagate: a wrong-shape bug must not silently
         # become a different init.
@@ -396,6 +421,9 @@ class ShardedTrainer:
             arr = _HostArray(np.zeros(self._arg_shapes[name], np.float32))
             init(InitDesc(name), arr)
             host_params[name] = arr.data
+        for name in self._native_w:   # initializers see reference OIHW
+            host_params[name] = np.ascontiguousarray(
+                host_params[name].transpose(2, 3, 1, 0))
         host_aux = {}
         for name in self._aux_names:
             v = np.zeros(self._aux_shapes[name], np.float32)
@@ -427,10 +455,14 @@ class ShardedTrainer:
         self.tp_rules = tp_rules
 
         def param_spec(name):
-            shp = self._arg_shapes.get(name, self._aux_shapes.get(name))
+            shp = self._store_shapes.get(name, self._aux_shapes.get(name))
             spec = [None] * len(shp)
             if name in tp_rules:
-                spec[tp_rules[name]] = "model"
+                d = tp_rules[name]
+                if name in self._native_w:
+                    # OIHW dim index -> its position in HWIO storage
+                    d = (3, 2, 0, 1)[d]
+                spec[d] = "model"
             return P(*spec)
 
         self._param_sharding = {
@@ -480,13 +512,51 @@ class ShardedTrainer:
             return {n: [] for n in self._param_names}
 
         def make():
-            return {n: [jnp.zeros(self._arg_shapes[n], jnp.float32)
+            return {n: [jnp.zeros(self._store_shapes[n], jnp.float32)
                         for _ in range(self._n_slots)]
                     for n in self._param_names}
 
         shardings = {n: [self._param_sharding[n]] * self._n_slots
                      for n in self._param_names}
         return jax.jit(make, out_shardings=shardings)()
+
+    def _derive_native_weights(self):
+        """Param names eligible for physical HWIO master storage: 4-d
+        weights whose EVERY graph use is the ``weight`` input of a 2-d
+        Convolution (shared/tied weights with any other consumer keep
+        reference layout)."""
+        uses = {}
+        for node in self._topo:
+            if node.is_variable or node.op is None:
+                continue
+            for pos, (src, _i) in enumerate(node.inputs):
+                if src.is_variable:
+                    uses.setdefault(src.name, []).append((node, pos))
+        out = set()
+        for name in self._param_names:
+            shp = self._arg_shapes.get(name)
+            if shp is None or len(shp) != 4:
+                continue
+            us = uses.get(name, ())
+            if us and all(n.op.name == "Convolution" and pos == 1
+                          for n, pos in us):
+                out.add(name)
+        return frozenset(out)
+
+    def _compute_view(self, params, compute_dtype):
+        """Compute-precision copies of the f32 masters, native-layout
+        weights rotated back to the reference OIHW view the graph
+        expects (the op-level OIHW->HWIO transpose then cancels, so the
+        conv consumes the HWIO master directly)."""
+        import jax.numpy as jnp
+        native = self._native_w
+        p = {}
+        for k, v in params.items():
+            v = v.astype(compute_dtype)
+            if k in native:
+                v = jnp.transpose(v, (3, 2, 0, 1))  # HWIO -> OIHW view
+            p[k] = v
+        return p
 
     def _put_state(self, value, target):
         """Stage a full host value (identical on every process) as a
@@ -921,13 +991,14 @@ class ShardedTrainer:
             bsz = next(iter(batch.values())).shape[0]
 
             def fwd(p32):
-                # compute-precision copies of the f32 masters; the astype
-                # vjp returns f32 grads automatically
+                # compute-precision copies of the f32 masters (the astype
+                # vjp returns f32 grads automatically); native-layout
+                # weights arrive HWIO and grads flow back HWIO
                 from ..ops.fused import (conv_bn_fusion, stem_s2d,
                                          elide_input_grads, phase_bwd,
                                          conv1x1_dot)
                 from .sequence import sequence_parallel as seq_ctx
-                p = {k: v.astype(compute_dtype) for k, v in p32.items()}
+                p = self._compute_view(p32, compute_dtype)
                 with image_layout(layout), \
                         conv_bn_fusion(self._fuse_conv_bn), \
                         stem_s2d(self._stem_s2d), \
@@ -1344,7 +1415,7 @@ class ShardedTrainer:
 
             def fwd(params, aux, batch):
                 from .sequence import sequence_parallel as seq_ctx
-                p = {k: v.astype(compute_dtype) for k, v in params.items()}
+                p = self._compute_view(params, compute_dtype)
                 bsz = next(iter(batch.values())).shape[0]
                 # loss heads still take label inputs at inference; their
                 # forward ignores the values, so zeros stand in
@@ -1399,9 +1470,14 @@ class ShardedTrainer:
         from .. import ndarray as _nd
         from . import multihost
 
+        def to_ref(k, a):
+            # native-layout masters/slots live HWIO on device; files
+            # keep the reference OIHW so checkpoints stay interoperable
+            return a.transpose(3, 2, 0, 1) if k in self._native_w else a
+
         host = {}
         for k, v in self.params.items():
-            host["arg:%s" % k] = multihost.gather_to_host(v)
+            host["arg:%s" % k] = to_ref(k, multihost.gather_to_host(v))
         for k, v in self.aux.items():
             host["aux:%s" % k] = multihost.gather_to_host(v)
         st = None
@@ -1411,7 +1487,8 @@ class ShardedTrainer:
                 _np.int64)}
             for k, slots in self.opt_state.items():
                 for i, sl in enumerate(slots):
-                    st["slot%d:%s" % (i, k)] = multihost.gather_to_host(sl)
+                    st["slot%d:%s" % (i, k)] = to_ref(
+                        k, multihost.gather_to_host(sl))
         if not self._multiproc or jax.process_index() == 0:
             self.symbol.save("%s-symbol.json" % prefix)
             _nd.save("%s-%04d.params" % (prefix, epoch),
@@ -1452,10 +1529,14 @@ class ShardedTrainer:
             raise MXNetError(
                 "checkpoint/model mismatch: missing %s, unexpected %s"
                 % (sorted(missing), sorted(unexpected)))
+        def to_store(name, a):
+            # files hold reference OIHW; native-layout state lives HWIO
+            return a.transpose(2, 3, 1, 0) if name in self._native_w else a
+
         with self.mesh:
             for name, v in file_args.items():
                 self.params[name] = self._put_state(
-                    _np.asarray(v.asnumpy(), _np.float32),
+                    to_store(name, _np.asarray(v.asnumpy(), _np.float32)),
                     self._state_target(self.params[name],
                                        self._param_sharding[name]))
             for name, v in file_aux.items():
@@ -1490,7 +1571,8 @@ class ShardedTrainer:
                     slot, name = k.split(":", 1)
                     i = int(slot[len("slot"):])
                     self.opt_state[name][i] = self._put_state(
-                        _np.asarray(v.asnumpy(), _np.float32),
+                        to_store(name,
+                                 _np.asarray(v.asnumpy(), _np.float32)),
                         self._state_target(self.opt_state[name][i],
                                            self._param_sharding[name]))
 

@@ -512,8 +512,18 @@ class Symbol:
                 known[k] = np.dtype(v).name
         topo = self._topo()
         arg_nodes, aux_nodes = _classify_vars(topo)
+        # reference InferType propagates the known dtype to the other
+        # float arguments of each op (same-dtype rule): typing only the
+        # data input types the whole net (test_utils.check_consistency
+        # depends on this).  Conservative version: when every explicitly
+        # known dtype agrees on one float type, unknown un-attributed
+        # args default to it instead of float32.
+        default = "float32"
+        kt = {np.dtype(v).name for v in known.values()}
+        if len(kt) == 1 and np.dtype(next(iter(kt))).kind == "f":
+            default = next(iter(kt))
         arg_types = [np.dtype(known.get(
-            n.name, n.raw_attr.get("__dtype__", "float32")))
+            n.name, n.raw_attr.get("__dtype__", default)))
             for n in arg_nodes]
         aux_types = [np.dtype(known.get(
             n.name, n.raw_attr.get("__dtype__", "float32")))

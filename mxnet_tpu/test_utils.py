@@ -106,10 +106,10 @@ def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
                                            f"of {op_name}")
 
 
-def check_consistency(op_name, input_arrays, attrs=None, dtypes=("float32",),
-                      rtol=1e-4, atol=1e-5):
-    """Run the op across dtypes and compare (reference check_consistency's
-    cross-device role; devices are uniform under XLA so dtype is the axis)."""
+def check_consistency_op(op_name, input_arrays, attrs=None,
+                         dtypes=("float32",), rtol=1e-4, atol=1e-5):
+    """Per-op dtype sweep: run the op across dtypes and compare (the
+    imperative slice of the reference check_consistency's role)."""
     from . import ops
     attrs = attrs or {}
     outs = []
@@ -122,3 +122,152 @@ def check_consistency(op_name, input_arrays, attrs=None, dtypes=("float32",),
     for o in outs[1:]:
         np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
     return outs
+
+
+def _dtype_rank(dt):
+    """Precision order for picking the ground-truth executor."""
+    dt = np.dtype(dt)
+    if dt.kind == "f":
+        return np.finfo(dt).nmant
+    try:  # bfloat16 and friends are extension dtypes with finfo
+        import ml_dtypes  # noqa: F401
+        return np.finfo(dt).nmant
+    except Exception:
+        return 0
+
+
+def default_tols():
+    """Per-dtype comparison tolerance (reference check_consistency's
+    table, plus bfloat16 — the TPU compute dtype)."""
+    import jax.numpy as jnp
+    return {np.dtype(np.float16): 1e-1,
+            np.dtype(jnp.bfloat16): 1e-1,
+            np.dtype(np.float32): 1e-3,
+            np.dtype(np.float64): 1e-5,
+            np.dtype(np.uint8): 0,
+            np.dtype(np.int32): 0}
+
+
+def check_consistency(sym, ctx_list=None, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None, **op_kwargs):
+    """Symbol-level cross-context/cross-dtype consistency harness.
+
+    Reference: python/mxnet/test_utils.py:765 ``check_consistency`` — the
+    harness the reference GPU suite is built on.  Bind the SAME symbol
+    under every entry of ``ctx_list`` (each a dict with ``'ctx'``, input
+    shapes by name, and an optional ``'type_dict'``), initialize all
+    executors with identical parameters, then compare forward outputs
+    (predict), and forward+backward outputs and input gradients (train)
+    against the highest-precision executor, within per-dtype tolerance.
+
+    Devices are uniform under XLA, so dtype is the main axis here; ctx
+    entries may still differ (cpu vs tpu) and the comparison is
+    cross-executor either way.
+
+    Back-compat: called with an op-name string, dispatches to the
+    original per-op dtype sweep (:func:`check_consistency_op`).
+    """
+    from .symbol import Symbol
+
+    if isinstance(sym, str):  # legacy per-op form
+        return check_consistency_op(sym, ctx_list, **op_kwargs)
+
+    if tol is None:
+        tol = default_tols()
+    elif isinstance(tol, (int, float)):
+        tol = {dt: tol for dt in default_tols()}
+
+    assert len(ctx_list) > 1
+    if isinstance(sym, Symbol):
+        sym = [sym] * len(ctx_list)
+    assert len(sym) == len(ctx_list)
+
+    output_names = sym[0].list_outputs()
+    arg_names = sym[0].list_arguments()
+    exe_list = []
+    for s, ctx in zip(sym, ctx_list):
+        assert s.list_arguments() == arg_names
+        assert s.list_outputs() == output_names
+        kwargs = dict(ctx)
+        dev = kwargs.pop("ctx", None)
+        exe_list.append(s.simple_bind(ctx=dev, grad_req=grad_req,
+                                      **kwargs))
+
+    arg_params = {} if arg_params is None else dict(arg_params)
+    aux_params = {} if aux_params is None else dict(aux_params)
+    rng = np.random.RandomState(0)
+    for n, arr in exe_list[0].arg_dict.items():
+        if n not in arg_params:
+            arg_params[n] = rng.normal(size=arr.shape, scale=scale)
+    for n, arr in exe_list[0].aux_dict.items():
+        if n not in aux_params:
+            aux_params[n] = np.zeros(arr.shape)
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = arg_params[name].astype(arr.dtype)
+        for name, arr in exe.aux_dict.items():
+            arr[:] = aux_params[name].astype(arr.dtype)
+
+    # ---- predict phase (executors expose outputs only after forward)
+    for exe in exe_list:
+        exe.forward(is_train=False)
+    dtypes = [np.dtype(exe.outputs[0].dtype) for exe in exe_list]
+    max_idx = int(np.argmax([_dtype_rank(dt) for dt in dtypes]))
+
+    def tol_of(i):
+        t = tol.get(dtypes[i])
+        if t is None:
+            t = tol.get(np.dtype(np.float32), 1e-3)
+        return t
+
+    def compare(i, name, arr, gtarr, phase):
+        t = tol_of(i)
+        try:
+            np.testing.assert_allclose(
+                np.asarray(arr.asnumpy(), np.float64),
+                np.asarray(gtarr, np.float64), rtol=t, atol=t,
+                err_msg="%s err: ctx %d vs ctx %d at %s"
+                        % (phase, i, max_idx, name))
+        except AssertionError:
+            if raise_on_err:
+                raise
+            import traceback
+            traceback.print_exc()
+
+    gt = ground_truth
+    if gt is None:
+        gt = {name: out.asnumpy()
+              for name, out in zip(output_names, exe_list[max_idx].outputs)}
+    for i, exe in enumerate(exe_list):
+        if i == max_idx and ground_truth is None:
+            continue
+        for name, arr in zip(output_names, exe.outputs):
+            compare(i, name, arr, gt[name], "predict")
+
+    # ---- train phase: forward + backward with the outputs as head
+    # grads.  A caller-supplied ground_truth stays authoritative
+    # (reference contract) — the max-precision executor only fills the
+    # keys the caller did not provide, and is itself compared when an
+    # external ground truth exists.
+    if grad_req != "null":
+        for exe in exe_list:
+            exe.forward(is_train=True)
+            exe.backward(list(exe.outputs))
+        ref = exe_list[max_idx]
+        gt = {name: out.asnumpy()
+              for name, out in zip(output_names, ref.outputs)}
+        for name, g in ref.grad_dict.items():
+            gt["grad:" + name] = g.asnumpy()
+        if ground_truth is not None:
+            gt.update(ground_truth)   # external truth stays authoritative
+        for i, exe in enumerate(exe_list):
+            if i == max_idx and ground_truth is None:
+                continue
+            for name, arr in zip(output_names, exe.outputs):
+                compare(i, name, arr, gt[name], "train-out")
+            for name, g in exe.grad_dict.items():
+                if "grad:" + name in gt:
+                    compare(i, "grad:" + name, g, gt["grad:" + name],
+                            "train-grad")
+    return gt

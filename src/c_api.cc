@@ -1,0 +1,576 @@
+// Flat C ABI (include/mxnet_tpu/c_api.h): the MXNDArray*/MXSymbol*
+// subsets of the reference include/mxnet/c_api.h, implemented over the
+// embedded interpreter like the predict/train ABIs (architecture:
+// src/c_predict_api.cc).  Handles own references to REAL framework
+// objects (mxnet_tpu NDArray / Symbol via mxnet_tpu/c_api.py), so the
+// ABI is a boundary onto the framework, not a bespoke session object:
+// files written from C load in python and vice versa.
+//
+// Reference counterparts: src/c_api/c_api.cc:1-847 (ndarray+symbol
+// sections); error convention API_BEGIN/API_END -> guard macros here.
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_api.h"
+#include "py_embed_common.h"
+
+namespace {
+
+using mxtpu_embed::EnsurePython;
+using mxtpu_embed::Gil;
+using mxtpu_embed::Ref;
+using mxtpu_embed::SetPyError;
+using mxtpu_embed::g_last_error;
+
+// a handle owns one python object plus scratch buffers backing the
+// const char*/mx_uint* returns made from it (freed with the handle)
+struct Handle {
+  PyObject *obj = nullptr;
+  std::vector<std::string> str_store;
+  std::vector<const char *> str_ptrs;
+  std::vector<mx_uint> shape_store;
+  std::string text;
+  explicit Handle(PyObject *o) : obj(o) {}  // steals the reference
+  ~Handle() { Py_XDECREF(obj); }
+};
+
+// thread-local scratch for returns not tied to one handle (load lists,
+// creator names) — reference keeps these in its per-thread ret store
+struct Scratch {
+  std::vector<std::string> names;
+  std::vector<const char *> name_ptrs;
+  std::vector<NDArrayHandle> handles;
+  std::vector<AtomicSymbolCreator> creators;
+};
+inline Scratch &TlsScratch() {
+  static thread_local Scratch s;
+  return s;
+}
+
+// cached op-name list; creator == index+1 (0 stays invalid)
+std::vector<std::string> &OpNames() {
+  static std::vector<std::string> names;  // filled under the GIL once
+  return names;
+}
+
+PyObject *Driver() {  // borrowed module ref (cached by CPython)
+  return PyImport_ImportModule("mxnet_tpu.c_api");
+}
+
+// call mxnet_tpu.c_api.<fn>(...) -> new reference or nullptr
+PyObject *CallDriver(const char *fn, PyObject *args) {
+  Ref mod(Driver());
+  if (!mod) return nullptr;
+  Ref f(PyObject_GetAttrString(mod.p, fn));
+  if (!f) return nullptr;
+  return PyObject_CallObject(f.p, args);
+}
+
+PyObject *StrList(const char **strs, mx_uint n) {
+  PyObject *lst = PyList_New(n);
+  if (!lst) return nullptr;
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(strs[i] ? strs[i] : ""));
+  }
+  return lst;
+}
+
+const char *DTypeName(int dtype) {
+  switch (dtype) {  // reference type codes + bfloat16 extension
+    case 0: return "float32";
+    case 1: return "float64";
+    case 2: return "float16";
+    case 3: return "uint8";
+    case 4: return "int32";
+    case 5: return "int8";
+    case 6: return "int64";
+    case 7: return "bfloat16";
+    default: return nullptr;
+  }
+}
+
+int DTypeCode(const std::string &name) {
+  const char *names[] = {"float32", "float64", "float16", "uint8",
+                         "int32",   "int8",    "int64",   "bfloat16"};
+  for (int i = 0; i < 8; ++i) {
+    if (name == names[i]) return i;
+  }
+  return -1;
+}
+
+size_t DTypeBytes(int code) {
+  switch (code) {
+    case 1: case 6: return 8;
+    case 0: case 4: return 4;
+    case 2: case 7: return 2;
+    default: return 1;
+  }
+}
+
+// copy a python list of str into a handle's string store
+bool FillStrs(Handle *h, PyObject *lst) {
+  h->str_store.clear();
+  h->str_ptrs.clear();
+  const Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PyList_GET_ITEM(lst, i);
+    const char *s = PyUnicode_AsUTF8(it);
+    if (s == nullptr) return false;
+    h->str_store.emplace_back(s);
+  }
+  for (auto &s : h->str_store) h->str_ptrs.push_back(s.c_str());
+  return true;
+}
+
+}  // namespace
+
+#define API_GUARD()  EnsurePython()
+
+#define CHECK_HANDLE(h)                                              \
+  do {                                                               \
+    if ((h) == nullptr) {                                            \
+      g_last_error = "null handle";                                  \
+      return -1;                                                     \
+    }                                                                \
+  } while (0)
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+// ------------------------------------------------------------ ndarray
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int /*delay_alloc*/, int dtype,
+                      NDArrayHandle *out) {
+  API_GUARD();
+  Gil gil;
+  const char *dt = DTypeName(dtype);
+  if (dt == nullptr) {
+    g_last_error = "unknown dtype code " + std::to_string(dtype);
+    return -1;
+  }
+  Ref shp(PyTuple_New(ndim));
+  if (!shp) { SetPyError(); return -1; }
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp.p, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  Ref args(Py_BuildValue("(Osii)", shp.p, dt, dev_type, dev_id));
+  if (!args) { SetPyError(); return -1; }
+  PyObject *arr = CallDriver("nd_create", args.p);
+  if (arr == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(arr);
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc,
+                           0, out);
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  int code = 0;
+  {
+    Ref args(Py_BuildValue("(O)", h->obj));
+    Ref dt(CallDriver("nd_dtype", args.p));
+    if (!dt) { SetPyError(); return -1; }
+    code = DTypeCode(PyUnicode_AsUTF8(dt.p));
+  }
+  Ref bytes(PyBytes_FromStringAndSize(
+      static_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * DTypeBytes(code))));
+  if (!bytes) { SetPyError(); return -1; }
+  Ref args(Py_BuildValue("(OO)", h->obj, bytes.p));
+  Ref r(CallDriver("nd_from_bytes", args.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref bytes(CallDriver("nd_to_bytes", args.p));
+  if (!bytes) { SetPyError(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes.p, &buf, &n) != 0) {
+    SetPyError();
+    return -1;
+  }
+  int code = 0;
+  {
+    Ref a2(Py_BuildValue("(O)", h->obj));
+    Ref dt(CallDriver("nd_dtype", a2.p));
+    if (!dt) { SetPyError(); return -1; }
+    code = DTypeCode(PyUnicode_AsUTF8(dt.p));
+  }
+  const size_t want = size * DTypeBytes(code);
+  if (static_cast<size_t>(n) > want) {
+    g_last_error = "destination buffer too small";
+    return -1;
+  }
+  std::memcpy(data, buf, static_cast<size_t>(n));
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref shp(CallDriver("nd_shape", args.p));
+  if (!shp) { SetPyError(); return -1; }
+  const Py_ssize_t nd = PyTuple_Size(shp.p);
+  h->shape_store.clear();
+  for (Py_ssize_t i = 0; i < nd; ++i) {
+    h->shape_store.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp.p, i))));
+  }
+  *out_dim = static_cast<mx_uint>(nd);
+  *out_pdata = h->shape_store.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref dt(CallDriver("nd_dtype", args.p));
+  if (!dt) { SetPyError(); return -1; }
+  *out_dtype = DTypeCode(PyUnicode_AsUTF8(dt.p));
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref ctx(CallDriver("nd_context", args.p));
+  if (!ctx) { SetPyError(); return -1; }
+  *out_dev_type = static_cast<int>(
+      PyLong_AsLong(PyTuple_GET_ITEM(ctx.p, 0)));
+  *out_dev_id = static_cast<int>(
+      PyLong_AsLong(PyTuple_GET_ITEM(ctx.p, 1)));
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref shp(PyTuple_New(ndim));
+  if (!shp) { SetPyError(); return -1; }
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp.p, i, PyLong_FromLong(dims[i]));
+  }
+  Ref args(Py_BuildValue("(OO)", h->obj, shp.p));
+  PyObject *arr = CallDriver("nd_reshape", args.p);
+  if (arr == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(arr);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(OII)", h->obj, slice_begin, slice_end));
+  PyObject *arr = CallDriver("nd_slice", args.p);
+  if (arr == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(arr);
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args_h, const char **keys) {
+  API_GUARD();
+  Gil gil;
+  Ref arrs(PyList_New(num_args));
+  if (!arrs) { SetPyError(); return -1; }
+  for (mx_uint i = 0; i < num_args; ++i) {
+    if (args_h[i] == nullptr) {
+      g_last_error = "null NDArrayHandle in save list";
+      return -1;
+    }
+    PyObject *o = static_cast<Handle *>(args_h[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrs.p, i, o);
+  }
+  Ref keylist(keys ? StrList(keys, num_args)
+                   : (Py_INCREF(Py_None), Py_None));
+  if (!keylist) { SetPyError(); return -1; }
+  Ref args(Py_BuildValue("(sOO)", fname, arrs.p, keylist.p));
+  Ref r(CallDriver("nd_save", args.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  API_GUARD();
+  Gil gil;
+  Ref args(Py_BuildValue("(s)", fname));
+  Ref res(CallDriver("nd_load", args.p));
+  if (!res) { SetPyError(); return -1; }
+  PyObject *names = PyTuple_GET_ITEM(res.p, 0);
+  PyObject *arrs = PyTuple_GET_ITEM(res.p, 1);
+  Scratch &sc = TlsScratch();
+  sc.names.clear();
+  sc.name_ptrs.clear();
+  sc.handles.clear();
+  const Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(arrs, i);
+    Py_INCREF(o);
+    sc.handles.push_back(new Handle(o));
+  }
+  if (names != Py_None) {
+    const Py_ssize_t m = PyList_Size(names);
+    for (Py_ssize_t i = 0; i < m; ++i) {
+      sc.names.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+    }
+  }
+  for (auto &s : sc.names) sc.name_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(sc.handles.size());
+  *out_arr = sc.handles.data();
+  *out_name_size = static_cast<mx_uint>(sc.name_ptrs.size());
+  *out_names = sc.name_ptrs.data();
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  API_GUARD();
+  return 0;  // host copies above are synchronous already
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  EnsurePython();
+  Gil gil;
+  delete static_cast<Handle *>(handle);
+  return 0;
+}
+
+// ------------------------------------------------------------- symbol
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  API_GUARD();
+  Gil gil;
+  if (OpNames().empty()) {
+    Ref args(PyTuple_New(0));
+    Ref lst(CallDriver("op_names", args.p));
+    if (!lst) { SetPyError(); return -1; }
+    const Py_ssize_t n = PyList_Size(lst.p);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      OpNames().emplace_back(
+          PyUnicode_AsUTF8(PyList_GET_ITEM(lst.p, i)));
+    }
+  }
+  Scratch &sc = TlsScratch();
+  sc.creators.clear();
+  for (size_t i = 0; i < OpNames().size(); ++i) {
+    sc.creators.push_back(reinterpret_cast<AtomicSymbolCreator>(i + 1));
+  }
+  *out_size = static_cast<mx_uint>(sc.creators.size());
+  *out_array = sc.creators.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  API_GUARD();
+  Gil gil;
+  const size_t idx = reinterpret_cast<size_t>(creator);
+  if (idx == 0 || idx > OpNames().size()) {
+    g_last_error = "invalid AtomicSymbolCreator (call "
+                   "MXSymbolListAtomicSymbolCreators first)";
+    return -1;
+  }
+  *name = OpNames()[idx - 1].c_str();
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out) {
+  API_GUARD();
+  Gil gil;
+  const size_t idx = reinterpret_cast<size_t>(creator);
+  if (idx == 0 || idx > OpNames().size()) {
+    g_last_error = "invalid AtomicSymbolCreator";
+    return -1;
+  }
+  Ref ks(StrList(keys, num_param));
+  Ref vs(StrList(vals, num_param));
+  if (!ks || !vs) { SetPyError(); return -1; }
+  Ref args(Py_BuildValue("(sOO)", OpNames()[idx - 1].c_str(), ks.p, vs.p));
+  PyObject *stub = CallDriver("create_atomic", args.p);
+  if (stub == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(stub);
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  API_GUARD();
+  Gil gil;
+  Ref args(Py_BuildValue("(s)", name));
+  PyObject *v = CallDriver("create_variable", args.p);
+  if (v == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(v);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args_h) {
+  API_GUARD();
+  CHECK_HANDLE(sym);
+  Gil gil;
+  auto h = static_cast<Handle *>(sym);
+  Ref arglist(PyList_New(num_args));
+  if (!arglist) { SetPyError(); return -1; }
+  for (mx_uint i = 0; i < num_args; ++i) {
+    if (args_h[i] == nullptr) {
+      g_last_error = "null SymbolHandle in compose args";
+      return -1;
+    }
+    PyObject *o = static_cast<Handle *>(args_h[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arglist.p, i, o);
+  }
+  Ref ks(keys ? StrList(keys, num_args)
+              : (Py_INCREF(Py_None), Py_None));
+  Ref cargs(Py_BuildValue("(OsOO)", h->obj, name ? name : "", ks.p,
+                          arglist.p));
+  PyObject *composed = CallDriver("compose", cargs.p);
+  if (composed == nullptr) { SetPyError(); return -1; }
+  // reference semantics: compose mutates the symbol in place
+  Py_XDECREF(h->obj);
+  h->obj = composed;
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  API_GUARD();
+  Gil gil;
+  Ref args(Py_BuildValue("(s)", json));
+  PyObject *s = CallDriver("sym_from_json", args.p);
+  if (s == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(s);
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  API_GUARD();
+  Gil gil;
+  Ref args(Py_BuildValue("(s)", fname));
+  PyObject *s = CallDriver("sym_from_file", args.p);
+  if (s == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(s);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  API_GUARD();
+  CHECK_HANDLE(symbol);
+  Gil gil;
+  auto h = static_cast<Handle *>(symbol);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref js(CallDriver("sym_to_json", args.p));
+  if (!js) { SetPyError(); return -1; }
+  h->text = PyUnicode_AsUTF8(js.p);
+  *out_json = h->text.c_str();
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  API_GUARD();
+  CHECK_HANDLE(symbol);
+  Gil gil;
+  auto h = static_cast<Handle *>(symbol);
+  Ref args(Py_BuildValue("(Os)", h->obj, fname));
+  Ref r(CallDriver("sym_save", args.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+static int ListStrings(SymbolHandle symbol, const char *fn,
+                       mx_uint *out_size, const char ***out_str_array) {
+  API_GUARD();
+  CHECK_HANDLE(symbol);
+  Gil gil;
+  auto h = static_cast<Handle *>(symbol);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref lst(CallDriver(fn, args.p));
+  if (!lst) { SetPyError(); return -1; }
+  if (!FillStrs(h, lst.p)) { SetPyError(); return -1; }
+  *out_size = static_cast<mx_uint>(h->str_ptrs.size());
+  *out_str_array = h->str_ptrs.data();
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array) {
+  return ListStrings(symbol, "sym_list_arguments", out_size,
+                     out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array) {
+  return ListStrings(symbol, "sym_list_outputs", out_size, out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array) {
+  return ListStrings(symbol, "sym_list_aux", out_size, out_str_array);
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  API_GUARD();
+  CHECK_HANDLE(symbol);
+  Gil gil;
+  auto h = static_cast<Handle *>(symbol);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref nm(CallDriver("sym_name", args.p));
+  if (!nm) { SetPyError(); return -1; }
+  h->text = PyUnicode_AsUTF8(nm.p);
+  *success = h->text.empty() ? 0 : 1;
+  *out = h->text.c_str();
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  if (symbol == nullptr) return 0;
+  EnsurePython();
+  Gil gil;
+  delete static_cast<Handle *>(symbol);
+  return 0;
+}
+
+}  // extern "C"

@@ -71,6 +71,19 @@ long CopyNumpyOut(PyObject *arr, mx_float *data, mx_uint size) {
 
 }  // namespace
 
+// Every handle-taking entry point honours the 0/-1 error contract on a
+// NULL handle and guarantees the interpreter exists before taking the
+// GIL (reference c_api API_BEGIN role).
+#define MXTPU_GUARD_HANDLE(h)                                          \
+  do {                                                                 \
+    if ((h) == nullptr) {                                              \
+      g_last_error =                                                   \
+          "null TrainHandle (MXTrainCreate must succeed first)";       \
+      return -1;                                                       \
+    }                                                                  \
+    EnsurePython();                                                    \
+  } while (0)
+
 extern "C" {
 
 const char *MXTrainGetLastError() { return g_last_error.c_str(); }
@@ -122,6 +135,7 @@ int MXTrainCreate(const char *symbol_json_str, int dev_type, int dev_id,
 
 int MXTrainSetInput(TrainHandle handle, const char *key,
                     const mx_float *data, mx_uint size) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref flat(FloatsToNumpy(data, size));
@@ -133,6 +147,7 @@ int MXTrainSetInput(TrainHandle handle, const char *key,
 }
 
 int MXTrainForward(TrainHandle handle, int is_train) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref r(PyObject_CallMethod(rec->session, "forward", "i", is_train));
@@ -141,6 +156,7 @@ int MXTrainForward(TrainHandle handle, int is_train) {
 }
 
 int MXTrainBackward(TrainHandle handle) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref r(PyObject_CallMethod(rec->session, "backward", nullptr));
@@ -150,6 +166,7 @@ int MXTrainBackward(TrainHandle handle) {
 
 int MXTrainSGDUpdate(TrainHandle handle, mx_float lr, mx_float momentum,
                      mx_float wd, mx_float rescale_grad) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref r(PyObject_CallMethod(rec->session, "sgd_update", "ffff",
@@ -162,6 +179,7 @@ int MXTrainSGDUpdate(TrainHandle handle, mx_float lr, mx_float momentum,
 }
 
 int MXTrainGetOutputCount(TrainHandle handle, mx_uint *out) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref r(PyObject_CallMethod(rec->session, "num_outputs", nullptr));
@@ -172,6 +190,7 @@ int MXTrainGetOutputCount(TrainHandle handle, mx_uint *out) {
 
 int MXTrainGetOutputShape(TrainHandle handle, mx_uint index,
                           mx_uint **shape_data, mx_uint *shape_ndim) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref shape(PyObject_CallMethod(rec->session, "get_output_shape", "I",
@@ -190,6 +209,7 @@ int MXTrainGetOutputShape(TrainHandle handle, mx_uint index,
 
 int MXTrainGetOutput(TrainHandle handle, mx_uint index, mx_float *data,
                      mx_uint size) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref arr(PyObject_CallMethod(rec->session, "get_output", "I", index));
@@ -199,6 +219,7 @@ int MXTrainGetOutput(TrainHandle handle, mx_uint index, mx_float *data,
 
 int MXTrainGetArray(TrainHandle handle, const char *kind,
                     const char *name, mx_float *data, mx_uint size) {
+  MXTPU_GUARD_HANDLE(handle);
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Ref arr(PyObject_CallMethod(rec->session, "get_array", "ss", name,
@@ -208,6 +229,8 @@ int MXTrainGetArray(TrainHandle handle, const char *kind,
 }
 
 int MXTrainFree(TrainHandle handle) {
+  if (handle == nullptr) return 0;  // free(NULL) semantics
+  EnsurePython();
   Gil gil;
   auto rec = static_cast<TrainRecord *>(handle);
   Py_XDECREF(rec->session);

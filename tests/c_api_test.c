@@ -1,0 +1,219 @@
+/* Exercises the widened flat C ABI (include/mxnet_tpu/c_api.h):
+ * builds a symbol from atomic creators + compose, round-trips it
+ * through JSON, and creates/saves/loads NDArrays in the reference
+ * container — cross-checked against python by the pytest wrapper
+ * (tests/test_c_api.py).
+ *
+ * Usage: c_api_test <out_dir> <python_written.params>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu/c_api.h"
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s (last: %s)\n", __FILE__,        \
+              __LINE__, #cond, MXGetLastError());                     \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+static AtomicSymbolCreator find_creator(const char *want) {
+  mx_uint n = 0;
+  AtomicSymbolCreator *cs = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &cs) != 0) return NULL;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *nm = NULL;
+    if (MXSymbolGetAtomicSymbolName(cs[i], &nm) != 0) return NULL;
+    if (strcmp(nm, want) == 0) return cs[i];
+  }
+  return NULL;
+}
+
+static int has_arg(const char **args, mx_uint n, const char *want) {
+  for (mx_uint i = 0; i < n; ++i) {
+    if (strcmp(args[i], want) == 0) return 1;
+  }
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <out_dir> <py.params>\n", argv[0]);
+    return 2;
+  }
+  char path[1024];
+
+  /* ---- registry: creator discovery (reference ListAtomicSymbolCreators
+   * over the single op registry; >=150 ops expected) */
+  mx_uint ncreators = 0;
+  AtomicSymbolCreator *creators = NULL;
+  CHECK(MXSymbolListAtomicSymbolCreators(&ncreators, &creators) == 0);
+  CHECK(ncreators >= 150);
+  AtomicSymbolCreator fc = find_creator("FullyConnected");
+  AtomicSymbolCreator act = find_creator("Activation");
+  AtomicSymbolCreator sm = find_creator("SoftmaxOutput");
+  CHECK(fc != NULL && act != NULL && sm != NULL);
+
+  /* ---- build an MLP via atomic+compose (the reference binding flow) */
+  SymbolHandle data = NULL, l1 = NULL, a1 = NULL, l2 = NULL, out = NULL;
+  CHECK(MXSymbolCreateVariable("data", &data) == 0);
+
+  const char *k1[] = {"num_hidden"};
+  const char *v1[] = {"16"};
+  CHECK(MXSymbolCreateAtomicSymbol(fc, 1, k1, v1, &l1) == 0);
+  SymbolHandle in1[] = {data};
+  CHECK(MXSymbolCompose(l1, "fc1", 1, NULL, in1) == 0);
+
+  const char *k2[] = {"act_type"};
+  const char *v2[] = {"relu"};
+  CHECK(MXSymbolCreateAtomicSymbol(act, 1, k2, v2, &a1) == 0);
+  SymbolHandle in2[] = {l1};
+  CHECK(MXSymbolCompose(a1, "relu1", 1, NULL, in2) == 0);
+
+  const char *v3[] = {"5"};
+  CHECK(MXSymbolCreateAtomicSymbol(fc, 1, k1, v3, &l2) == 0);
+  SymbolHandle in3[] = {a1};
+  CHECK(MXSymbolCompose(l2, "fc2", 1, NULL, in3) == 0);
+
+  CHECK(MXSymbolCreateAtomicSymbol(sm, 0, NULL, NULL, &out) == 0);
+  SymbolHandle in4[] = {l2};
+  CHECK(MXSymbolCompose(out, "softmax", 1, NULL, in4) == 0);
+
+  mx_uint nargs = 0;
+  const char **args = NULL;
+  CHECK(MXSymbolListArguments(out, &nargs, &args) == 0);
+  CHECK(nargs == 6);
+  CHECK(has_arg(args, nargs, "data"));
+  CHECK(has_arg(args, nargs, "fc1_weight"));
+  CHECK(has_arg(args, nargs, "fc1_bias"));
+  CHECK(has_arg(args, nargs, "fc2_weight"));
+  CHECK(has_arg(args, nargs, "softmax_label"));
+
+  mx_uint nouts = 0;
+  const char **outs = NULL;
+  CHECK(MXSymbolListOutputs(out, &nouts, &outs) == 0);
+  CHECK(nouts == 1 && strcmp(outs[0], "softmax_output") == 0);
+
+  const char *name = NULL;
+  int success = 0;
+  CHECK(MXSymbolGetName(out, &name, &success) == 0);
+  CHECK(success == 1 && strcmp(name, "softmax") == 0);
+
+  /* ---- JSON round trip + file save (python cross-loads this) */
+  const char *json = NULL;
+  CHECK(MXSymbolSaveToJSON(out, &json) == 0);
+  CHECK(strstr(json, "FullyConnected") != NULL);
+  SymbolHandle again = NULL;
+  CHECK(MXSymbolCreateFromJSON(json, &again) == 0);
+  mx_uint nargs2 = 0;
+  const char **args2 = NULL;
+  CHECK(MXSymbolListArguments(again, &nargs2, &args2) == 0);
+  CHECK(nargs2 == nargs);
+  snprintf(path, sizeof(path), "%s/net-symbol.json", argv[1]);
+  CHECK(MXSymbolSaveToFile(out, path) == 0);
+  SymbolHandle fromfile = NULL;
+  CHECK(MXSymbolCreateFromFile(path, &fromfile) == 0);
+  MXSymbolFree(fromfile);
+  MXSymbolFree(again);
+
+  /* ---- error contract: bad symbol JSON -> -1 + message, not a crash */
+  SymbolHandle bad = NULL;
+  CHECK(MXSymbolCreateFromJSON("{not json", &bad) == -1);
+  CHECK(strlen(MXGetLastError()) > 0);
+
+  /* ---- ndarray: create/fill/readback/shape/dtype/reshape/slice */
+  mx_uint shape[2] = {3, 4};
+  NDArrayHandle w = NULL;
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &w) == 0);
+  float host[12];
+  for (int i = 0; i < 12; ++i) host[i] = (float)i * 0.5f;
+  CHECK(MXNDArraySyncCopyFromCPU(w, host, 12) == 0);
+  float back[12];
+  CHECK(MXNDArraySyncCopyToCPU(w, back, 12) == 0);
+  for (int i = 0; i < 12; ++i) CHECK(back[i] == host[i]);
+
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  CHECK(MXNDArrayGetShape(w, &ndim, &dims) == 0);
+  CHECK(ndim == 2 && dims[0] == 3 && dims[1] == 4);
+  int dtype = -1;
+  CHECK(MXNDArrayGetDType(w, &dtype) == 0);
+  CHECK(dtype == 0);
+  int dev_type = 0, dev_id = -1;
+  CHECK(MXNDArrayGetContext(w, &dev_type, &dev_id) == 0);
+  CHECK(dev_type == 1 && dev_id == 0);
+
+  int newdims[2] = {4, 3};
+  NDArrayHandle wr = NULL;
+  CHECK(MXNDArrayReshape(w, 2, newdims, &wr) == 0);
+  CHECK(MXNDArrayGetShape(wr, &ndim, &dims) == 0);
+  CHECK(ndim == 2 && dims[0] == 4 && dims[1] == 3);
+  NDArrayHandle ws = NULL;
+  CHECK(MXNDArraySlice(w, 1, 3, &ws) == 0);
+  CHECK(MXNDArrayGetShape(ws, &ndim, &dims) == 0);
+  CHECK(ndim == 2 && dims[0] == 2 && dims[1] == 4);
+  float srow[8];
+  CHECK(MXNDArraySyncCopyToCPU(ws, srow, 8) == 0);
+  CHECK(srow[0] == host[4] && srow[7] == host[11]);
+
+  /* int32 array via CreateEx */
+  mx_uint bshape[1] = {5};
+  NDArrayHandle b = NULL;
+  CHECK(MXNDArrayCreateEx(bshape, 1, 1, 0, 0, 4, &b) == 0);
+  int bi[5] = {1, 2, 3, 4, 5};
+  CHECK(MXNDArraySyncCopyFromCPU(b, bi, 5) == 0);
+  CHECK(MXNDArrayGetDType(b, &dtype) == 0 && dtype == 4);
+
+  /* ---- save keyed + load back (reference container) */
+  snprintf(path, sizeof(path), "%s/c_written.params", argv[1]);
+  NDArrayHandle savelist[2] = {w, b};
+  const char *keys[2] = {"arg:w", "arg:b"};
+  CHECK(MXNDArraySave(path, 2, savelist, keys) == 0);
+
+  mx_uint nload = 0, nname = 0;
+  NDArrayHandle *loaded = NULL;
+  const char **names = NULL;
+  CHECK(MXNDArrayLoad(path, &nload, &loaded, &nname, &names) == 0);
+  CHECK(nload == 2 && nname == 2);
+  for (mx_uint i = 0; i < nload; ++i) {
+    if (strcmp(names[i], "arg:w") == 0) {
+      float got[12];
+      CHECK(MXNDArraySyncCopyToCPU(loaded[i], got, 12) == 0);
+      for (int j = 0; j < 12; ++j) CHECK(got[j] == host[j]);
+    } else {
+      CHECK(strcmp(names[i], "arg:b") == 0);
+    }
+  }
+
+  /* ---- cross-language: load the python-written file */
+  mx_uint pload = 0, pname = 0;
+  NDArrayHandle *pyarrs = NULL;
+  const char **pynames = NULL;
+  CHECK(MXNDArrayLoad(argv[2], &pload, &pyarrs, &pname, &pynames) == 0);
+  CHECK(pload == 1 && pname == 1);
+  CHECK(strcmp(pynames[0], "arg:ramp") == 0);
+  float ramp[6];
+  CHECK(MXNDArraySyncCopyToCPU(pyarrs[0], ramp, 6) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(ramp[i] == (float)i * 2.0f);
+  MXNDArrayFree(pyarrs[0]);
+
+  /* ---- error contract on null handles */
+  CHECK(MXNDArrayGetDType(NULL, &dtype) == -1);
+  CHECK(strlen(MXGetLastError()) > 0);
+
+  MXNDArrayFree(w);
+  MXNDArrayFree(wr);
+  MXNDArrayFree(ws);
+  MXNDArrayFree(b);
+  MXSymbolFree(out);
+  MXSymbolFree(l2);
+  MXSymbolFree(a1);
+  MXSymbolFree(l1);
+  MXSymbolFree(data);
+  printf("c_api OK ops=%u\n", ncreators);
+  return 0;
+}

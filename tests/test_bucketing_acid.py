@@ -14,12 +14,43 @@ that must hold are:
   on a corpus with learnable structure;
 * ``switch_bucket`` works mid-training.
 """
+import contextlib
+
 import numpy as np
 import pytest
 
 import mxnet_tpu as mx
 
-import jax._src.test_util as jtu
+
+def _count_lowerings():
+    """Context manager yielding a callable that returns the number of
+    jit lowerings so far.  Prefers jax's test utility (name has changed
+    across releases); falls back to the public jax.monitoring events so
+    a JAX upgrade degrades gracefully instead of breaking the suite."""
+    import jax._src.test_util as jtu
+    for name in ("count_jit_and_pmap_lowerings",
+                 "count_jit_and_pmap_compiles"):
+        fn = getattr(jtu, name, None)
+        if fn is not None:
+            return fn()
+
+    @contextlib.contextmanager
+    def _monitoring_counter():
+        import jax.monitoring
+        events = []
+
+        def _listener(event, **kw):
+            # lowering events only: counting compile+lower per jit
+            # would double-count and break the absolute bound asserts
+            if "lower" in event:
+                events.append(event)
+        jax.monitoring.register_event_listener(_listener)
+        try:
+            yield lambda: len(events)
+        finally:
+            jax.monitoring.unregister_event_listener(_listener)
+    return _monitoring_counter()
+
 
 BUCKETS = [4, 8, 12, 16]
 VOCAB = 24
@@ -70,7 +101,7 @@ def test_bucketing_acid():
                        optimizer_params={"learning_rate": 0.02})
     metric = mx.metric.Perplexity(ignore_label=0)
 
-    with jtu.count_jit_and_pmap_lowerings() as lowerings:  # yields a callable
+    with _count_lowerings() as lowerings:  # yields a callable
         ppls = []
         for epoch in range(6):
             it.reset()
@@ -110,7 +141,7 @@ def test_bucketing_acid():
 
     # --- switch_bucket mid-training: move to a specific bucket, train
     # a step there, and confirm no new compilation happened
-    with jtu.count_jit_and_pmap_lowerings() as lowerings2:
+    with _count_lowerings() as lowerings2:
         for want in (4, 16, 8):
             mod.switch_bucket(want, None, None)
             assert mod._curr_bucket_key == want

@@ -48,10 +48,10 @@ def _digits(batch=40, dim=16, nclass=5):
     return x, y.astype("f")
 
 
-def _build(name, src_c, lib):
+def _build(name, src_c, lib, outdir):
     subprocess.run(["make", lib + ".so"], cwd=SRC, check=True,
                    capture_output=True)
-    exe = os.path.join(SRC, name)
+    exe = os.path.join(str(outdir), name)
     cc = ["gcc", "-O1", src_c, "-o", exe, "-L" + SRC,
           "-l" + lib.replace("lib", "", 1), "-Wl,-rpath," + SRC, "-lm"]
     subprocess.run(cc, check=True, capture_output=True)
@@ -61,7 +61,7 @@ def _build(name, src_c, lib):
 def test_c_train_loop_learns(tmp_path):
     exe = _build("c_train_test",
                  os.path.join(ROOT, "tests", "c_train_test.c"),
-                 "libmxtpu_train")
+                 "libmxtpu_train", tmp_path)
     x, y = _digits()
     net = _mlp()
     sym_path = tmp_path / "net-symbol.json"
@@ -87,7 +87,7 @@ def test_cpp_trainer_wrapper_learns(tmp_path):
     same ABI — the reference cpp-package's training role."""
     subprocess.run(["make", "libmxtpu_train.so"], cwd=SRC, check=True,
                    capture_output=True)
-    exe = os.path.join(SRC, "train_cpp_test")
+    exe = os.path.join(str(tmp_path), "train_cpp_test")
     subprocess.run(
         ["g++", "-O1", "-std=c++17",
          os.path.join(ROOT, "cpp-package", "example", "train_cpp.cc"),

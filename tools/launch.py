@@ -99,10 +99,18 @@ def launch_ssh(opts, command):
                 hosts.append(h)
     assert len(hosts) >= opts.num_workers
     procs = []
+    # dist_async multi-server: servers run inside ranks 0..N-1, so
+    # their reachable hosts are the first N hostfile entries (workers
+    # default all servers to the coordinator host otherwise, which is
+    # wrong the moment rank 1 lives on another machine)
+    nserv = int(os.environ.get("MXNET_TPU_NUM_SERVERS", "1"))
+    server_hosts = ",".join(hosts[:nserv])
     for rank in range(opts.num_workers):
         env_prefix = ("MXNET_TPU_NUM_PROCESSES=%d MXNET_TPU_PROCESS_ID=%d "
-                      "MXNET_TPU_COORDINATOR=%s"
-                      % (opts.num_workers, rank, opts.coordinator))
+                      "MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_SERVERS=%d "
+                      "MXNET_TPU_SERVER_HOSTS=%s"
+                      % (opts.num_workers, rank, opts.coordinator,
+                         nserv, server_hosts))
         cmd = "ssh -o StrictHostKeyChecking=no %s 'cd %s; %s %s'" % (
             hosts[rank], os.getcwd(), env_prefix, command)
         procs.append(subprocess.Popen(cmd, shell=True))

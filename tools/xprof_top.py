@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--vocab", type=int, default=16384)
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--scan", type=int, default=0,
+                    help="profile run_steps(scan) chains instead of "
+                         "single steps (the bench path)")
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--layout", default="NHWC")
     ap.add_argument("--dtype", default="bfloat16")
@@ -68,23 +71,48 @@ def main():
             "softmax_label": rng.randint(0, 1000, batch).astype(np.float32)})
     float(trainer.step(staged))  # compile
     float(trainer.step(staged))
+    if args.scan:
+        # profile the run_steps lax.scan path (what bench.py times):
+        # scan carries canonicalize layouts, so its op mix can differ
+        # from the single-step program
+        float(trainer.run_steps(staged, args.scan)[-1])  # compile
 
     os.makedirs(args.outdir, exist_ok=True)
     jax.profiler.start_trace(args.outdir)
-    for _ in range(args.steps):
-        loss = trainer.step(staged)
-    float(loss)
+    if args.scan:
+        nchain = max(1, args.steps)
+        for _ in range(nchain):
+            losses = trainer.run_steps(staged, args.scan)
+        float(losses[-1])
+        total_steps = nchain * args.scan
+    else:
+        for _ in range(args.steps):
+            loss = trainer.step(staged)
+        float(loss)
+        total_steps = args.steps
     jax.profiler.stop_trace()
 
     import re
     import jax.numpy as jnp
 
-    # categorize fusions by what their fused computation contains
+    # categorize fusions by what their fused computation contains; in
+    # --scan mode the executed program is the run_steps scan, whose
+    # fusion names differ from the single-step program
     kk = jax.random.PRNGKey(0)
-    lowered = trainer._step_fn.lower(
-        trainer.params, trainer.opt_state, trainer.aux, staged, kk,
-        jnp.float32(0.1), jnp.float32(1.0))
-    hlo = lowered.compile().as_text()
+    if args.scan:
+        fnj = trainer._scan_fns[args.scan]
+        if hasattr(fnj, "as_text"):   # AOT-compiled (auto_layouts)
+            hlo = fnj.as_text()
+        else:
+            hlo = fnj.lower(
+                trainer.params, trainer.opt_state, trainer.aux, staged,
+                kk, jnp.zeros(args.scan, jnp.float32),
+                jnp.zeros(args.scan, jnp.float32)).compile().as_text()
+    else:
+        lowered = trainer._step_fn.lower(
+            trainer.params, trainer.opt_state, trainer.aux, staged, kk,
+            jnp.float32(0.1), jnp.float32(1.0))
+        hlo = lowered.compile().as_text()
     comp_kind, cur = {}, None
     for ln in hlo.splitlines():
         if ln.startswith("%fused_computation") or \
@@ -145,15 +173,15 @@ def main():
               [p.name for p in data.planes])
         return
     print("device time: %.2f ms/step over %d steps"
-          % (total / 1e6 / args.steps, args.steps))
+          % (total / 1e6 / total_steps, total_steps))
     print("--- by category")
     for k, v in cat.most_common(12):
         print("%-34s %8.3f ms/step %5.1f%%"
-              % (k, v / 1e6 / args.steps, 100.0 * v / total))
+              % (k, v / 1e6 / total_steps, 100.0 * v / total))
     print("--- top ops")
     for name, ns in per_op.most_common(args.top):
         print("%7.3f ms %4.1f%%  %s"
-              % (ns / 1e6 / args.steps, 100.0 * ns / total, name[:120]))
+              % (ns / 1e6 / total_steps, 100.0 * ns / total, name[:120]))
 
 
 if __name__ == "__main__":
