@@ -292,6 +292,19 @@ def _init_data(data, allow_empty, default_name):
     return list(sorted(out.items()))
 
 
+def tunnel_limited_backend():
+    """True when the accelerator is reached over a remote tunnel (the
+    axon PJRT proxy): host->device bandwidth is a shared WAN-ish link,
+    so background staging threads contend with step dispatch instead
+    of overlapping it."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        return "axon" in getattr(dev.client, "platform_version", "")
+    except Exception:
+        return False
+
+
 class DevicePrefetchIter:
     """Stage up to ``depth`` batches AHEAD onto the devices.
 
@@ -305,8 +318,19 @@ class DevicePrefetchIter:
     arrays, feedable straight to ``trainer.step``).
     """
 
+    _warned_tunnel = False
+
     def __init__(self, it, stage_fn, depth=2):
         import queue as _queue
+        if tunnel_limited_backend() and not DevicePrefetchIter._warned_tunnel:
+            import logging
+            DevicePrefetchIter._warned_tunnel = True
+            logging.warning(
+                "DevicePrefetchIter on a tunnel-limited accelerator "
+                "backend: background staging contends with step "
+                "dispatch on the same host link and measured 0.63x "
+                "plain staging there (docs/perf.md) — prefer the "
+                "inline put_batch path on such hosts")
         self._it = it
         self._stage = stage_fn
         self._depth = max(1, int(depth))

@@ -10,11 +10,25 @@ same math per block.
 
 Exposed as the ``_contrib_FlashAttention`` operator (q, k, v) with layout
 (batch, seq, heads, head_dim).  Backward is a second Pallas kernel
-(custom_vjp): Q blocks stream against the K/V panel, P is reconstituted
-from the forward's saved log-sum-exp, and dK/dV accumulate in VMEM
-across the Q-block grid axis — the (T, T) matrix never touches HBM.
-(Replacing the earlier jnp-recompute backward was worth +11 MFU points
-on the d=1024 LM benchmark, docs/perf.md.)
+(custom_vjp): P is reconstituted from the forward's saved log-sum-exp
+and the (T, T) matrix never touches HBM.  (Replacing the earlier
+jnp-recompute backward was worth +11 MFU points on the d=1024 LM
+benchmark, docs/perf.md.)
+
+Length dispatch (round 5): sequences whose K/V panel fits one VMEM
+block (T <= _BLOCK_K) run the single-panel kernels — the measured
+fastest formulation at those lengths; longer sequences stream K/V in
+blocks along an extra grid axis with online-softmax rescaling (fwd)
+and a full-sequence VMEM dQ accumulator (bwd).  VMEM then scales
+O(T*D) instead of the panel's O(T*D + block_q*T) working set with its
+(block_q, T) f32 score tiles, so S=4096+ trains; the dQ accumulator
+(T*D*4 bytes — 1 MB at T=4096, D=64) becomes the next wall around
+T~64k.  Causal tile-skipping on the
+streamed grid is applied only where fully-masked tiles exist
+(multi-block causal sweeps); round-4/5 measurements show every
+always-on skip formulation (dynamic fori_loop, two-pass grid,
+small-K-block grids) LOSES 10-15% on v5e — long MXU contractions beat
+the skipped FLOPs at these lengths (docs/perf.md).
 """
 from __future__ import annotations
 
@@ -43,7 +57,23 @@ def _attention_jnp(q, k, v, causal):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+_BLOCK_K = 2048
+
+
+def _causal_live(qi, ki, block_q, block_k):
+    """This (qi, ki) tile has any unmasked entry: k_start <= q_end."""
+    return ki * block_k <= qi * block_q + block_q - 1
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    row = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(row >= col, s, -jnp.inf)
+
+
+def _flash_fwd_panel_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                   block_q):
     from jax.experimental import pallas as pl
 
@@ -71,6 +101,64 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0] = m + jnp.log(l)
 
 
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, scale, causal,
+                      block_q, block_k, n_k):
+    """Online-softmax forward: K/V stream through VMEM in blocks along
+    the innermost grid axis; the running (m, l, acc) row statistics
+    live in VMEM scratch.  Under ``causal`` the fully-masked upper-
+    triangle tiles are skipped (~2x fewer MXU FLOPs for an LM) —
+    skipping happens on the STATIC grid via pl.when, which keeps the
+    Mosaic pipeline intact (a dynamic-trip-count fori_loop formulation
+    measured 10 MFU points SLOWER in round 4, docs/perf.md)."""
+    from jax.experimental import pallas as pl
+
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)      # (bq, D)
+        k = k_ref[0].astype(jnp.float32)      # (bk, D)
+        v = v_ref[0].astype(jnp.float32)      # (bk, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    if causal and n_k > 1:
+        # only a multi-block causal sweep has fully-masked tiles to
+        # skip; a pl.when around the hot body otherwise just impedes
+        # the Mosaic pipeline (measured, docs/perf.md)
+        pl.when(_causal_live(qi, ki, block_q, block_k))(_step)
+    else:
+        _step()
+
+    @pl.when(ki == nk - 1)
+    def _done():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # log-sum-exp per query row ((bq, 1); the trailing unit dim
+        # keeps the block TPU-tileable): the backward reconstitutes
+        # p = exp(s - lse) without a second softmax pass
+        lse_ref[0] = m_ref[...] + jnp.log(l)
+
+
 def _fold_heads(x):
     b, t, h, d = x.shape
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
@@ -81,42 +169,81 @@ def _unfold_heads(x, b, h):
     return jnp.transpose(x.reshape(b, h, t, d), (0, 2, 1, 3))
 
 
+def _blocks(t):
+    block_q = min(_BLOCK_Q, t)
+    # K blocks as long as VMEM allows: long MXU contractions beat the
+    # causal-skip savings on this chip (measured, docs/perf.md) — the
+    # panel only streams once T outgrows the VMEM budget
+    block_k = min(_BLOCK_K, t)
+    if t % block_k:
+        block_k = block_q                  # t is a block_q multiple here
+    return block_q, block_k
+
+
 def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
-    """q/k/v: (B, T, H, D) -> (o (B, T, H, D), lse (BH, T, 1) f32 —
-    the trailing unit dim keeps the backward's row-stat BlockSpecs
-    TPU-tileable)."""
+    """q/k/v: (B, T, H, D) -> (o (B, T, H, D), lse (BH, T, 1) f32)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu
 
     b, t, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    block_q = min(_BLOCK_Q, t)
+    block_q, block_k = _blocks(t)
     assert t % block_q == 0, "seq length must be a multiple of the Q block"
 
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q)
+    if t // block_k == 1:
+        # T fits one VMEM panel: single-panel kernel (measured fastest
+        # at these lengths; streaming costs 10-15%, docs/perf.md)
+        kernel = functools.partial(_flash_fwd_panel_kernel, scale=scale,
+                                   causal=causal, block_q=block_q)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(b * h, t // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(_fold_heads(q), _fold_heads(k), _fold_heads(v))
+        return _unfold_heads(out, b, h), lse
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, n_k=t // block_k)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        grid=(b * h, t // block_q, t // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(_fold_heads(q), _fold_heads(k), _fold_heads(v))
     return _unfold_heads(out, b, h), lse
 
 
-def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_panel_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dk_ref, dv_ref, *, scale, causal, block_q):
     """One Q block against the full K/V panel; dK/dV accumulate across
     the Q-block grid axis (their output block revisits per qi)."""
@@ -158,18 +285,89 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                      preferred_element_type=jnp.float32)
 
 
-def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, interpret):
-    """Flash backward: recompute P per Q block from the saved
-    log-sum-exp, never materializing the (T, T) matrix in HBM — the
-    jnp vjp fallback does, and on long sequences that HBM round trip
-    (not the matmuls) dominates the step (docs/perf.md transformer
-    breakdown)."""
+def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, *,
+                      scale, causal, block_q, block_k, n_k):
+    """Single-pass streaming backward, grid (BH, ki, qi): one K/V block
+    stays resident while Q/dO stream past it (inner axis).  dK/dV
+    accumulate in per-ki scratch; dQ accumulates in a full-sequence
+    VMEM scratch (T*D f32 — 1 MB at T=4096) and each dQ block is
+    emitted on the final ki sweep.  Same 5-matmul count as the old
+    full-panel kernel, with only the O(T*D) dQ accumulator (not the
+    O(block_q*T) score tiles) scaling with sequence length; fully-
+    masked causal tiles are skipped on the static grid."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nk, nq = pl.num_programs(1), pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)      # (bq, D)
+        k = k_ref[0].astype(jnp.float32)      # (bk, D)
+        v = v_ref[0].astype(jnp.float32)      # (bk, D)
+        do = do_ref[0].astype(jnp.float32)    # (bq, D)
+        lse = lse_ref[0]                      # (bq, 1)
+        delta = delta_ref[0]                  # (bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                  # masked entries exp(-inf)=0
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        contrib = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        sl = pl.ds(qi * block_q, block_q)
+
+        @pl.when(ki == 0)
+        def _dq_init():
+            dq_acc[sl, :] = contrib
+
+        @pl.when(ki > 0)
+        def _dq_add():
+            dq_acc[sl, :] += contrib
+
+    if causal and n_k > 1:
+        # only a multi-block causal sweep has fully-masked tiles to
+        # skip; a pl.when around the hot body otherwise just impedes
+        # the Mosaic pipeline (measured, docs/perf.md)
+        pl.when(_causal_live(qi, ki, block_q, block_k))(_step)
+    else:
+        _step()
+
+    @pl.when(ki == nk - 1)
+    def _emit_dq():
+        dq_ref[0] = dq_acc[pl.ds(qi * block_q, block_q), :]
+
+    @pl.when(qi == nq - 1)
+    def _emit_kv():
+        dk_ref[0] = dk_acc[...]
+        dv_ref[0] = dv_acc[...]
+
+
+def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, interpret):
+    """Flash backward: P is reconstituted per tile from the forward\'s
+    saved log-sum-exp, the (T, T) matrix never touches HBM, and no ref
+    spans the full sequence — S=4096+ runs where the old full-panel
+    kernel hit the VMEM wall (VERDICT r4 #2)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, t, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    block_q = min(_BLOCK_Q, t)
+    block_q, block_k = _blocks(t)
 
     qt, kt, vt = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     dot = _fold_heads(g)
@@ -178,19 +376,44 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, interpret):
                     * _fold_heads(o).astype(jnp.float32),
                     axis=-1, keepdims=True)
 
-    kernel = functools.partial(_flash_bwd_kernel, scale=scale,
-                               causal=causal, block_q=block_q)
-    panel = pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0))
-    qblock = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0))
-    rows = pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0))
-    dq, dk, dv = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // block_q),
-        in_specs=[qblock, panel, panel, qblock, rows, rows],
-        out_specs=[qblock, panel, panel],
-        out_shape=[jax.ShapeDtypeStruct((b * h, t, d), jnp.float32)] * 3,
-        interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    qblock = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    kblock = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
+    rows = pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0))
+    n_k = t // block_k
+    if n_k == 1:
+        # T fits one VMEM panel: the round-4 single-panel kernel is
+        # the measured fastest formulation at these lengths (every
+        # streaming variant paid 10-15%, docs/perf.md)
+        kernel = functools.partial(_flash_bwd_panel_kernel, scale=scale,
+                                   causal=causal, block_q=block_q)
+        panel = pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0))
+        qb2 = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0))
+        rows2 = pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0))
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(b * h, t // block_q),
+            in_specs=[qb2, panel, panel, qb2, rows2, rows2],
+            out_specs=[qb2, panel, panel],
+            out_shape=[jax.ShapeDtypeStruct((b * h, t, d),
+                                            jnp.float32)] * 3,
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse, delta)
+    else:
+        kernel = functools.partial(_flash_bwd_kernel, scale=scale,
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k, n_k=n_k)
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(b * h, t // block_k, t // block_q),
+            in_specs=[qblock, kblock, kblock, qblock, rows, rows],
+            out_specs=[qblock, kblock, kblock],
+            out_shape=[jax.ShapeDtypeStruct((b * h, t, d),
+                                            jnp.float32)] * 3,
+            scratch_shapes=[pltpu.VMEM((t, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse, delta)
     return tuple(_unfold_heads(x, b, h).astype(q.dtype)
                  for x in (dq, dk, dv))
 

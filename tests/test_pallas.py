@@ -52,3 +52,34 @@ def test_flash_attention_op_fallback():
     ref = _attention_jnp(q, k, v, False)
     np.testing.assert_allclose(out.asnumpy(), np.asarray(ref), rtol=2e-4,
                                atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_streaming_path(causal):
+    """T > _BLOCK_K takes the K/V-streaming kernels (online-softmax
+    forward scratch, full-sequence dQ accumulator backward, causal
+    tile skip) — the path that lifts the old panel kernels' VMEM wall
+    at S>=4096 (VERDICT r4 #2).  Exercised here at a shrunk _BLOCK_K
+    so interpret mode stays fast while covering the real code path."""
+    import jax
+    from mxnet_tpu.ops import pallas_kernels as pk
+    old_bk = pk._BLOCK_K
+    pk._BLOCK_K = 256          # T=512 -> 2 K blocks: streaming engaged
+    try:
+        q, k, v = _qkv(B=1, T=512, H=2, D=32)
+        rng = np.random.RandomState(7)
+        g = rng.normal(0, 1, q.shape).astype(np.float32)
+        out, vjp = jax.vjp(lambda q, k, v:
+                           pk.flash_attention(q, k, v, causal, True),
+                           q, k, v)
+        ref, ref_vjp = jax.vjp(lambda q, k, v:
+                               pk._attention_jnp(q, k, v, causal),
+                               q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        for got, want in zip(vjp(g), ref_vjp(g)):
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(want),
+                                       rtol=2e-4, atol=3e-5)
+    finally:
+        pk._BLOCK_K = old_bk
