@@ -1,0 +1,61 @@
+"""Create a record-file data iterator with augmentation and threaded IO.
+
+Reference: example/python-howto/data_iter.py — ImageRecordIter over a
+.rec file with augmentation parameters and a backend thread hiding IO.
+This version packs a tiny synthetic .rec in-place first (the reference
+assumes a pre-downloaded cifar rec), so the walkthrough runs anywhere.
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_toy_rec(path, n=24, edge=32):
+    """Pack n random JPEGs into path (tools/im2rec role, in-process)."""
+    from PIL import Image
+    import io as _io
+
+    rng = np.random.RandomState(0)
+    rec = mx.recordio.MXIndexedRecordIO(path[:-4] + ".idx", path, "w")
+    for i in range(n):
+        img = Image.fromarray(
+            rng.randint(0, 255, (edge, edge, 3), dtype=np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG")
+        header = mx.recordio.IRHeader(0, float(i % 10), i, 0)
+        rec.write_idx(i, mx.recordio.pack(header, buf.getvalue()))
+    rec.close()
+
+
+def main():
+    tmpdir = tempfile.TemporaryDirectory()
+    tmp = tmpdir.name
+    rec_path = os.path.join(tmp, "toy.rec")
+    make_toy_rec(rec_path)
+
+    dataiter = mx.image.ImageIter(
+        # Dataset parameters: the record file and decoded shape
+        path_imgrec=rec_path,
+        path_imgidx=rec_path[:-4] + ".idx",
+        data_shape=(3, 28, 28),
+        # Batch parameter
+        batch_size=8,
+        # Augmentation parameters
+        rand_crop=True,
+        rand_mirror=True,
+        shuffle=True,
+    )
+    batches = 0
+    for batch in dataiter:
+        assert batch.data[0].shape == (8, 3, 28, 28)
+        batches += 1
+    print("read %d augmented batches" % batches)
+    tmpdir.cleanup()
+    return batches
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Debug a single Convolution through Module with an install_monitor.
+
+Reference: example/python-howto/debug_conv.py — a one-op module, a
+Monitor installed on the executor group, one forward on ones.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class SimpleData(object):
+    def __init__(self, data):
+        self.data = data
+
+
+def main():
+    data_shape = (1, 3, 5, 5)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), pad=(1, 1),
+                              stride=(1, 1), num_filter=1)
+    mon = mx.mon.Monitor(1)
+
+    mod = mx.mod.Module(conv, label_names=[])
+    mod.bind(data_shapes=[("data", data_shape)])
+    mod.install_monitor(mon)   # (the reference reaches into _exec_group)
+    mod.init_params()
+
+    input_data = mx.nd.ones(data_shape)
+    mon.tic()
+    mod.forward(data_batch=SimpleData([input_data]))
+    res = mod.get_outputs()[0].asnumpy()
+    mon.toc_print()
+    print(res)
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Monitor weights/gradients/outputs during FeedForward training.
+
+Reference: example/python-howto/monitor_weights.py — install a Monitor
+with a custom statistic and watch per-array norms stream past during
+``model.fit``.  Runs on synthetic digits so it needs no download.
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def mlp():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=128)
+    act1 = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act1, name="fc2", num_hidden=64)
+    act2 = mx.symbol.Activation(data=fc2, name="relu2", act_type="relu")
+    fc3 = mx.symbol.FullyConnected(data=act2, name="fc3", num_hidden=10)
+    return mx.symbol.SoftmaxOutput(data=fc3, name="softmax")
+
+
+def synthetic_digits(n, seed=0):
+    protos = np.random.RandomState(42).rand(10, 784).astype("f")
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = (protos[y] + rng.randn(n, 784).astype("f") * 0.3).astype("f")
+    return x, y.astype("f")
+
+
+def norm_stat(d):
+    return mx.nd.norm(d) / np.sqrt(d.size)
+
+
+def main(num_epoch=2, batch_size=100):
+    logging.basicConfig(level=logging.INFO)
+    xt, yt = synthetic_digits(1000, seed=0)
+    xv, yv = synthetic_digits(300, seed=1)
+    train = mx.io.NDArrayIter(xt, yt, batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(xv, yv, batch_size,
+                            label_name="softmax_label")
+
+    model = mx.model.FeedForward(
+        ctx=mx.cpu(), symbol=mlp(), num_epoch=num_epoch,
+        learning_rate=0.1, momentum=0.9, wd=0.00001)
+    mon = mx.mon.Monitor(5, norm_stat)
+    model.fit(X=train, eval_data=val, monitor=mon,
+              batch_end_callback=mx.callback.Speedometer(batch_size, 5))
+    return model
+
+
+if __name__ == "__main__":
+    main()

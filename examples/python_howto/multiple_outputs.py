@@ -1,0 +1,36 @@
+"""Multiple-output symbols with Group.
+
+Reference: example/python-howto/multiple_outputs.py — group an internal
+layer with the loss head so one executor returns both.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    net = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=net, name="fc1", num_hidden=128)
+    net = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    net = mx.symbol.FullyConnected(data=net, name="fc2", num_hidden=64)
+    out = mx.symbol.SoftmaxOutput(data=net, name="softmax")
+    # group fc1 and out together
+    group = mx.symbol.Group([fc1, out])
+    print(group.list_outputs())
+
+    # bind on the group: outputs[0] is fc1's value, outputs[1] softmax's
+    executor = group.simple_bind(ctx=mx.cpu(), data=(4, 32),
+                                 softmax_label=(4,))
+    for name, arr in executor.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.RandomState(0).uniform(
+                -0.1, 0.1, arr.shape).astype("f")
+    executor.arg_dict["data"][:] = np.random.RandomState(1).rand(4, 32)
+    executor.forward(is_train=False)
+    fc1_val, softmax_val = executor.outputs
+    print("fc1:", fc1_val.shape, "softmax:", softmax_val.shape)
+    return group, executor
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,7 @@ from .module import Module
 from . import rnn
 from . import profiler
 from . import monitor
+from . import monitor as mon  # reference alias (python/mxnet/__init__.py)
 from .monitor import Monitor
 from . import recordio
 from . import visualization

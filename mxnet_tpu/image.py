@@ -333,7 +333,13 @@ class ImageIter(io_mod.DataIter):
         if self.imgrec is None:
             self.seq = imgkeys
         elif shuffle or num_parts > 1:
-            assert self.imgidx is not None
+            if not self.imgidx:
+                # an absent/empty .idx silently yields 0-batch epochs;
+                # shuffle and sharding need random access, so fail loud
+                raise MXNetError(
+                    "ImageIter(shuffle/num_parts) needs a non-empty "
+                    "index: pass path_imgidx to a .idx built alongside "
+                    "the .rec (tools/im2rec)")
             self.seq = self.imgidx
         else:
             self.seq = None
