@@ -85,6 +85,13 @@ int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
                         const char ***out_str_array);
 int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
                                 const char ***out_str_array);
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
+                    const char **out, int *success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                    const char *value);
+/* flat [k0, v0, k1, v1, ...] pairs (reference ListAttrShallow) */
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out_str_array);
 int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
 int MXSymbolFree(SymbolHandle symbol);
 

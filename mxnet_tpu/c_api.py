@@ -176,3 +176,38 @@ def sym_list_outputs(sym):
 
 def sym_list_aux(sym):
     return list(sym.list_auxiliary_states())
+
+
+def sym_get_attr(sym, key):
+    """-> (found, value): absent and empty-string attrs are distinct
+    (the reference returns success=1 with an empty value)."""
+    v = sym.attr(key)
+    return (False, "") if v is None else (True, str(v))
+
+
+def sym_set_attr(sym, key, value):
+    if key == "name":
+        # attr("name") resolves to the node's name, so a raw_attr
+        # write would be unobservable through the paired Get — refuse
+        # rather than silently no-op (names are fixed at compose time)
+        raise MXNetError("cannot set the reserved attr 'name'; node "
+                         "names are fixed when the symbol is composed")
+    sym._set_attr(**{key: value})
+
+
+def sym_list_attr(sym):
+    """Flat [k0, v0, k1, v1, ...]: operator params AND user raw attrs
+    of the head node (the reference's ListAttrShallow covers both, and
+    GetAttr's param fallback must agree with the listing)."""
+    node = sym._entries[0][0]
+    merged = {}
+    if node.op is not None:
+        for k, v in (node.attrs or {}).items():
+            merged[str(k)] = str(v)
+    for k, v in sym.list_attr().items():
+        merged[str(k)] = str(v)
+    out = []
+    for k in sorted(merged):
+        out.append(k)
+        out.append(merged[k])
+    return out

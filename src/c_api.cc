@@ -551,6 +551,40 @@ int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
   return ListStrings(symbol, "sym_list_aux", out_size, out_str_array);
 }
 
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
+                    const char **out, int *success) {
+  API_GUARD();
+  CHECK_HANDLE(symbol);
+  Gil gil;
+  auto h = static_cast<Handle *>(symbol);
+  Ref args(Py_BuildValue("(Os)", h->obj, key));
+  Ref v(CallDriver("sym_get_attr", args.p));
+  if (!v) { SetPyError(); return -1; }
+  // (found, value): empty-but-present attrs stay success=1
+  *success = PyObject_IsTrue(PyTuple_GET_ITEM(v.p, 0)) ? 1 : 0;
+  h->text = PyUnicode_AsUTF8(PyTuple_GET_ITEM(v.p, 1));
+  *out = h->text.c_str();
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                    const char *value) {
+  API_GUARD();
+  CHECK_HANDLE(symbol);
+  Gil gil;
+  auto h = static_cast<Handle *>(symbol);
+  Ref args(Py_BuildValue("(Oss)", h->obj, key, value));
+  Ref r(CallDriver("sym_set_attr", args.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out_str_array) {
+  // flat [k0, v0, k1, v1, ...] like the reference
+  return ListStrings(symbol, "sym_list_attr", out_size, out_str_array);
+}
+
 int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
   API_GUARD();
   CHECK_HANDLE(symbol);

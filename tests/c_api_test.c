@@ -103,6 +103,26 @@ int main(int argc, char **argv) {
   CHECK(MXSymbolGetName(out, &name, &success) == 0);
   CHECK(success == 1 && strcmp(name, "softmax") == 0);
 
+  /* ---- attrs: set/get/list (ctx_group-style metadata ride-along) */
+  CHECK(MXSymbolSetAttr(out, "ctx_group", "stage1") == 0);
+  const char *aval = NULL;
+  CHECK(MXSymbolGetAttr(out, "ctx_group", &aval, &success) == 0);
+  CHECK(success == 1 && strcmp(aval, "stage1") == 0);
+  mx_uint nattr = 0;
+  const char **attrs_flat = NULL;
+  CHECK(MXSymbolListAttrShallow(out, &nattr, &attrs_flat) == 0);
+  CHECK(nattr >= 2 && nattr % 2 == 0);
+  int found_attr = 0;
+  for (mx_uint i = 0; i + 1 < nattr; i += 2) {
+    if (strcmp(attrs_flat[i], "ctx_group") == 0 &&
+        strcmp(attrs_flat[i + 1], "stage1") == 0) {
+      found_attr = 1;
+    }
+  }
+  CHECK(found_attr);
+  CHECK(MXSymbolGetAttr(out, "no_such_attr", &aval, &success) == 0);
+  CHECK(success == 0);
+
   /* ---- JSON round trip + file save (python cross-loads this) */
   const char *json = NULL;
   CHECK(MXSymbolSaveToJSON(out, &json) == 0);

@@ -143,7 +143,8 @@ def test_dist_async_parameter_server_dcasgd():
     update counter proves per-push application (the reference
     kvstore_dist_server.h:200-208 contract) and every worker converges
     despite gradient staleness."""
-    res, out = _launch("dist_async_worker.py", n=3, timeout=560)
+    res, out = _launch("dist_async_worker.py", n=3, timeout=560,
+                       extra_env={"MXNET_TPU_NUM_SERVERS": "2"})
     assert res.returncode == 0, out
     for rank in range(3):
         assert "dist-async worker %d/3 OK" % rank in out, out
