@@ -9,6 +9,24 @@ Import convention mirrors the reference's ``import mxnet as mx``::
 """
 from __future__ import annotations
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # Honor an explicit CPU pin even where a site TPU plugin prepends
+    # itself to jax_platforms regardless of the env var.  This must run
+    # before anything below touches jax: embedded ABI consumers import
+    # this package with no conftest, and a lazily-initialized remote
+    # accelerator client would hang the whole process when its tunnel
+    # is down.
+    import jax as _jax
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception as _e:
+        import logging as _logging
+        _logging.getLogger(__name__).warning(
+            "JAX_PLATFORMS=cpu requested but the pin failed (%s); a "
+            "site accelerator plugin may still be selected", _e)
+
 __version__ = "0.1.0"
 
 from .base import MXNetError

@@ -14,18 +14,6 @@ key/value string lists, opaque creator indices) to them.
 """
 from __future__ import annotations
 
-import os as _os
-
-if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-    # honor an explicit CPU pin even where a site TPU plugin prepends
-    # itself to jax_platforms regardless of the env var (the embedded
-    # interpreter has no conftest to do this)
-    import jax as _jax
-    try:
-        _jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
-
 import numpy as np
 
 from . import ndarray as nd
