@@ -29,6 +29,8 @@ typedef float mx_float;
 typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
 typedef void *AtomicSymbolCreator;
+typedef void *KVStoreHandle;
+typedef void *RecordIOHandle;
 
 const char *MXGetLastError();
 
@@ -94,6 +96,31 @@ int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
                             const char ***out_str_array);
 int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
 int MXSymbolFree(SymbolHandle symbol);
+
+/* ---------------------------------------------------------- kvstore */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStoreFree(KVStoreHandle handle);
+
+/* --------------------------------------------------------- recordio */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+/* *out_buf=NULL and *size=0 at end of file; the buffer stays valid
+ * until the next read on the same handle */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                               char const **out_buf, size_t *size);
+int MXRecordIOReaderFree(RecordIOHandle handle);
 
 #ifdef __cplusplus
 }  /* extern "C" */

@@ -199,3 +199,59 @@ def sym_list_attr(sym):
         out.append(k)
         out.append(merged[k])
     return out
+
+
+# ---------------------------------------------------------------- kvstore
+
+def kv_create(kv_type):
+    from . import kvstore as kv_mod
+    return kv_mod.create(kv_type)
+
+
+def kv_type(kv):
+    return str(kv.type)
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_num_workers(kv):
+    return int(kv.num_workers)
+
+
+def kv_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kv_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+
+
+# ---------------------------------------------------------------- recordio
+
+def recordio_writer(uri):
+    from . import recordio
+    return recordio.MXRecordIO(uri, "w")
+
+
+def recordio_reader(uri):
+    from . import recordio
+    return recordio.MXRecordIO(uri, "r")
+
+
+def recordio_write(rec, buf):
+    rec.write(buf)
+
+
+def recordio_read(rec):
+    """-> bytes or None at end of file."""
+    return rec.read()
+
+
+def recordio_close(rec):
+    rec.close()

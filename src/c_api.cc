@@ -607,4 +607,196 @@ int MXSymbolFree(SymbolHandle symbol) {
   return 0;
 }
 
+// ------------------------------------------------------------ kvstore
+
+static PyObject *KvPairs(mx_uint num, const int *keys,
+                         NDArrayHandle *vals, PyObject **out_keys) {
+  // -> new refs: (key list, value list) or nullptr
+  PyObject *ks = PyList_New(num);
+  PyObject *vs = PyList_New(num);
+  if (!ks || !vs) {
+    Py_XDECREF(ks);
+    Py_XDECREF(vs);
+    return nullptr;
+  }
+  for (mx_uint i = 0; i < num; ++i) {
+    if (vals[i] == nullptr) {
+      g_last_error = "null NDArrayHandle in kvstore list";
+      Py_DECREF(ks);
+      Py_DECREF(vs);
+      return nullptr;
+    }
+    PyList_SET_ITEM(ks, i, PyLong_FromLong(keys[i]));
+    PyObject *o = static_cast<Handle *>(vals[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(vs, i, o);
+  }
+  *out_keys = ks;
+  return vs;
+}
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  API_GUARD();
+  Gil gil;
+  Ref args(Py_BuildValue("(s)", type));
+  PyObject *kv = CallDriver("kv_create", args.p);
+  if (kv == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(kv);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref t(CallDriver("kv_type", args.p));
+  if (!t) { SetPyError(); return -1; }
+  h->text = PyUnicode_AsUTF8(t.p);
+  *type = h->text.c_str();
+  return 0;
+}
+
+static int KvInt(KVStoreHandle handle, const char *fn, int *out) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref v(CallDriver(fn, args.p));
+  if (!v) { SetPyError(); return -1; }
+  *out = static_cast<int>(PyLong_AsLong(v.p));
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  return KvInt(handle, "kv_rank", rank);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  return KvInt(handle, "kv_num_workers", size);
+}
+
+static int KvOp(KVStoreHandle handle, const char *fn, mx_uint num,
+                const int *keys, NDArrayHandle *vals, int priority,
+                bool with_priority) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  PyObject *ks = nullptr;
+  Ref vs(KvPairs(num, keys, vals, &ks));
+  if (!vs) { if (PyErr_Occurred()) SetPyError(); return -1; }
+  Ref ksr(ks);
+  Ref args(with_priority
+               ? Py_BuildValue("(OOOi)", h->obj, ksr.p, vs.p, priority)
+               : Py_BuildValue("(OOO)", h->obj, ksr.p, vs.p));
+  Ref r(CallDriver(fn, args.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  return KvOp(handle, "kv_init", num, keys, vals, 0, false);
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return KvOp(handle, "kv_push", num, keys, vals, priority, true);
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  return KvOp(handle, "kv_pull", num, keys, vals, priority, true);
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  if (handle == nullptr) return 0;
+  EnsurePython();
+  Gil gil;
+  delete static_cast<Handle *>(handle);
+  return 0;
+}
+
+// ----------------------------------------------------------- recordio
+
+static int RecCreate(const char *uri, const char *fn,
+                     RecordIOHandle *out) {
+  API_GUARD();
+  Gil gil;
+  Ref args(Py_BuildValue("(s)", uri));
+  PyObject *rec = CallDriver(fn, args.p);
+  if (rec == nullptr) { SetPyError(); return -1; }
+  *out = new Handle(rec);
+  return 0;
+}
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  return RecCreate(uri, "recordio_writer", out);
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  return RecCreate(uri, "recordio_reader", out);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref bytes(PyBytes_FromStringAndSize(buf,
+                                      static_cast<Py_ssize_t>(size)));
+  if (!bytes) { SetPyError(); return -1; }
+  Ref args(Py_BuildValue("(OO)", h->obj, bytes.p));
+  Ref r(CallDriver("recordio_write", args.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                               char const **out_buf, size_t *size) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref rec(CallDriver("recordio_read", args.p));
+  if (!rec) { SetPyError(); return -1; }
+  if (rec.p == Py_None) {
+    *out_buf = nullptr;
+    *size = 0;
+    return 0;
+  }
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(rec.p, &buf, &n) != 0) {
+    SetPyError();
+    return -1;
+  }
+  h->text.assign(buf, static_cast<size_t>(n));
+  *out_buf = h->text.data();
+  *size = static_cast<size_t>(n);
+  return 0;
+}
+
+static int RecFree(RecordIOHandle handle) {
+  if (handle == nullptr) return 0;
+  EnsurePython();
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue("(O)", h->obj));
+  Ref r(CallDriver("recordio_close", args.p));
+  // close errors are surfaced, but the handle is freed either way
+  int rc = r ? 0 : (SetPyError(), -1);
+  delete h;
+  return rc;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) { return RecFree(handle); }
+
+int MXRecordIOReaderFree(RecordIOHandle handle) { return RecFree(handle); }
+
 }  // extern "C"

@@ -221,6 +221,59 @@ int main(int argc, char **argv) {
   for (int i = 0; i < 6; ++i) CHECK(ramp[i] == (float)i * 2.0f);
   MXNDArrayFree(pyarrs[0]);
 
+  /* ---- kvstore: create/init/push/pull through the local store */
+  KVStoreHandle kv = NULL;
+  CHECK(MXKVStoreCreate("local", &kv) == 0);
+  const char *kvtype = NULL;
+  CHECK(MXKVStoreGetType(kv, &kvtype) == 0);
+  CHECK(strcmp(kvtype, "local") == 0);
+  int rank = -1, gsize = 0;
+  CHECK(MXKVStoreGetRank(kv, &rank) == 0 && rank == 0);
+  CHECK(MXKVStoreGetGroupSize(kv, &gsize) == 0 && gsize == 1);
+
+  mx_uint kshape[1] = {4};
+  NDArrayHandle kinit = NULL, kgrad = NULL, kout = NULL;
+  CHECK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &kinit) == 0);
+  CHECK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &kgrad) == 0);
+  CHECK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &kout) == 0);
+  float kv0[4] = {0, 0, 0, 0}, kv1[4] = {2, 4, 6, 8};
+  CHECK(MXNDArraySyncCopyFromCPU(kinit, kv0, 4) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(kgrad, kv1, 4) == 0);
+  int kkeys[1] = {3};
+  NDArrayHandle kvals[1] = {kinit};
+  CHECK(MXKVStoreInit(kv, 1, kkeys, kvals) == 0);
+  kvals[0] = kgrad;
+  CHECK(MXKVStorePush(kv, 1, kkeys, kvals, 0) == 0);
+  kvals[0] = kout;
+  CHECK(MXKVStorePull(kv, 1, kkeys, kvals, 0) == 0);
+  float kread[4];
+  CHECK(MXNDArraySyncCopyToCPU(kout, kread, 4) == 0);
+  for (int i = 0; i < 4; ++i) CHECK(kread[i] == kv1[i]);
+  MXNDArrayFree(kinit);
+  MXNDArrayFree(kgrad);
+  MXNDArrayFree(kout);
+  CHECK(MXKVStoreFree(kv) == 0);
+
+  /* ---- recordio: write records from C, read them back (python
+   * cross-reads the same file in the pytest wrapper) */
+  snprintf(path, sizeof(path), "%s/c_written.rec", argv[1]);
+  RecordIOHandle rw = NULL;
+  CHECK(MXRecordIOWriterCreate(path, &rw) == 0);
+  CHECK(MXRecordIOWriterWriteRecord(rw, "hello", 5) == 0);
+  CHECK(MXRecordIOWriterWriteRecord(rw, "tpu-record!", 11) == 0);
+  CHECK(MXRecordIOWriterFree(rw) == 0);
+  RecordIOHandle rr = NULL;
+  CHECK(MXRecordIOReaderCreate(path, &rr) == 0);
+  const char *rbuf = NULL;
+  size_t rsize = 0;
+  CHECK(MXRecordIOReaderReadRecord(rr, &rbuf, &rsize) == 0);
+  CHECK(rsize == 5 && memcmp(rbuf, "hello", 5) == 0);
+  CHECK(MXRecordIOReaderReadRecord(rr, &rbuf, &rsize) == 0);
+  CHECK(rsize == 11 && memcmp(rbuf, "tpu-record!", 11) == 0);
+  CHECK(MXRecordIOReaderReadRecord(rr, &rbuf, &rsize) == 0);
+  CHECK(rbuf == NULL && rsize == 0);   /* end of file */
+  CHECK(MXRecordIOReaderFree(rr) == 0);
+
   /* ---- error contract on null handles */
   CHECK(MXNDArrayGetDType(NULL, &dtype) == -1);
   CHECK(strlen(MXGetLastError()) > 0);

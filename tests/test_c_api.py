@@ -68,3 +68,10 @@ def test_c_api_roundtrip(tmp_path):
     assert loaded["arg:b"].dtype == np.int32
     np.testing.assert_array_equal(loaded["arg:b"].asnumpy(),
                                   np.array([1, 2, 3, 4, 5], np.int32))
+
+    # the recordio file C wrote is the reference container format
+    rec = mx.recordio.MXRecordIO(str(tmp_path / "c_written.rec"), "r")
+    assert rec.read() == b"hello"
+    assert rec.read() == b"tpu-record!"
+    assert rec.read() is None
+    rec.close()
