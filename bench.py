@@ -21,11 +21,38 @@ BASELINE_IMG_S = 45.52  # reference ResNet-50 train, 1x K80, batch 32
 
 
 def main():
+    import threading
+
+    # Init watchdog: a dead accelerator tunnel makes jax.devices() hang
+    # forever, which would leave NO bench artifact at all.  Fail loudly
+    # with an unambiguous error line instead (BENCH_INIT_TIMEOUT secs).
+    init_done = threading.Event()
+    try:
+        init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "900"))
+    except ValueError:
+        init_timeout = 900.0
+    if init_timeout <= 0:
+        init_timeout = 900.0
+    metric_name = "resnet%s_train_images_per_sec_per_chip" % \
+        os.environ.get("BENCH_LAYERS", "50")
+
+    def _watchdog():
+        if not init_done.wait(init_timeout):
+            print(json.dumps({
+                "metric": metric_name,
+                "value": 0, "unit": "img/s/chip", "vs_baseline": 0,
+                "error": "accelerator backend unreachable after %.0fs "
+                         "(tunnel down?)" % init_timeout}), flush=True)
+            os._exit(1)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax
     from mxnet_tpu import models
     from mxnet_tpu.parallel import ShardedTrainer, build_mesh
 
     devices = jax.devices()
+    init_done.set()
     n_dev = len(devices)
     platform = devices[0].platform
 
