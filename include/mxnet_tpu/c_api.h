@@ -108,6 +108,12 @@ int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
                   NDArrayHandle *vals, int priority);
 int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
                   NDArrayHandle *vals, int priority);
+/* per-push update rule, C side in charge (reference contract):
+ * mutate `local` in place via MXNDArraySyncCopyFromCPU */
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void *handle);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
 int MXKVStoreFree(KVStoreHandle handle);
 
 /* --------------------------------------------------------- recordio */

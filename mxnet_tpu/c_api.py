@@ -255,3 +255,42 @@ def recordio_read(rec):
 
 def recordio_close(rec):
     rec.close()
+
+
+def kv_set_updater(kv, fnptr, user_handle):
+    """Install a C callback updater (reference MXKVStoreSetUpdater).
+
+    ``fnptr`` is the address of a ``void (int key, NDArrayHandle recv,
+    NDArrayHandle local, void *user)`` function; each push invokes it
+    with freshly wrapped handles onto the REAL stored arrays, so the
+    callback's in-place writes (SyncCopyFromCPU) update the store —
+    the reference worker-protocol seam, C side in charge of the rule.
+    """
+    import ctypes
+
+    UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p)
+    cb = UPDATER(int(fnptr))
+    # PyDLL: these helpers manipulate Python refcounts, so the GIL must
+    # stay held across the call (the user callback itself goes through
+    # CFUNCTYPE, which releases the GIL; its re-entries into MXNDArray*
+    # entry points re-ensure it)
+    lib = ctypes.PyDLL(None)
+    wrap = lib.MXTPUWrapNDArray
+    wrap.restype = ctypes.c_void_p
+    wrap.argtypes = [ctypes.py_object]
+    free = lib.MXNDArrayFree
+    free.restype = ctypes.c_int
+    free.argtypes = [ctypes.c_void_p]
+    user = ctypes.c_void_p(int(user_handle))
+
+    def _updater(key, recv, local):
+        rh = wrap(recv)
+        lh = wrap(local)
+        try:
+            cb(int(key), rh, lh, user)
+        finally:
+            free(rh)
+            free(lh)
+
+    kv.set_updater(_updater)

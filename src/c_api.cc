@@ -11,6 +11,7 @@
 
 #include <Python.h>
 
+#include <cstdint>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -710,6 +711,36 @@ int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
 int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
                   NDArrayHandle *vals, int priority) {
   return KvOp(handle, "kv_pull", num, keys, vals, priority, true);
+}
+
+// internal trampoline helper for kv_set_updater (mxnet_tpu/c_api.py):
+// wrap a live python NDArray as an owned ABI handle.  The caller MUST
+// hold the GIL (ctypes.PyDLL does).
+NDArrayHandle MXTPUWrapNDArray(PyObject *obj) {
+  Py_INCREF(obj);
+  return new Handle(obj);
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  API_GUARD();
+  CHECK_HANDLE(handle);
+  if (updater == nullptr) {
+    g_last_error = "null updater function";
+    return -1;
+  }
+  Gil gil;
+  auto h = static_cast<Handle *>(handle);
+  Ref args(Py_BuildValue(
+      "(OKK)", h->obj,
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(updater)),
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(updater_handle))));
+  if (!args) { SetPyError(); return -1; }
+  Ref r(CallDriver("kv_set_updater", args.p));
+  if (!r) { SetPyError(); return -1; }
+  return 0;
 }
 
 int MXKVStoreFree(KVStoreHandle handle) {

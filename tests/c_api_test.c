@@ -40,6 +40,19 @@ static int has_arg(const char **args, mx_uint n, const char *want) {
   return 0;
 }
 
+/* SGD-flavored updater for the kvstore callback test: the C side owns
+ * the rule, mutating `local` in place through the ABI */
+static void sgd_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                        void *user) {
+  (void)key;
+  float r[4], l[4];
+  if (MXNDArraySyncCopyToCPU(recv, r, 4) != 0) return;
+  if (MXNDArraySyncCopyToCPU(local, l, 4) != 0) return;
+  for (int i = 0; i < 4; ++i) l[i] += 0.5f * r[i];
+  MXNDArraySyncCopyFromCPU(local, l, 4);
+  (*(int *)user)++;
+}
+
 int main(int argc, char **argv) {
   if (argc < 3) {
     fprintf(stderr, "usage: %s <out_dir> <py.params>\n", argv[0]);
@@ -253,6 +266,36 @@ int main(int argc, char **argv) {
   MXNDArrayFree(kgrad);
   MXNDArrayFree(kout);
   CHECK(MXKVStoreFree(kv) == 0);
+
+  /* ---- kvstore with a C UPDATER: the push applies sgd_updater to the
+   * stored value in place (reference MXKVStoreSetUpdater contract) */
+  KVStoreHandle kvu = NULL;
+  CHECK(MXKVStoreCreate("local", &kvu) == 0);
+  int ucount = 0;
+  CHECK(MXKVStoreSetUpdater(kvu, sgd_updater, &ucount) == 0);
+  NDArrayHandle uinit = NULL, ugrad = NULL, uout = NULL;
+  CHECK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &uinit) == 0);
+  CHECK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &ugrad) == 0);
+  CHECK(MXNDArrayCreate(kshape, 1, 1, 0, 0, &uout) == 0);
+  float ubase[4] = {10, 20, 30, 40}, ug[4] = {2, 2, 2, 2};
+  CHECK(MXNDArraySyncCopyFromCPU(uinit, ubase, 4) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(ugrad, ug, 4) == 0);
+  int ukeys[1] = {7};
+  NDArrayHandle uvals[1] = {uinit};
+  CHECK(MXKVStoreInit(kvu, 1, ukeys, uvals) == 0);
+  uvals[0] = ugrad;
+  CHECK(MXKVStorePush(kvu, 1, ukeys, uvals, 0) == 0);
+  CHECK(MXKVStorePush(kvu, 1, ukeys, uvals, 0) == 0);
+  uvals[0] = uout;
+  CHECK(MXKVStorePull(kvu, 1, ukeys, uvals, 0) == 0);
+  float ures[4];
+  CHECK(MXNDArraySyncCopyToCPU(uout, ures, 4) == 0);
+  for (int i = 0; i < 4; ++i) CHECK(ures[i] == ubase[i] + 2 * 0.5f * 2.0f);
+  CHECK(ucount == 2);
+  MXNDArrayFree(uinit);
+  MXNDArrayFree(ugrad);
+  MXNDArrayFree(uout);
+  CHECK(MXKVStoreFree(kvu) == 0);
 
   /* ---- recordio: write records from C, read them back (python
    * cross-reads the same file in the pytest wrapper) */
