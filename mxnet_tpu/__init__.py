@@ -71,6 +71,7 @@ from . import monitor
 from . import monitor as mon  # reference alias (python/mxnet/__init__.py)
 from .monitor import Monitor
 from . import recordio
+from . import resilience
 from . import visualization
 from . import visualization as viz
 from . import test_utils

@@ -70,6 +70,44 @@ _CATALOG = {
     "MXNET_USE_NATIVE_REC": ("", "honored",
                              "force (1) or disable (0) the native JPEG "
                              "record pipeline in the examples"),
+    # resilience subsystem (docs/api/resilience.md)
+    "MXNET_TPU_FAULTS": ("", "honored",
+                         "fault-injection spec, e.g. "
+                         "'recordio.read:p=0.05,seed=7;checkpoint.save:"
+                         "n=1' (resilience.configure_faults grammar)"),
+    "MXNET_TPU_BAD_RECORD_QUOTA": ("0", "honored",
+                                   "max corrupt/truncated records a "
+                                   "reader skips by magic-resync before "
+                                   "raising (0 = strict)"),
+    "MXNET_TPU_HEARTBEAT_TIMEOUT": ("", "honored",
+                                    "jax.distributed peer-failure "
+                                    "detection window in seconds "
+                                    "(ps-lite heartbeat role)"),
+    "MXNET_TPU_INIT_TIMEOUT": ("0", "honored",
+                               "per-attempt bound on joining the "
+                               "jax.distributed job (0 = runtime "
+                               "default)"),
+    "MXNET_TPU_INIT_RETRIES": ("2", "honored",
+                               "bounded backoff retries for "
+                               "multihost.ensure_initialized"),
+    "MXNET_TPU_BARRIER_TIMEOUT": ("0", "honored",
+                                  "per-attempt bound on process_barrier "
+                                  "in seconds (0 = wait forever)"),
+    "MXNET_TPU_BARRIER_RETRIES": ("1", "honored",
+                                  "bounded backoff retries for "
+                                  "process_barrier"),
+    "MXNET_TPU_RESTART_BUDGET": ("0", "honored",
+                                 "tools/launch.py: relaunch a failed "
+                                 "job up to this many times from the "
+                                 "last complete checkpoint"),
+    "MXNET_TPU_HEARTBEAT_INTERVAL": ("0.2", "honored",
+                                     "tools/launch.py watchdog poll "
+                                     "interval (dead-rank detection "
+                                     "latency)"),
+    "MXNET_TPU_RESTART_COUNT": ("0", "honored",
+                                "set by tools/launch.py on each restart "
+                                "attempt; resume-aware scripts reload "
+                                "their latest checkpoint when > 0"),
 }
 
 

@@ -20,6 +20,7 @@ import numpy as np
 
 from .base import MXNetError
 from . import ndarray
+from . import resilience
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "DevicePrefetchIter",
@@ -177,6 +178,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self.prefetch_errors = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -184,9 +186,22 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
+                    # the io.prefetch fault seam: injected faults retry
+                    # with backoff (transient-read semantics); a real —
+                    # or exhausted — error is surfaced on the consumer
+                    # in iter_next instead of killing this thread and
+                    # hanging the consumer on data_ready forever
+                    resilience.retry_call(
+                        resilience.fault_point, args=("io.prefetch",),
+                        retries=2, base_delay=0.01, max_delay=0.1,
+                        exceptions=(resilience.FaultInjected,),
+                        name="io.prefetch")
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
+                except BaseException as e:
+                    self.next_batch[i] = None
+                    self.prefetch_errors[i] = e
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
@@ -232,6 +247,18 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        errs = [e for e in self.prefetch_errors if e is not None]
+        if errs:
+            # re-arm EVERY slot before raising so a caller that treats
+            # the error as transient can keep iterating: the whole
+            # composite batch is dropped (re-arming only the errored
+            # slot would leave the other iterators one batch ahead —
+            # silently mismatched data/labels for the rest of the epoch)
+            for i in range(self.n_iter):
+                self.prefetch_errors[i] = None
+                self.data_ready[i].clear()
+                self.data_taken[i].set()
+            raise errs[0]
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iters"
@@ -372,6 +399,14 @@ class DevicePrefetchIter:
                 for batch in self._it:
                     if self._stop:
                         return
+                    # io.prefetch fault seam: injected staging faults
+                    # retry with backoff; exhaustion surfaces on the
+                    # consumer like any other staging error
+                    resilience.retry_call(
+                        resilience.fault_point, args=("io.prefetch",),
+                        retries=2, base_delay=0.01, max_delay=0.1,
+                        exceptions=(resilience.FaultInjected,),
+                        name="io.prefetch")
                     staged = self._stage(self._to_host_dict(batch))
                     if not self._put(("item", staged)):
                         return

@@ -71,13 +71,27 @@ def jpeg_available():
 
 
 class NativeRecordIOReader:
-    """Threaded-prefetch sequential reader over the reference .rec format."""
+    """Threaded-prefetch sequential reader over the reference .rec format.
 
-    def __init__(self, path, queue_cap=64, max_record=1 << 24):
+    ``skip_bad_records`` (or ``MXNET_TPU_BAD_RECORD_QUOTA``) mirrors the
+    pure-python ``MXRecordIO`` tolerant mode: records the native reader
+    rejects (oversized / negative return) are counted on ``bad_records``
+    and skipped under the quota instead of surfacing as hard errors, and
+    the ``recordio.read`` fault seam fires per read so chaos specs cover
+    the native path too."""
+
+    def __init__(self, path, queue_cap=64, max_record=1 << 24,
+                 skip_bad_records=None):
         lib = _load()
         if lib is None:
             raise RuntimeError("native IO library unavailable")
         self._lib = lib
+        self._path = path
+        if skip_bad_records is None:
+            from . import config
+            skip_bad_records = config.get_int("MXNET_TPU_BAD_RECORD_QUOTA")
+        self._bad_quota = int(skip_bad_records)
+        self.bad_records = 0
         self._handle = lib.MXTPURecordIOReaderCreate(
             path.encode(), queue_cap)
         if not self._handle:
@@ -85,13 +99,51 @@ class NativeRecordIOReader:
         self._buf = (ctypes.c_uint8 * max_record)()
         self._max_record = max_record
 
+    def _note_bad_record(self, exc):
+        if self._bad_quota <= 0:
+            raise exc
+        self.bad_records += 1
+        if self.bad_records > self._bad_quota:
+            raise IOError(
+                "%s: bad-record quota exhausted (%d > %d); last "
+                "error: %s" % (self._path, self.bad_records,
+                               self._bad_quota, exc)) from exc
+        import logging
+        logging.warning("%s: skipping bad record (%d/%d under quota): "
+                        "%s", self._path, self.bad_records,
+                        self._bad_quota, exc)
+
     def read(self):
         """Next record bytes, or None at EOF."""
-        n = self._lib.MXTPURecordIOReaderNext(self._handle, self._buf,
-                                              self._max_record)
-        if n <= 0:
-            return None
-        return bytes(bytearray(self._buf[:n]))
+        from . import resilience
+        while True:
+            dropped = False
+            try:
+                resilience.fault_point("recordio.read")
+            except resilience.FaultInjected as e:
+                # the injected fault corrupted this record: count it
+                # once and drop it after the (shared) validity checks
+                self._note_bad_record(e)
+                dropped = True
+            n = self._lib.MXTPURecordIOReaderNext(self._handle, self._buf,
+                                                  self._max_record)
+            if n == 0:
+                return None
+            if n < 0 or n > self._max_record:
+                # the native side returns the FULL record size but only
+                # memcpy's min(n, buf_size) bytes: an oversized record
+                # would otherwise be returned silently truncated.  Count
+                # it against the quota (the record was already consumed)
+                # unless the injected fault already claimed it
+                if not dropped:
+                    self._note_bad_record(IOError(
+                        "%s: record of %d bytes exceeds the %d-byte "
+                        "staging buffer (or native error)"
+                        % (self._path, n, self._max_record)))
+                continue
+            if dropped:
+                continue
+            return bytes(bytearray(self._buf[:n]))
 
     def read_float_batch(self, batch, record_floats):
         """Parse ``batch`` records of IRHeader+float32 payload into

@@ -7,13 +7,18 @@ define the prefix-symbol.json + prefix-%04d.params format (model.py:319-380).
 """
 from __future__ import annotations
 
+import glob
 import logging
+import os
+import re
 from collections import namedtuple
+from struct import error as struct_error
 
 import numpy as np
 
 from . import io
 from . import ndarray as nd
+from . import resilience
 from . import symbol as sym
 from . import kvstore as kvs
 from .base import MXNetError
@@ -21,7 +26,7 @@ from .context import cpu
 from .ndarray import NDArray
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "FeedForward"]
+           "find_checkpoints", "load_latest_checkpoint", "FeedForward"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -94,20 +99,61 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Write prefix-symbol.json + prefix-%04d.params
-    (reference model.py:319-347)."""
+    (reference model.py:319-347).
+
+    Writes are atomic (tmp file + fsync + rename, resilience.atomic_write)
+    and committed by a ``prefix-%04d.manifest.json`` sidecar holding
+    per-array and per-file CRC32s: a crash at ANY point leaves either the
+    previous complete checkpoint or a stray ``.tmp`` file, never a
+    half-written ``.params`` a loader could mistake for a checkpoint.
+    The ``checkpoint.save`` fault seam fires between the params tmp
+    write and its rename (the real crash window)."""
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        resilience.atomic_write("%s-symbol.json" % prefix, symbol.save)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    resilience.atomic_write(param_name,
+                            lambda tmp: nd.save(tmp, save_dict),
+                            fault_site="checkpoint.save")
+    resilience.write_manifest(prefix, epoch, [param_name],
+                              arrays=save_dict)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
 def load_checkpoint(prefix, epoch):
-    """Read (symbol, arg_params, aux_params) (reference model.py:349-380)."""
-    symbol = sym.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    """Read (symbol, arg_params, aux_params) (reference model.py:349-380).
+
+    The manifest (when present) is CRC-verified BEFORE the params file
+    is parsed; missing or corrupt files raise a descriptive
+    :class:`~mxnet_tpu.base.MXNetError` naming the path instead of a raw
+    FileNotFoundError/unpickling traceback.  See
+    :func:`load_latest_checkpoint` for fallback to the newest complete
+    checkpoint."""
+    resilience.fault_point("checkpoint.load")
+    sym_name = "%s-symbol.json" % prefix
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    resilience.verify_manifest(prefix, epoch)
+    try:
+        symbol = sym.load(sym_name)
+    except FileNotFoundError as e:
+        raise MXNetError("checkpoint symbol file %r is missing — was "
+                         "save_checkpoint(%r, ...) ever run?"
+                         % (sym_name, prefix)) from e
+    except (ValueError, KeyError) as e:
+        raise MXNetError("checkpoint symbol file %r is corrupt: %s"
+                         % (sym_name, e)) from e
+    try:
+        save_dict = nd.load(param_name)
+    except FileNotFoundError as e:
+        raise MXNetError(
+            "checkpoint params file %r is missing for epoch %d — "
+            "available epochs for this prefix: %s"
+            % (param_name, epoch, find_checkpoints(prefix) or "none")) \
+            from e
+    except (MXNetError, ValueError, struct_error, EOFError) as e:
+        raise MXNetError("checkpoint params file %r is corrupt: %s"
+                         % (param_name, e)) from e
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():
@@ -117,6 +163,63 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+def find_checkpoints(prefix, require_states=False):
+    """Sorted epochs with a complete ``prefix-%04d.params`` on disk.
+
+    An epoch counts only if its manifest (when one exists) screens
+    clean (files present at their recorded sizes) — a save that crashed
+    between tmp-write and rename, or a truncated file, is invisible
+    here.  Full CRC verification happens in :func:`load_checkpoint` on
+    the epoch actually opened (screening every retained epoch by CRC
+    would read every checkpoint byte on disk).  ``require_states``
+    additionally demands the ``.states`` optimizer file."""
+    epochs = []
+    # escape the prefix in both patterns: a sibling prefix ('job' vs
+    # 'job-b') or a glob metacharacter in the path must not produce
+    # phantom epochs / empty scans
+    pat = re.compile(re.escape(prefix) + r"-(\d{4,})\.params$")
+    for f in glob.glob("%s-*.params" % glob.escape(prefix)):
+        # %04d zero-pads to 4 digits but renders 5+ digits in full, so
+        # epochs >= 10000 (routine when step counts are epochs) match too
+        m = pat.match(f)
+        if not m:
+            continue
+        ep = int(m.group(1))
+        if require_states and not os.path.exists(
+                "%s-%04d.states" % (prefix, ep)):
+            continue
+        try:
+            resilience.verify_manifest(prefix, ep, quick=True)
+        except MXNetError as e:
+            logging.warning("skipping unverifiable checkpoint epoch %d "
+                            "of %r: %s", ep, prefix, e)
+            continue
+        epochs.append(ep)
+    return sorted(epochs)
+
+
+def load_latest_checkpoint(prefix, require_states=False):
+    """Load the newest COMPLETE checkpoint for ``prefix``, falling back
+    past corrupt/incomplete ones (each skip is logged).  Returns
+    ``(epoch, symbol, arg_params, aux_params)``; raises
+    :class:`~mxnet_tpu.base.MXNetError` when no loadable checkpoint
+    exists."""
+    failures = []
+    for ep in reversed(find_checkpoints(prefix,
+                                        require_states=require_states)):
+        try:
+            symbol, args_, aux_ = load_checkpoint(prefix, ep)
+            return ep, symbol, args_, aux_
+        except MXNetError as e:
+            failures.append("epoch %d: %s" % (ep, e))
+            logging.warning("falling back past checkpoint epoch %d of "
+                            "%r: %s", ep, prefix, e)
+    raise MXNetError(
+        "no complete checkpoint found for prefix %r%s"
+        % (prefix, " (tried: %s)" % "; ".join(failures)
+           if failures else ""))
 
 
 class FeedForward:

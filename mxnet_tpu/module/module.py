@@ -95,15 +95,27 @@ class Module(BaseModule):
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """Save symbol + params (+ optimizer states) (reference
-        module.py:133-155)."""
-        self._symbol.save("%s-symbol.json" % prefix)
+        module.py:133-155).  Writes are atomic (tmp + fsync + rename)
+        and committed by a CRC32 manifest, like model.save_checkpoint —
+        see docs/api/resilience.md."""
+        from .. import resilience
+        resilience.atomic_write("%s-symbol.json" % prefix,
+                                self._symbol.save)
         param_name = "%s-%04d.params" % (prefix, epoch)
-        self.save_params(param_name)
+        resilience.atomic_write(param_name, self.save_params,
+                                fault_site="checkpoint.save")
         logging.info("Saved checkpoint to \"%s\"", param_name)
+        files = [param_name]
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
+            resilience.atomic_write(state_name,
+                                    self.save_optimizer_states)
             logging.info("Saved optimizer state to \"%s\"", state_name)
+            files.append(state_name)
+        arg_params, aux_params = self.get_params()
+        arrays = {("arg:%s" % k): v for k, v in arg_params.items()}
+        arrays.update({("aux:%s" % k): v for k, v in aux_params.items()})
+        resilience.write_manifest(prefix, epoch, files, arrays=arrays)
 
     # ---------------------------------------------------------- properties
     @property

@@ -31,38 +31,93 @@ __all__ = ["ensure_initialized", "spans_processes", "stage_local",
            "scale_local_shape", "gather_to_host", "process_barrier"]
 
 
+def _distributed_initialized():
+    """True when this process already joined a jax.distributed job.
+    ``jax.distributed.is_initialized`` only exists on newer jax; fall
+    back to the runtime state object older versions expose."""
+    import jax
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None) is not None
+    except ImportError:  # pragma: no cover - very old jax
+        return False
+
+
 def ensure_initialized():
     """Join the ``jax.distributed`` job described by the MXNET_TPU_*
     env (set by ``tools/launch.py``); no-op for single-process jobs or
     when the runtime is already up.  Must run before the XLA backend is
     touched — the first eagerly-executed primitive binds it, after
-    which joining is impossible."""
+    which joining is impossible.
+
+    Resilience: the join is bounded by ``MXNET_TPU_INIT_TIMEOUT``
+    seconds (0/unset = the runtime's own timeout); transient connect
+    failures are retried with exponential backoff up to
+    ``MXNET_TPU_INIT_RETRIES`` times (default 2) — a coordinator that
+    is still binding its port when a fast rank arrives no longer kills
+    the whole job.  A TIMED-OUT join is terminal (see the retry_call
+    below).  The ``multihost.init`` fault seam (resilience.py) fires
+    inside the retried attempt."""
     import jax
     from .. import config
+    from .. import resilience
 
     nproc = config.get_int("MXNET_TPU_NUM_PROCESSES")
-    if not nproc or nproc <= 1 or jax.distributed.is_initialized():
-        return
+    need_init = bool(nproc and nproc > 1
+                     and not _distributed_initialized())
     coordinator = config.get("MXNET_TPU_COORDINATOR")
-    if not coordinator:
-        # a silent localhost default would make every rank wait on its
-        # own unbound port — fail fast instead
+    if need_init and not coordinator:
+        # a config error never heals — fail fast OUTSIDE the retry (a
+        # silent localhost default would make every rank wait on its
+        # own unbound port)
         raise MXNetError(
             "MXNET_TPU_NUM_PROCESSES=%d but MXNET_TPU_COORDINATOR is "
             "unset; launch via tools/launch.py or export the "
             "coordinator address" % nproc)
+    import inspect
     kwargs = {}
+    accepted = inspect.signature(jax.distributed.initialize).parameters
     hb = config.get_int("MXNET_TPU_HEARTBEAT_TIMEOUT")
-    if hb:
+    if hb and "heartbeat_timeout_seconds" in accepted:
         # failure detection: a dead peer is declared failed after this
         # many seconds without heartbeats (the reference's ps-lite
-        # heartbeat role, kvstore_dist.h:159-169); default 100 s
+        # heartbeat role, kvstore_dist.h:159-169); default 100 s.
+        # Older jax has no such kwarg — the env is then only consumed
+        # by the launch.py watchdog.
         kwargs["heartbeat_timeout_seconds"] = hb
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=nproc,
-        process_id=config.get_int("MXNET_TPU_PROCESS_ID", 0),
-        **kwargs)
+    timeout = config.get_int("MXNET_TPU_INIT_TIMEOUT")
+    if timeout and "initialization_timeout" in accepted:
+        kwargs["initialization_timeout"] = timeout
+
+    def attempt():
+        resilience.fault_point("multihost.init")
+        if not need_init or _distributed_initialized():
+            return
+        resilience.with_timeout(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=nproc,
+                process_id=config.get_int("MXNET_TPU_PROCESS_ID", 0),
+                **kwargs),
+            timeout or None,
+            name="jax.distributed.initialize(%s)" % coordinator)
+
+    # a TIMED-OUT initialize is terminal, not retried: its daemon
+    # thread is still inside the coordinator handshake, and a second
+    # concurrent initialize from this process could double-register the
+    # rank.  Transient pre-connect failures (coordinator still binding
+    # its port) are the retryable class.
+    resilience.retry_call(
+        attempt,
+        retries=config.get_int("MXNET_TPU_INIT_RETRIES", "2"),
+        exceptions=(resilience.FaultInjected, RuntimeError,
+                    ConnectionError, OSError),
+        no_retry=(resilience.TimeoutError,),
+        base_delay=0.2, max_delay=5.0,
+        name="multihost.ensure_initialized")
 
 
 def spans_processes(mesh):
@@ -120,8 +175,36 @@ def gather_to_host(arr):
 
 def process_barrier(name="mxnet_tpu_multihost"):
     """Block until every process reaches this point (checkpoint
-    write/read ordering across ranks)."""
+    write/read ordering across ranks).
+
+    Resilience: with ``MXNET_TPU_BARRIER_TIMEOUT`` set (seconds), the
+    sync is bounded: a TIMEOUT is terminal and raises
+    :class:`~mxnet_tpu.base.MXNetError` naming the barrier — the
+    dead-rank detector for rendezvous points, instead of an unbounded
+    hang against a preempted peer.  (A timed-out collective is NOT
+    retried: the hung attempt's thread is still parked inside it, and
+    re-entering the same barrier from a second thread of this process
+    would corrupt the rendezvous.)  Transient pre-collective failures —
+    including the ``multihost.barrier`` fault seam — are retried up to
+    ``MXNET_TPU_BARRIER_RETRIES`` times (default 1) with backoff.
+    0/unset keeps the previous wait-forever behavior."""
     import jax
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(name)
+    from .. import config
+    from .. import resilience
+
+    timeout = config.get_int("MXNET_TPU_BARRIER_TIMEOUT") or None
+
+    def attempt():
+        resilience.fault_point("multihost.barrier")
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            resilience.with_timeout(
+                lambda: multihost_utils.sync_global_devices(name),
+                timeout, name="process_barrier(%r)" % name)
+
+    resilience.retry_call(
+        attempt,
+        retries=config.get_int("MXNET_TPU_BARRIER_RETRIES", "1"),
+        exceptions=(resilience.FaultInjected,),
+        base_delay=0.1, max_delay=2.0,
+        name="process_barrier(%r)" % name)

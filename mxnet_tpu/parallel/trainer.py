@@ -37,6 +37,7 @@ training scripts use this.
 from __future__ import annotations
 
 import os
+import struct as _struct
 
 import numpy as np
 
@@ -497,6 +498,10 @@ class ShardedTrainer:
         self._scan_fns = {}
         self._fwd_fn = None
         self._step_count = 0
+        # epoch this trainer resumed from (load_checkpoint sets it):
+        # _step_count restarts at 0 after a resume, so anything deriving
+        # a global step/epoch must add this offset
+        self._resume_epoch = 0
         self._key = jax.random.PRNGKey(seed)
         self._hyper_snapshot = self._hyper_state()
 
@@ -1134,10 +1139,19 @@ class ShardedTrainer:
         """
         import jax
         import jax.numpy as jnp
-        from jax.experimental.layout import Format, Layout
+        try:
+            # jax >= 0.5 naming
+            from jax.experimental.layout import Format, Layout
+            _auto = Layout.AUTO
+        except ImportError:
+            # jax 0.4.x: Layout(device_local_layout, sharding) is the
+            # format wrapper and DeviceLocalLayout carries AUTO
+            from jax.experimental.layout import (DeviceLocalLayout,
+                                                 Layout as Format)
+            _auto = DeviceLocalLayout.AUTO
 
         def auto_of(sharding_tree):
-            return jax.tree.map(lambda s: Format(Layout.AUTO, s),
+            return jax.tree.map(lambda s: Format(_auto, s),
                                 sharding_tree,
                                 is_leaf=lambda x: hasattr(x, "spec"))
 
@@ -1172,7 +1186,9 @@ class ShardedTrainer:
                    as_spec(self.aux), zero_batch, jax.random.PRNGKey(0),
                    lr_example, t_example)
         compiled = jf.lower(*example).compile()
-        fmts = compiled.input_formats[0]
+        fmts = (compiled.input_formats
+                if hasattr(compiled, "input_formats")
+                else compiled.input_layouts)[0]
         compiled._state_formats = (fmts[0], fmts[1], fmts[2])
         if migrate:
             # migrate live state into the chosen layouts (one-time copies)
@@ -1490,20 +1506,40 @@ class ShardedTrainer:
                     st["slot%d:%s" % (i, k)] = to_ref(
                         k, multihost.gather_to_host(sl))
         if not self._multiproc or jax.process_index() == 0:
-            self.symbol.save("%s-symbol.json" % prefix)
-            _nd.save("%s-%04d.params" % (prefix, epoch),
-                     {k: _nd.array(v) for k, v in host.items()})
+            from .. import resilience
+            resilience.atomic_write("%s-symbol.json" % prefix,
+                                    self.symbol.save)
+            param_name = "%s-%04d.params" % (prefix, epoch)
+            resilience.atomic_write(
+                param_name,
+                lambda tmp: _nd.save(
+                    tmp, {k: _nd.array(v) for k, v in host.items()}),
+                fault_site="checkpoint.save")
+            files = [param_name]
+            arrays = dict(host)
             if st is not None:
-                _nd.save("%s-%04d.states" % (prefix, epoch),
-                         {k: _nd.array(v) for k, v in st.items()})
+                states_name = "%s-%04d.states" % (prefix, epoch)
+                resilience.atomic_write(
+                    states_name,
+                    lambda tmp: _nd.save(
+                        tmp, {k: _nd.array(v) for k, v in st.items()}))
+                files.append(states_name)
+                arrays.update(st)
+            # the manifest commits the checkpoint: written LAST (itself
+            # atomically), so a crash anywhere above leaves no epoch a
+            # verified loader would pick up
+            resilience.write_manifest(prefix, epoch, files, arrays=arrays)
         if self._multiproc:
             multihost.process_barrier("sharded_trainer_ckpt_save")
 
     def _state_target(self, live, sharding):
         """device_put target preserving the live array's layout: under
         auto_layouts the AOT-compiled step was lowered with XLA-chosen
-        formats, which a plain NamedSharding put would discard."""
-        return live.format if self._auto_layouts else sharding
+        formats, which a plain NamedSharding put would discard.
+        (jax 0.4.x spells the array's format ``.layout``.)"""
+        if not self._auto_layouts:
+            return sharding
+        return getattr(live, "format", None) or live.layout
 
     def load_checkpoint(self, prefix, epoch, load_optimizer_states=False):
         """Restore params/aux (and fused optimizer slots) saved by
@@ -1517,8 +1553,22 @@ class ShardedTrainer:
         import jax
         import numpy as _np
         from .. import ndarray as _nd
+        from .. import resilience
 
-        loaded = _nd.load("%s-%04d.params" % (prefix, epoch))
+        resilience.fault_point("checkpoint.load")
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        # manifest CRC verification first: a truncated/corrupt file must
+        # surface as a named MXNetError, not an unpickle traceback
+        resilience.verify_manifest(prefix, epoch)
+        try:
+            loaded = _nd.load(param_name)
+        except FileNotFoundError as e:
+            raise MXNetError(
+                "checkpoint params file %r is missing for epoch %d"
+                % (param_name, epoch)) from e
+        except (ValueError, EOFError, _struct.error) as e:
+            raise MXNetError("checkpoint params file %r is corrupt: %s"
+                             % (param_name, e)) from e
         file_args = {k.split(":", 1)[1]: v for k, v in loaded.items()
                      if k.startswith("arg:")}
         file_aux = {k.split(":", 1)[1]: v for k, v in loaded.items()
@@ -1545,7 +1595,17 @@ class ShardedTrainer:
                     self._state_target(self.aux[name],
                                        self._aux_sharding[name]))
             if load_optimizer_states:
-                st = _nd.load("%s-%04d.states" % (prefix, epoch))
+                states_name = "%s-%04d.states" % (prefix, epoch)
+                try:
+                    st = _nd.load(states_name)
+                except FileNotFoundError as e:
+                    raise MXNetError(
+                        "checkpoint states file %r is missing for epoch "
+                        "%d" % (states_name, epoch)) from e
+                except (ValueError, EOFError, _struct.error) as e:
+                    raise MXNetError(
+                        "checkpoint states file %r is corrupt: %s"
+                        % (states_name, e)) from e
                 slots_in_file = {}
                 for k in st:
                     if k.startswith("slot"):
@@ -1575,6 +1635,90 @@ class ShardedTrainer:
                                  _np.asarray(v.asnumpy(), _np.float32)),
                         self._state_target(self.opt_state[name][i],
                                            self._param_sharding[name]))
+        # the restored state IS the new baseline: steps counted before
+        # this load no longer describe it (with optimizer states the
+        # meta branch above also restored begin_num_update)
+        self._resume_epoch = int(epoch)
+        self._step_count = 0
+
+    def load_latest_checkpoint(self, prefix, load_optimizer_states=False):
+        """Restore from the NEWEST complete checkpoint under ``prefix``,
+        falling back past corrupt/incomplete epochs (a save interrupted
+        between tmp-write and rename is invisible; a CRC-failing file is
+        skipped with a warning).  Returns the restored epoch, or None
+        when no checkpoint exists yet (caller starts fresh) — the
+        preemption-restart resume path."""
+        import logging
+        from ..base import MXNetError as _Err
+        from ..model import find_checkpoints
+
+        for ep in reversed(find_checkpoints(
+                prefix, require_states=load_optimizer_states)):
+            try:
+                self.load_checkpoint(
+                    prefix, ep, load_optimizer_states=load_optimizer_states)
+                return ep
+            except _Err as e:
+                logging.warning("falling back past checkpoint epoch %d "
+                                "of %r: %s", ep, prefix, e)
+        return None
+
+    def install_preemption_handler(self, prefix, save_optimizer_states=True,
+                                   signals=None, exit_process=True):
+        """Checkpoint-and-exit cleanly on SIGTERM (host preemption).
+
+        Cloud TPU hosts get a SIGTERM grace window before shutdown; the
+        handler writes an atomic checkpoint at epoch = resumed epoch +
+        completed step count and exits 0, so the supervisor (tools/launch.py watchdog
+        or an external scheduler) can restart the job and
+        :meth:`load_latest_checkpoint` resumes it.  Runs in the MAIN
+        thread between Python bytecodes — an in-flight jitted step
+        finishes first, so the saved state is step-consistent.
+
+        Multi-host caveat: save_checkpoint is collective (the gather);
+        the handler assumes every rank receives the signal (true for
+        whole-slice preemption and for launch.py's group teardown).
+
+        Returns the handler (its ``.triggered`` attribute flips to True
+        after it fires — useful when ``exit_process=False`` and the
+        training loop wants to drain and stop itself)."""
+        import signal as _signal
+        import sys as _sys
+        import logging
+
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+
+        def handler(signum, frame):
+            if handler._saving:         # repeated TERM during the save
+                return
+            handler._saving = True
+            try:
+                # _step_count restarts at 0 after a resume: offset by
+                # the resumed epoch so a SECOND preemption never writes
+                # a lower epoch than the first (load_latest would
+                # resume the older checkpoint and re-train the same
+                # window forever)
+                epoch = self._resume_epoch + self._step_count
+                logging.warning(
+                    "preemption signal %d: checkpointing to %r epoch "
+                    "%d and exiting", signum, prefix, epoch)
+                self.save_checkpoint(
+                    prefix, epoch,
+                    save_optimizer_states=save_optimizer_states)
+                handler.triggered = True
+                if exit_process:
+                    _sys.exit(0)
+            finally:
+                # in drain mode (exit_process=False) a LATER preemption
+                # must checkpoint again, not be swallowed by a latch
+                handler._saving = False
+
+        handler._saving = False
+        handler.triggered = False
+        for sig in signals:
+            _signal.signal(sig, handler)
+        return handler
 
 
 

@@ -25,12 +25,28 @@ _LENGTH_MASK = (1 << 29) - 1
 
 
 class MXRecordIO:
-    """Sequential record reader/writer (reference MXRecordIO)."""
+    """Sequential record reader/writer (reference MXRecordIO).
 
-    def __init__(self, uri, flag):
+    ``skip_bad_records`` (or the ``MXNET_TPU_BAD_RECORD_QUOTA`` env)
+    arms tolerant reads: a corrupt or truncated record is skipped by
+    scanning forward to the next 4-aligned magic word instead of raising
+    ``IOError``, up to that many records per reader.  Skips are counted
+    on ``bad_records``/``skipped_bytes``/``resyncs`` so callers can
+    surface data loss; exceeding the quota raises ``IOError`` naming
+    the file and count.  Default quota 0 = strict (reference behavior).
+    """
+
+    def __init__(self, uri, flag, skip_bad_records=None):
         self.uri = uri
         self.flag = flag
         self.fid = None
+        if skip_bad_records is None:
+            from . import config
+            skip_bad_records = config.get_int("MXNET_TPU_BAD_RECORD_QUOTA")
+        self._bad_quota = int(skip_bad_records)
+        self.bad_records = 0
+        self.skipped_bytes = 0
+        self.resyncs = 0
         self.open()
 
     def open(self):
@@ -50,9 +66,12 @@ class MXRecordIO:
             self.is_open = False
 
     def __del__(self):
+        # interpreter-teardown close: only swallow the I/O errors a
+        # half-constructed or already-closed reader can raise — a bare
+        # ``except Exception`` here used to hide real parse bugs
         try:
             self.close()
-        except Exception:
+        except (OSError, ValueError, AttributeError):
             pass
 
     def __getstate__(self):
@@ -126,15 +145,21 @@ class MXRecordIO:
             raise IOError("Invalid magic number in %s" % self.uri)
         length = lrec & _LENGTH_MASK
         buf = self.fid.read(length)
+        if len(buf) < length:
+            # a corrupt length field reads to EOF silently otherwise,
+            # losing the rest of the file behind a garbage record
+            raise IOError("truncated record in %s: header claims %d "
+                          "bytes, file has %d"
+                          % (self.uri, length, len(buf)))
         pad = (4 - (length % 4)) % 4
         if pad:
             self.fid.read(pad)
         return lrec >> 29, buf
 
-    def read(self):
-        """Read the next record, or None at EOF (re-joins continuation
-        parts with the magic word re-inserted)."""
-        assert not self.writable
+    def _read_record(self):
+        """One framed record, or None at EOF (re-joins continuation
+        parts with the magic word re-inserted).  Strict: corruption
+        raises IOError."""
         cflag, buf = self._read_part()
         if buf is None:
             return None
@@ -154,6 +179,90 @@ class MXRecordIO:
             if cflag == 3:
                 return b"".join(parts)
 
+    def _note_bad_record(self, exc):
+        """Count one corrupt/truncated record against the quota;
+        re-raises when no quota is configured, IOError when the quota
+        is exhausted."""
+        if self._bad_quota <= 0:
+            raise exc
+        self.bad_records += 1
+        if self.bad_records > self._bad_quota:
+            raise IOError(
+                "%s: bad-record quota exhausted (%d corrupt/truncated "
+                "records > quota %d); last error: %s"
+                % (self.uri, self.bad_records, self._bad_quota,
+                   exc)) from exc
+        import logging
+        logging.warning("%s: skipping corrupt record (%d/%d under "
+                        "quota): %s", self.uri, self.bad_records,
+                        self._bad_quota, exc)
+
+    def _resync(self):
+        """Scan forward to the next 4-aligned magic word (dmlc recordio
+        framing makes every record boundary one).  Returns False at EOF.
+        Skipped bytes are accounted on ``skipped_bytes``."""
+        magic_bytes = struct.pack("<I", _MAGIC)
+        start = self.fid.tell()
+        start += (-start) % 4
+        self.fid.seek(start)
+        base, tail = start, b""
+        while True:
+            chunk = self.fid.read(1 << 16)
+            if not chunk:
+                self.skipped_bytes += base + len(tail) - start
+                return False
+            buf = tail + chunk
+            i = buf.find(magic_bytes)
+            while i != -1:
+                off = base + i
+                if off % 4 == 0 and off >= start:
+                    self.fid.seek(off)
+                    self.resyncs += 1
+                    self.skipped_bytes += off - start
+                    return True
+                i = buf.find(magic_bytes, i + 1)
+            keep = min(3, len(buf))
+            base += len(buf) - keep
+            tail = buf[len(buf) - keep:]
+
+    def read(self):
+        """Read the next record, or None at EOF.
+
+        With a bad-record quota (see the constructor) corrupt or
+        truncated records are skipped by magic-resync and counted
+        instead of raising; the ``recordio.read`` fault seam
+        (resilience.py) injects per-record corruption here for chaos
+        tests — an injected fault drops the record it would have
+        returned, exactly like real corruption."""
+        assert not self.writable
+        from . import resilience
+        while True:
+            # remember where this record starts: a corrupt length field
+            # can drag the file position to EOF, so resync must restart
+            # just past THIS record's magic, not from wherever the
+            # failed read left off
+            start = self.fid.tell()
+            try:
+                resilience.fault_point("recordio.read")
+                return self._read_record()
+            except resilience.FaultInjected as e:
+                self._note_bad_record(e)
+                try:
+                    # the injected fault stands for a corrupt payload:
+                    # consume and drop one record, continue with the next
+                    if self._read_record() is None:
+                        return None
+                except (IOError, OSError, struct.error, ValueError) as e2:
+                    self._note_bad_record(e2)
+                    self.fid.seek(start + 4)
+                    if not self._resync():
+                        return None
+            except (IOError, OSError, struct.error, ValueError) as e:
+                self._note_bad_record(e)
+                self.fid.seek(start + 4)
+                if not self._resync():
+                    return None
+
 
 class MXIndexedRecordIO(MXRecordIO):
     """Random-access reader/writer with `.idx` sidecar
@@ -172,10 +281,16 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         if not self.writable and os.path.isfile(self.idx_path):
             with open(self.idx_path) as fin:
-                for line in fin.readlines():
-                    line = line.strip().split("\t")
-                    key = self.key_type(line[0])
-                    self.idx[key] = int(line[1])
+                for lineno, raw in enumerate(fin, 1):
+                    try:
+                        line = raw.strip().split("\t")
+                        key = self.key_type(line[0])
+                        self.idx[key] = int(line[1])
+                    except (ValueError, IndexError) as e:
+                        raise IOError(
+                            "corrupt index %s:%d (%r): %s"
+                            % (self.idx_path, lineno, raw.strip(), e)) \
+                            from e
                     self.keys.append(key)
 
     def close(self):
@@ -222,13 +337,21 @@ def pack(header, s):
 
 
 def unpack(s):
-    """Unpack a record into (IRHeader, payload)."""
-    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
-    s = s[_IR_SIZE:]
-    if header.flag > 0:
-        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
-        header = header._replace(label=label)
-        s = s[header.flag * 4:]
+    """Unpack a record into (IRHeader, payload).
+
+    The flag/header parse catches only ``struct.error``/``ValueError``
+    (truncated or malformed headers) and re-raises with the original
+    message preserved — anything else is a real bug and propagates."""
+    try:
+        header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+        s = s[_IR_SIZE:]
+        if header.flag > 0:
+            label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+            header = header._replace(label=label)
+            s = s[header.flag * 4:]
+    except (struct.error, ValueError) as e:
+        raise ValueError("invalid IRHeader in %d-byte record: %s"
+                         % (len(s), e)) from e
     return header, s
 
 

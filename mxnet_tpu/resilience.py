@@ -1,0 +1,489 @@
+"""Resilience primitives: fault injection, retry/backoff, atomic files.
+
+The reference framework's distributed story is ps-lite heartbeats plus
+restart-from-checkpoint (``src/kvstore/kvstore_dist.h:39-80``, SURVEY
+§5.8).  The TPU-native port replaced ps-lite with ``jax.distributed``
+collectives, so failure handling moves into the framework itself.  This
+module is the shared substrate the other layers build on:
+
+* a deterministic, seeded **fault-injection registry**: seams are
+  declared at named sites (:data:`KNOWN_SITES`) via :func:`fault_point`
+  calls in production code, and armed through ``MXNET_TPU_FAULTS`` (or
+  :func:`configure_faults`) so tests and chaos runs reproduce exact
+  failure sequences.  Spec grammar (sites separated by ``;``)::
+
+      MXNET_TPU_FAULTS="recordio.read:p=0.05,seed=7;checkpoint.save:n=1"
+
+  per-site keys: ``p`` (probability, default 1), ``seed`` (per-site RNG
+  seed, default 0), ``n`` (max injections, default unlimited), ``after``
+  (skip the first K evaluations), ``kind`` (``error`` raises
+  :class:`FaultInjected`, ``delay`` sleeps ``delay`` seconds — a
+  simulated hang for timeout paths);
+
+* **retry/timeout/backoff primitives**: :func:`backoff_delays`
+  (exponential with deterministic jitter), :func:`retry_call` /
+  :func:`retryable` (deadline-aware bounded retry), :func:`with_timeout`
+  (thread-based timeout wrapper), :class:`Deadline`;
+
+* **atomic file + checkpoint-manifest helpers**: :func:`atomic_write`
+  (tmp file, fsync, rename — the crash-safe write used by every
+  checkpoint path) and :func:`write_manifest` / :func:`verify_manifest`
+  (per-array CRC32 records that let a loader prove a checkpoint is
+  complete before unpickling it).
+
+See ``docs/api/resilience.md`` for the full grammar and knob table.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import struct
+import threading
+import time
+import zlib
+
+from .base import MXNetError
+
+__all__ = [
+    "KNOWN_SITES", "FaultInjected", "TimeoutError",
+    "configure_faults", "clear_faults", "fault_point", "fault_stats",
+    "faults_active",
+    "Deadline", "backoff_delays", "retry_call", "retryable",
+    "with_timeout",
+    "atomic_write", "array_crc32", "manifest_path", "write_manifest",
+    "verify_manifest", "load_manifest",
+]
+
+# the declared seam names; fault_point() accepts others (a typo'd site
+# simply never fires), but configure_faults() warns on unknown names so
+# chaos specs fail loudly instead of silently testing nothing
+KNOWN_SITES = (
+    "recordio.read", "checkpoint.save", "checkpoint.load",
+    "multihost.init", "multihost.barrier", "io.prefetch",
+)
+
+
+class FaultInjected(MXNetError):
+    """Raised by an armed :func:`fault_point` seam (never by real code)."""
+
+    def __init__(self, site, hit):
+        super().__init__(
+            "injected fault at site %r (injection #%d) — armed via "
+            "MXNET_TPU_FAULTS / configure_faults()" % (site, hit))
+        self.site = site
+        self.hit = hit
+
+
+class TimeoutError(MXNetError):
+    """A :func:`with_timeout`-wrapped call exceeded its deadline."""
+
+
+# --------------------------------------------------------------- fault registry
+
+class _Site:
+    __slots__ = ("name", "p", "seed", "times", "after", "kind", "delay",
+                 "rng", "calls", "hits")
+
+    def __init__(self, name, p=1.0, seed=0, times=None, after=0,
+                 kind="error", delay=0.05):
+        self.name = name
+        self.p = float(p)
+        self.seed = int(seed)
+        self.times = None if times is None else int(times)
+        self.after = int(after)
+        if kind not in ("error", "delay"):
+            raise MXNetError("fault site %r: unknown kind=%r "
+                             "(use error|delay)" % (name, kind))
+        self.kind = kind
+        self.delay = float(delay)
+        self.rng = random.Random(self.seed)
+        self.calls = 0
+        self.hits = 0
+
+
+_LOCK = threading.Lock()
+_SITES = {}
+_ENV_SNAPSHOT = None     # last-parsed MXNET_TPU_FAULTS value
+
+
+def _parse_spec(spec):
+    sites = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, _, params = part.partition(":")
+        else:
+            name, params = part, ""
+        name = name.strip()
+        if name not in KNOWN_SITES:
+            logging.warning(
+                "MXNET_TPU_FAULTS: site %r is not a declared seam %s — "
+                "the spec will never fire there", name, list(KNOWN_SITES))
+        kw = {}
+        for item in params.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise MXNetError(
+                    "bad fault spec %r: expected key=value, got %r "
+                    "(grammar: site:p=0.05,seed=7[,n=3,after=2,"
+                    "kind=error|delay,delay=0.1];site2:...)" % (spec, item))
+            k, _, v = item.partition("=")
+            k = k.strip()
+            if k not in ("p", "seed", "n", "after", "kind", "delay"):
+                raise MXNetError("bad fault spec %r: unknown key %r"
+                                 % (spec, k))
+            kw["times" if k == "n" else k] = v.strip()
+        sites[name] = _Site(name, **kw)
+    return sites
+
+
+def configure_faults(spec=None):
+    """Arm fault sites from a spec string (or ``MXNET_TPU_FAULTS`` when
+    ``spec`` is None).  Replaces the current configuration and resets
+    per-site counters/RNGs, so the injection sequence is reproducible
+    from the seed alone."""
+    global _SITES, _ENV_SNAPSHOT
+    if spec is None:
+        spec = os.environ.get("MXNET_TPU_FAULTS", "")
+    with _LOCK:
+        _SITES = _parse_spec(spec)
+        _ENV_SNAPSHOT = os.environ.get("MXNET_TPU_FAULTS", "")
+    return sorted(_SITES)
+
+
+def clear_faults():
+    """Disarm every site and forget the cached env snapshot."""
+    global _SITES, _ENV_SNAPSHOT
+    with _LOCK:
+        _SITES = {}
+        _ENV_SNAPSHOT = os.environ.get("MXNET_TPU_FAULTS", "")
+
+
+def faults_active():
+    """True when at least one site is armed."""
+    _sync_env()
+    return bool(_SITES)
+
+
+def _sync_env():
+    # arm lazily from the env so subprocesses (launch.py workers, chaos
+    # runs) inherit the spec with no code changes; a plain string compare
+    # keeps the hot path (one dict lookup per seam call) cheap
+    global _ENV_SNAPSHOT
+    env = os.environ.get("MXNET_TPU_FAULTS", "")
+    if env != _ENV_SNAPSHOT:
+        configure_faults(env)
+
+
+def fault_point(site):
+    """Evaluate the named seam.  No-op unless the site is armed; armed
+    sites draw from their own seeded RNG, so the k-th evaluation of a
+    site fires identically across runs.  ``kind=error`` raises
+    :class:`FaultInjected`; ``kind=delay`` sleeps (a simulated stall for
+    timeout paths)."""
+    _sync_env()
+    s = _SITES.get(site)
+    if s is None:
+        return
+    with _LOCK:
+        s.calls += 1
+        if s.calls <= s.after:
+            return
+        if s.times is not None and s.hits >= s.times:
+            return
+        if s.p < 1.0 and s.rng.random() >= s.p:
+            return
+        s.hits += 1
+        hit, kind, delay = s.hits, s.kind, s.delay
+    if kind == "delay":
+        time.sleep(delay)
+        return
+    raise FaultInjected(site, hit)
+
+
+def fault_stats():
+    """{site: {"calls": n, "hits": m}} for every armed site."""
+    with _LOCK:
+        return {name: {"calls": s.calls, "hits": s.hits}
+                for name, s in _SITES.items()}
+
+
+# ------------------------------------------------------------- retry / backoff
+
+class Deadline:
+    """Wall-clock budget shared across retries.  ``seconds=None`` never
+    expires."""
+
+    def __init__(self, seconds):
+        self.seconds = seconds
+        self._expiry = None if seconds is None \
+            else time.monotonic() + float(seconds)
+
+    def remaining(self):
+        """Seconds left (None = unbounded)."""
+        if self._expiry is None:
+            return None
+        return max(0.0, self._expiry - time.monotonic())
+
+    def expired(self):
+        return self._expiry is not None and \
+            time.monotonic() >= self._expiry
+
+
+def backoff_delays(base=0.05, factor=2.0, max_delay=2.0, jitter=0.1,
+                   seed=None):
+    """Generator of exponential backoff delays ``base * factor**k``
+    capped at ``max_delay``, each scaled by a uniform jitter in
+    ``[1-jitter, 1+jitter]``.  A fixed ``seed`` makes the sequence
+    deterministic (chaos runs record it; retries then replay
+    identically)."""
+    rng = random.Random(seed)
+    delay = float(base)
+    while True:
+        if jitter:
+            yield min(delay, max_delay) * \
+                (1.0 + jitter * (2.0 * rng.random() - 1.0))
+        else:
+            yield min(delay, max_delay)
+        delay = min(delay * factor, max_delay)
+
+
+def retry_call(fn, args=(), kwargs=None, retries=3,
+               exceptions=(Exception,), no_retry=(), base_delay=0.05,
+               factor=2.0, max_delay=2.0, jitter=0.1, deadline=None,
+               seed=None, on_retry=None, name=None):
+    """Call ``fn(*args, **kwargs)``; on a listed exception retry up to
+    ``retries`` more times with exponential backoff, never past
+    ``deadline`` seconds overall.  ``no_retry`` exceptions re-raise
+    immediately even when they also match ``exceptions`` (e.g. treat
+    :class:`TimeoutError` as terminal while retrying its RuntimeError
+    siblings).  ``on_retry(attempt, exc, delay)`` is invoked before
+    each sleep.  Exhaustion (or deadline expiry) raises
+    :class:`~mxnet_tpu.base.MXNetError` naming the call and chaining the
+    last error."""
+    kwargs = kwargs or {}
+    what = name or getattr(fn, "__name__", repr(fn))
+    dl = deadline if isinstance(deadline, Deadline) else Deadline(deadline)
+    delays = backoff_delays(base_delay, factor, max_delay, jitter, seed)
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except exceptions as e:
+            if no_retry and isinstance(e, tuple(no_retry)):
+                raise
+            last = e
+            if attempt >= retries:
+                break
+            delay = next(delays)
+            rem = dl.remaining()
+            if rem is not None:
+                if rem <= 0:
+                    break
+                delay = min(delay, rem)
+            if on_retry is not None:
+                on_retry(attempt + 1, e, delay)
+            else:
+                logging.warning("%s failed (%s: %s); retry %d/%d in "
+                                "%.2fs", what, type(e).__name__, e,
+                                attempt + 1, retries, delay)
+            time.sleep(delay)
+    raise MXNetError(
+        "%s failed after %d attempt(s)%s: %s: %s"
+        % (what, attempt + 1,
+           " (deadline %.1fs expired)" % dl.seconds
+           if dl.expired() and dl.seconds is not None else "",
+           type(last).__name__, last)) from last
+
+
+def retryable(**cfg):
+    """Decorator form of :func:`retry_call`::
+
+        @retryable(retries=2, exceptions=(IOError,), deadline=30)
+        def fetch(): ...
+    """
+    def wrap(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            return retry_call(fn, args=args, kwargs=kwargs, **cfg)
+        return inner
+    return wrap
+
+
+def with_timeout(fn, timeout, name=None, args=(), kwargs=None):
+    """Run ``fn`` in a worker thread and raise :class:`TimeoutError`
+    after ``timeout`` seconds.  The worker is a daemon: a genuinely hung
+    call (e.g. a collective against a dead peer) stays parked without
+    blocking teardown.  ``timeout`` None/<=0 calls ``fn`` inline."""
+    if timeout is None or timeout <= 0:
+        return fn(*args, **(kwargs or {}))
+    what = name or getattr(fn, "__name__", repr(fn))
+    result = []
+    error = []
+
+    def runner():
+        try:
+            result.append(fn(*args, **(kwargs or {})))
+        except BaseException as e:      # surfaced on the caller
+            error.append(e)
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name="timeout:%s" % what)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise TimeoutError("%s did not complete within %.1fs"
+                           % (what, timeout))
+    if error:
+        raise error[0]
+    return result[0]
+
+
+# --------------------------------------------------- atomic files + manifests
+
+def atomic_write(path, write_fn, fault_site=None):
+    """Crash-safe file write: ``write_fn(tmp_path)`` writes a sibling
+    temp file, which is fsynced and atomically renamed over ``path`` —
+    a reader never observes a partial file.  ``fault_site`` (e.g.
+    ``"checkpoint.save"``) is evaluated BETWEEN the tmp write and the
+    rename: the window a real crash leaves a stray tmp in.  An injected
+    fault leaves the tmp behind (exactly the crash residue); any other
+    error cleans it up and propagates."""
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        write_fn(tmp)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    if fault_site is not None:
+        fault_point(fault_site)
+    os.replace(tmp, path)
+
+
+def array_crc32(arr):
+    """CRC32 of an array's raw bytes (C-contiguous copy if needed)."""
+    import numpy as np
+    a = np.ascontiguousarray(arr)
+    return zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+
+
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def manifest_path(prefix, epoch):
+    """Sidecar manifest path for a ``prefix-%04d.*`` checkpoint."""
+    return "%s-%04d.manifest.json" % (prefix, epoch)
+
+
+def write_manifest(prefix, epoch, files, arrays=None, meta=None):
+    """Write the checkpoint manifest (atomically — it is the commit
+    record: a checkpoint without a verifiable manifest is incomplete).
+
+    ``files``: paths covered by the checkpoint; each is recorded with
+    its size and whole-file CRC32.  ``arrays``: {name: array} whose
+    per-array CRC32/shape/dtype are recorded so a loader can verify
+    individual tensors.  Returns the manifest path."""
+    entry_files = {}
+    for p in files:
+        entry_files[os.path.basename(p)] = {
+            "size": os.path.getsize(p),
+            "crc32": _file_crc32(p),
+        }
+    entry_arrays = {}
+    for name, arr in (arrays or {}).items():
+        import numpy as np
+        a = np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy") else arr)
+        entry_arrays[name] = {
+            "crc32": array_crc32(a),
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+        }
+    doc = {
+        "format": 1,
+        "epoch": int(epoch),
+        "files": entry_files,
+        "arrays": entry_arrays,
+        "meta": dict(meta or {}),
+    }
+    path = manifest_path(prefix, epoch)
+    atomic_write(path, lambda tmp: _dump_json(tmp, doc))
+    return path
+
+
+def _dump_json(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+
+
+def load_manifest(prefix, epoch):
+    """Parse the manifest, or None when absent (pre-manifest
+    checkpoints stay loadable).  A corrupt manifest raises
+    :class:`~mxnet_tpu.base.MXNetError` naming the path."""
+    path = manifest_path(prefix, epoch)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (ValueError, OSError) as e:
+        raise MXNetError("corrupt checkpoint manifest %r: %s"
+                         % (path, e)) from e
+
+
+def verify_manifest(prefix, epoch, quick=False):
+    """Verify every file the manifest covers.  Returns the manifest
+    dict (None when no manifest exists — legacy checkpoint, nothing to
+    verify).  Mismatch raises :class:`~mxnet_tpu.base.MXNetError`
+    naming the offending file.
+
+    ``quick=True`` checks existence + size only — the screening mode
+    for checkpoint discovery over many epochs (a full CRC pass reads
+    every retained byte); loaders then CRC-verify just the epoch they
+    actually open."""
+    doc = load_manifest(prefix, epoch)
+    if doc is None:
+        return None
+    base = os.path.dirname(prefix)
+    for fname, rec in doc.get("files", {}).items():
+        path = os.path.join(base, fname) if base else fname
+        if not os.path.exists(path):
+            raise MXNetError(
+                "checkpoint %s epoch %d is incomplete: %r listed in "
+                "manifest but missing on disk" % (prefix, epoch, path))
+        size = os.path.getsize(path)
+        if size != rec["size"]:
+            raise MXNetError(
+                "checkpoint file %r is truncated/corrupt: size %d != "
+                "manifest size %d" % (path, size, rec["size"]))
+        if quick:
+            continue
+        crc = _file_crc32(path)
+        if crc != rec["crc32"]:
+            raise MXNetError(
+                "checkpoint file %r failed CRC32 verification "
+                "(0x%08x != manifest 0x%08x)" % (path, crc, rec["crc32"]))
+    return doc

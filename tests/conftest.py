@@ -21,3 +21,6 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end test")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection chaos test (tools/chaos_run.py harness)")

@@ -65,10 +65,17 @@ def _latest_epoch(prefix):
 
 
 def main():
-    mode = os.environ["RECOVERY_MODE"]          # crash | resume
+    # crash: SIGKILL one rank mid-run (launcher must tear the job down)
+    # resume: load the last complete checkpoint, finish training
+    # auto: the watchdog-restart path — resume from the latest verified
+    #       checkpoint if one exists, and crash only on the FIRST
+    #       launch attempt (MXNET_TPU_RESTART_COUNT=0); the restarted
+    #       job trains to completion
+    mode = os.environ["RECOVERY_MODE"]          # crash | resume | auto
     prefix = os.environ["RECOVERY_CKPT"]
     kill_rank = int(os.environ.get("KILL_RANK", "1"))
     kill_step = int(os.environ.get("KILL_STEP", "7"))
+    restart_count = int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
 
     multihost.ensure_initialized()
     import jax
@@ -84,6 +91,13 @@ def main():
         assert ep is not None, "no complete checkpoint to resume from"
         trainer.load_checkpoint(prefix, ep, load_optimizer_states=True)
         start = ep
+    elif mode == "auto":
+        ep = trainer.load_latest_checkpoint(prefix,
+                                            load_optimizer_states=True)
+        if ep is not None:
+            start = ep
+
+    may_kill = mode == "crash" or (mode == "auto" and restart_count == 0)
 
     def shard(a):
         per = GBATCH // nproc
@@ -99,7 +113,7 @@ def main():
         if done % CKPT_EVERY == 0 and done < STEPS:
             trainer.save_checkpoint(prefix, done,
                                     save_optimizer_states=True)
-        if mode == "crash" and rank == kill_rank and done == kill_step:
+        if may_kill and rank == kill_rank and done == kill_step:
             sys.stderr.write("worker %d: simulating node failure "
                              "(SIGKILL self) at step %d\n" % (rank, done))
             sys.stderr.flush()

@@ -28,21 +28,25 @@ def dmlc_opts(opts):
     return env
 
 
-def launch_local(opts, command):
-    """Fork N workers on this host (reference dmlc_tracker local mode —
-    multi-node semantics without a cluster, SURVEY §4.6).
+def _run_workers_once(opts, command, attempt):
+    """Fork N workers and watchdog them until the job ends.
 
-    Supervises the job the way the reference tracker does: if any
-    worker dies (crash, OOM kill, nonzero exit), the remaining workers
-    are torn down after a short grace period and the job exits nonzero
-    with a clear message — a synchronous peer would otherwise block in
-    a collective against the dead rank.  Recovery is checkpoint/resume
-    (docs/how_to/multi_device.md)."""
+    The watchdog polls worker liveness every ``--heartbeat-interval``
+    seconds: a dead rank (crash, OOM kill, nonzero exit) is detected
+    within one interval, the remaining workers are torn down after a
+    short grace period (SIGTERM, then SIGKILL — a synchronous peer
+    would otherwise block forever in a collective against the dead
+    rank), and the attempt exits nonzero with a clear message.
+    ``MXNET_TPU_RESTART_COUNT`` tells workers which restart attempt
+    they are (0 = first launch) so resume-aware scripts reload their
+    latest checkpoint."""
     import signal
     import time
 
+    hb = max(0.05, float(opts.heartbeat_interval))
     procs = []
     base_env = dmlc_opts(opts)
+    base_env["MXNET_TPU_RESTART_COUNT"] = str(attempt)
     for rank in range(opts.num_workers):
         env = dict(base_env)
         env["MXNET_TPU_PROCESS_ID"] = str(rank)
@@ -85,8 +89,43 @@ def launch_local(opts, command):
             elif rc != 0:
                 code = code or rc
         if live:
-            time.sleep(0.2)
+            time.sleep(hb)
     return code
+
+
+def launch_local(opts, command):
+    """Fork N workers on this host (reference dmlc_tracker local mode —
+    multi-node semantics without a cluster, SURVEY §4.6), under a
+    watchdog with an optional restart budget.
+
+    ``--restart-budget K`` (or MXNET_TPU_RESTART_BUDGET) relaunches the
+    whole job up to K times after a failed attempt — the preemption
+    story: workers that resume from their latest complete checkpoint
+    (see ShardedTrainer.load_latest_checkpoint and
+    MXNET_TPU_RESTART_COUNT) continue training where the dead attempt
+    left off.  Budget 0 (default) keeps the previous fail-fast
+    behavior."""
+    attempt = 0
+    while True:
+        code = _run_workers_once(opts, command, attempt)
+        if code == 0:
+            if attempt:
+                sys.stderr.write(
+                    "launch.py: job recovered after %d restart(s)\n"
+                    % attempt)
+            return 0
+        if attempt >= opts.restart_budget:
+            if opts.restart_budget:
+                sys.stderr.write(
+                    "launch.py: restart budget (%d) exhausted; giving "
+                    "up with exit code %d\n" % (opts.restart_budget,
+                                                code))
+            return code
+        attempt += 1
+        sys.stderr.write(
+            "launch.py: restarting job (attempt %d/%d) from the last "
+            "complete checkpoint\n" % (attempt, opts.restart_budget))
+        sys.stderr.flush()
 
 
 def launch_ssh(opts, command):
@@ -138,6 +177,17 @@ def main():
     parser.add_argument("--coordinator", type=str,
                         default="127.0.0.1:8431",
                         help="jax.distributed coordinator address")
+    parser.add_argument("--restart-budget", type=int,
+                        default=int(os.environ.get(
+                            "MXNET_TPU_RESTART_BUDGET", "0")),
+                        help="relaunch a failed job up to this many times "
+                             "(workers resume from their latest complete "
+                             "checkpoint; local launcher only)")
+    parser.add_argument("--heartbeat-interval", type=float,
+                        default=float(os.environ.get(
+                            "MXNET_TPU_HEARTBEAT_INTERVAL", "0.2")),
+                        help="watchdog poll interval in seconds — a dead "
+                             "rank is detected within one interval")
     parser.add_argument("command", nargs="+", help="command to launch")
     opts = parser.parse_args()
     command = " ".join(opts.command)
