@@ -116,7 +116,7 @@ def _use_fused(args):
     try:
         import jax
         return jax.devices()[0].platform == "tpu"
-    except Exception:
+    except (ImportError, RuntimeError, IndexError):
         return False
 
 

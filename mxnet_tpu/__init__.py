@@ -21,7 +21,7 @@ if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     import jax as _jax
     try:
         _jax.config.update("jax_platforms", "cpu")
-    except Exception as _e:
+    except Exception as _e:  # mxlint: allow-broad-except(site plugins fail the pin in arbitrary ways; a warning beats failing every import)
         import logging as _logging
         _logging.getLogger(__name__).warning(
             "JAX_PLATFORMS=cpu requested but the pin failed (%s); a "
@@ -75,6 +75,7 @@ from . import resilience
 from . import visualization
 from . import visualization as viz
 from . import test_utils
+from . import analysis
 from . import contrib
 from . import config
 from . import predictor

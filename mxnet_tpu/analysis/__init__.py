@@ -1,0 +1,65 @@
+"""mxnet_tpu.analysis — static verification before any device time.
+
+Two engines (ISSUE 2; see docs/api/analysis.md for the full catalog):
+
+* the **graph verifier** (:mod:`.verifier`): per-node abstract
+  interpretation of a Symbol DAG — shape/dtype consistency against the
+  op registry's fcompute contracts, missing param-shape rules, dead
+  nodes/unused inputs, duplicate names, cycles, and tensor-parallel
+  sharding coverage against ``parallel.tp_rules``.  Exposed as
+  ``Symbol.verify()``, ``bind(..., strict=True)`` and the
+  ``python -m mxnet_tpu.analysis`` CLI.
+* the **TPU-hazard source linter** (``tools/mxlint.py``, stdlib-only so
+  it runs without jax installed): broad excepts, host syncs inside
+  jitted code, jit recompile hazards, captured-state mutation under
+  ``@jit``, missing ``donate_argnums`` on train steps.  Re-exported
+  here via :func:`load_mxlint` for tests and ``tools/ci_check.py``.
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError
+from .verifier import (Diagnostic, Report, verify_symbol, verify_json,
+                       verify_model)
+
+__all__ = ["Diagnostic", "Report", "verify_symbol", "verify_json",
+           "verify_model", "load_mxlint", "registry_selfcheck"]
+
+
+def registry_selfcheck():
+    """Run the op-registry self-check; returns a list of problem strings
+    (see :func:`mxnet_tpu.ops.registry.selfcheck`)."""
+    from ..ops import registry as _registry
+    return _registry.selfcheck()
+
+
+_MXLINT_CACHE = None
+
+
+def load_mxlint():
+    """Import the standalone linter in ``tools/mxlint.py``.
+
+    The linter is deliberately NOT a package submodule: it must run with
+    zero third-party deps (no jax), and importing anything under
+    ``mxnet_tpu`` executes the package __init__ which pulls in jax.
+    Loading it by file path keeps one implementation serving the CLI
+    and the tests.  (tools/ci_check.py carries its own copy of this
+    loader on purpose — its lint stage must work even when the jax
+    import is broken, so it cannot go through this package.)
+    """
+    global _MXLINT_CACHE
+    if _MXLINT_CACHE is not None:
+        return _MXLINT_CACHE
+    import importlib.util
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(repo_root, "tools", "mxlint.py")
+    if not os.path.exists(path):
+        raise MXNetError("tools/mxlint.py not found at %r (linting "
+                         "requires a source checkout)" % path)
+    spec = importlib.util.spec_from_file_location("mxlint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _MXLINT_CACHE = mod
+    return mod

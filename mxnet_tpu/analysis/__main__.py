@@ -1,0 +1,120 @@
+"""CLI for the static analysis subsystem.
+
+Usage::
+
+    # verify serialized symbols (the reference -symbol.json layout)
+    python -m mxnet_tpu.analysis graph.json [--data 32,3,224,224] [--tp 8]
+
+    # verify model-zoo entries with their canonical input shapes
+    python -m mxnet_tpu.analysis --model resnet50 --model mlp [--tp 8]
+    python -m mxnet_tpu.analysis --model all
+
+    # run the TPU-hazard source linter (tools/mxlint.py rules)
+    python -m mxnet_tpu.analysis --lint mxnet_tpu/ tools/ examples/
+
+    # registry self-check only
+    python -m mxnet_tpu.analysis --registry
+
+Exit status 1 when any error-severity diagnostic (or lint finding) is
+reported; warnings alone exit 0 unless ``--strict-warnings``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _parse_shape(s):
+    return tuple(int(x) for x in s.replace("(", "").replace(")", "")
+                 .split(",") if x.strip())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="static graph verifier + TPU-hazard linter")
+    ap.add_argument("json", nargs="*",
+                    help="serialized symbol JSON files to verify")
+    ap.add_argument("--model", action="append", default=[],
+                    help="model-zoo entry to verify ('all' for every "
+                         "model); repeatable")
+    ap.add_argument("--data", default=None,
+                    help="data shape for JSON graphs, e.g. 32,3,224,224")
+    ap.add_argument("--label", default=None,
+                    help="label shape for JSON graphs (default: batch)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="verify tensor-parallel sharding coverage for "
+                         "this model-axis size")
+    ap.add_argument("--batch", type=int, default=2,
+                    help="batch size for --model verification")
+    ap.add_argument("--registry", action="store_true",
+                    help="run the op-registry self-check")
+    ap.add_argument("--lint", nargs="*", metavar="PATH", default=None,
+                    help="run the mxlint source linter over PATHs "
+                         "(default: mxnet_tpu/ tools/ examples/)")
+    ap.add_argument("--strict-warnings", action="store_true",
+                    help="exit 1 on warnings too")
+    args = ap.parse_args(argv)
+
+    if not (args.json or args.model or args.registry
+            or args.lint is not None):
+        ap.error("nothing to do: give JSON files, --model, --registry "
+                 "or --lint")
+
+    from . import (Report, load_mxlint, registry_selfcheck, verify_json,
+                   verify_model)
+
+    failed = warned = False
+
+    if args.registry:
+        problems = registry_selfcheck()
+        for p in problems:
+            print("MXG008 [error] <registry>: %s" % p)
+        print("registry selfcheck: %d problem(s)" % len(problems))
+        failed = failed or bool(problems)
+
+    models = args.model
+    if "all" in models:
+        from .. import models as _zoo
+        models = list(_zoo._MODELS)
+    for name in models:
+        _net, report = verify_model(name, batch=args.batch,
+                                    tp_size=args.tp)
+        print("model %-20s %s" % (name, report))
+        failed = failed or not report.ok
+        warned = warned or bool(report.warnings)
+
+    for path in args.json:
+        with open(path) as f:
+            js = f.read()
+        shapes = {}
+        if args.data:
+            shapes["data"] = _parse_shape(args.data)
+            shapes["softmax_label"] = (_parse_shape(args.label)
+                                       if args.label
+                                       else (shapes["data"][0],))
+        report = verify_json(js, shapes=shapes or None, tp_size=args.tp)
+        print("%s: %s" % (path, report))
+        failed = failed or not report.ok
+        warned = warned or bool(report.warnings)
+
+    if args.lint is not None:
+        mxlint = load_mxlint()
+        paths = args.lint
+        if not paths:
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            paths = [os.path.join(root, d)
+                     for d in mxlint.DEFAULT_LINT_DIRS]
+        findings = mxlint.lint_paths(paths)
+        for f in findings:
+            print(f)
+        print("mxlint: %d finding(s)" % len(findings))
+        failed = failed or bool(findings)
+
+    return 1 if (failed or (warned and args.strict_warnings)) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,11 @@ _CATALOG = {
                                 "set by tools/launch.py on each restart "
                                 "attempt; resume-aware scripts reload "
                                 "their latest checkpoint when > 0"),
+    "MXNET_TPU_STRICT_BIND": ("0", "honored",
+                              "run the mxnet_tpu.analysis graph verifier "
+                              "on every bind (Executor and Module) and "
+                              "fail with node-level diagnostics before "
+                              "any XLA compile"),
 }
 
 

@@ -49,7 +49,8 @@ def _normalize(values, names, kind, default_ctor=None):
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, group2ctx=None, shared_exec=None):
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 strict=False):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else current_context()
         self._group2ctx = group2ctx or {}
@@ -98,6 +99,24 @@ class Executor:
                 src = self.arg_dict[n]
                 self.grad_dict[n] = _nd_zeros(src.shape, ctx=self._ctx,
                                               dtype=src.dtype)
+
+        # strict bind: run the static graph verifier over the EXACT
+        # shapes/dtypes being bound, before any jit compile is attempted
+        # (the bind-time equivalent of the reference's InferShape pass,
+        # with node-level diagnostics instead of a mid-bind throw).
+        # MXNET_TPU_STRICT_BIND=1 turns it on globally.
+        from . import config as _config
+        if strict or _config.get_bool("MXNET_TPU_STRICT_BIND"):
+            from .analysis import verify_symbol
+            shapes = {n: tuple(self.arg_dict[n].shape)
+                      for n in self._arg_names}
+            shapes.update({n: tuple(self.aux_dict[n].shape)
+                           for n in self._aux_names})
+            types = {n: self.arg_dict[n].dtype for n in self._arg_names}
+            types.update({n: self.aux_dict[n].dtype
+                          for n in self._aux_names})
+            verify_symbol(symbol, shapes=shapes,
+                          types=types).raise_if_errors("bind strict=True")
 
         self._outputs = None
         self._last_key = None

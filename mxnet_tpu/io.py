@@ -199,7 +199,7 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
-                except BaseException as e:
+                except BaseException as e:  # mxlint: allow-broad-except(stored and re-raised on the consumer thread, not swallowed)
                     self.next_batch[i] = None
                     self.prefetch_errors[i] = e
                 self.data_taken[i].clear()
@@ -328,7 +328,7 @@ def tunnel_limited_backend():
         import jax
         dev = jax.devices()[0]
         return "axon" in getattr(dev.client, "platform_version", "")
-    except Exception:
+    except (ImportError, RuntimeError, IndexError, AttributeError):
         return False
 
 
@@ -410,7 +410,7 @@ class DevicePrefetchIter:
                     staged = self._stage(self._to_host_dict(batch))
                     if not self._put(("item", staged)):
                         return
-            except BaseException as e:          # surfaced on the consumer
+            except BaseException as e:  # mxlint: allow-broad-except(surfaced on the consumer via the error queue item)
                 self._put(("error", e))
                 return
             self._put(("end", None))

@@ -31,7 +31,7 @@ def _load():
         try:
             subprocess.run(["make", "-C", _SRC_DIR], check=True,
                            capture_output=True)
-        except Exception:
+        except (OSError, subprocess.CalledProcessError):
             return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
@@ -165,7 +165,7 @@ class NativeRecordIOReader:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(__del__ at interpreter teardown must never raise)
             pass
 
 
@@ -290,5 +290,5 @@ class ImageRecordIter:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(__del__ at interpreter teardown must never raise)
             pass

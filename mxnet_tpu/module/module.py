@@ -225,8 +225,14 @@ class Module(BaseModule):
     # ---------------------------------------------------------------- bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        """Bind executors (reference module.py:228-323)."""
+             grad_req="write", strict=False):
+        """Bind executors (reference module.py:228-323).
+
+        ``strict=True`` first runs the static graph verifier
+        (:mod:`mxnet_tpu.analysis`) over the declared data/label shapes
+        and raises with node-level diagnostics before any executor is
+        built or compiled.  (MXNET_TPU_STRICT_BIND=1 verifies at the
+        Executor layer instead, with the full bound shapes.)"""
         if force_rebind:
             self._reset_bind()
 
@@ -243,6 +249,17 @@ class Module(BaseModule):
 
         self._data_shapes, self._label_shapes = _parse_data_desc(
             self.data_names, self.label_names, data_shapes, label_shapes)
+
+        # explicit strict only: MXNET_TPU_STRICT_BIND is handled once at
+        # the Executor layer (with the full bound shapes, which subsume
+        # this data/label-shape pass) — checking the env var here too
+        # would run the whole abstract-interpretation pass twice per bind
+        if strict:
+            shapes = {d.name: tuple(d.shape) for d in self._data_shapes}
+            for d in (self._label_shapes or []):
+                shapes[d.name] = tuple(d.shape)
+            self._symbol.verify(shapes=shapes).raise_if_errors(
+                "Module.bind strict=True")
 
         if shared_module is not None:
             assert isinstance(shared_module, Module) and \

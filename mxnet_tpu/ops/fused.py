@@ -75,7 +75,7 @@ def _pick_bm(m):
 def _on_tpu():
     try:
         return jax.devices()[0].platform == "tpu"
-    except Exception:  # pragma: no cover
+    except (RuntimeError, IndexError):  # pragma: no cover
         return False
 
 

@@ -442,7 +442,7 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 def _on_tpu():
     try:
         return jax.default_backend() == "tpu"
-    except Exception:
+    except RuntimeError:
         return False
 
 

@@ -131,7 +131,16 @@ def parse_attrs(param_spec, raw, op_name="<op>"):
 def register(name, arg_names=("data",), aux_names=(), num_outputs=1,
              params=None, stochastic=False, key_var_num_args=None,
              is_loss=False, mutate=(), aliases=(), doc=""):
-    """Decorator: register ``fcompute`` under ``name`` (+aliases)."""
+    """Decorator: register ``fcompute`` under ``name`` (+aliases).
+
+    Duplicate registration is rejected outright — for the op name AND
+    for every alias, in both directions (an alias may not shadow an op,
+    an op may not take a name an alias already claimed).  The reference's
+    C++ registries let a second ``NNVM_REGISTER_OP`` silently extend the
+    first; one python table means a collision is always a bug (two
+    fcomputes fighting over one dispatch slot), so it fails loudly at
+    import time instead of last-write-wins at call time.
+    """
     def deco(fn):
         op = Operator(name=name, fcompute=fn, arg_names=arg_names,
                       aux_names=aux_names, num_outputs=num_outputs,
@@ -139,7 +148,29 @@ def register(name, arg_names=("data",), aux_names=(), num_outputs=1,
                       key_var_num_args=key_var_num_args, is_loss=is_loss,
                       mutate=tuple(mutate), doc=doc or fn.__doc__ or "")
         if name in _OP_REGISTRY:
-            raise MXNetError(f"op {name} registered twice")
+            prev = _OP_REGISTRY[name].fcompute
+            raise MXNetError(
+                "duplicate op registration: %r is already registered "
+                "(existing fcompute %s.%s, new %s.%s); rename one or "
+                "extend the existing registration"
+                % (name, getattr(prev, "__module__", "?"),
+                   getattr(prev, "__qualname__", "?"),
+                   getattr(fn, "__module__", "?"),
+                   getattr(fn, "__qualname__", "?")))
+        if name in _ALIASES:
+            raise MXNetError(
+                "duplicate op registration: %r is already an alias of "
+                "op %r; it cannot also name a new op"
+                % (name, _ALIASES[name]))
+        for a in aliases:
+            if a in _OP_REGISTRY:
+                raise MXNetError(
+                    "duplicate op registration: alias %r of op %r "
+                    "collides with the registered op %r" % (a, name, a))
+            if a in _ALIASES and _ALIASES[a] != name:
+                raise MXNetError(
+                    "duplicate op registration: alias %r of op %r is "
+                    "already an alias of op %r" % (a, name, _ALIASES[a]))
         _OP_REGISTRY[name] = op
         for a in aliases:
             _ALIASES[a] = name
@@ -161,6 +192,76 @@ def has_op(name):
 
 def list_ops():
     return sorted(_OP_REGISTRY)
+
+
+def selfcheck():
+    """Registry consistency audit; returns a list of problem strings.
+
+    Catches the contract drift the runtime never sees (reused by the
+    graph verifier via ``check_registry=True`` and by tools/ci_check.py):
+
+    * aliases pointing at ops that no longer exist;
+    * param-shape hooks (:mod:`.shapes`) registered for unknown ops —
+      a renamed op silently orphans its shape rule;
+    * tensor-parallel pass-through ops (``parallel.tp_rules._PASS_OPS``)
+      naming unknown ops — a renamed op silently changes which FC pairs
+      go row-parallel;
+    * malformed per-op metadata (duplicate/typed arg names, bad
+      num_outputs, mutate/key_var_num_args targets that are not args).
+    """
+    problems = []
+    for alias, target in sorted(_ALIASES.items()):
+        if target not in _OP_REGISTRY:
+            problems.append("alias %r points at unknown op %r"
+                            % (alias, target))
+    for name in sorted(_OP_REGISTRY):
+        op = _OP_REGISTRY[name]
+        if not callable(op.fcompute):
+            problems.append("op %r: fcompute is not callable" % name)
+        for label, val in (("arg_names", op.arg_names),
+                           ("aux_names", op.aux_names)):
+            if callable(val):
+                continue
+            names = list(val)
+            if any(not isinstance(n, str) for n in names):
+                problems.append("op %r: %s contains non-strings: %r"
+                                % (name, label, names))
+            elif len(set(names)) != len(names):
+                problems.append("op %r: %s has duplicates: %r"
+                                % (name, label, names))
+        if not callable(op.num_outputs) and (
+                not isinstance(op.num_outputs, int) or op.num_outputs < 1):
+            problems.append("op %r: num_outputs must be a positive int "
+                            "or callable, got %r" % (name, op.num_outputs))
+        if not callable(op.arg_names):
+            argset = set(op.arg_names)
+            for m in op.mutate:
+                if m not in argset:
+                    problems.append("op %r: mutate target %r is not an "
+                                    "argument" % (name, m))
+            if op.key_var_num_args and op.key_var_num_args not in op.params:
+                problems.append("op %r: key_var_num_args %r is not a "
+                                "declared param" % (name,
+                                                    op.key_var_num_args))
+    # cross-module drift: shape hooks and TP pass-ops must name real ops
+    from . import shapes as _shapes
+    for hook_op in sorted(_shapes._PARAM_SHAPE_HOOKS):
+        if not has_op(hook_op):
+            problems.append("param-shape rule registered for unknown op "
+                            "%r (ops/shapes.py drifted from the "
+                            "registry)" % hook_op)
+    try:
+        from ..parallel import tp_rules as _tp
+    except ImportError:  # parallel stack is optional at import time
+        _tp = None
+    if _tp is not None:
+        for pass_op in sorted(_tp._PASS_OPS):
+            if not has_op(pass_op):
+                problems.append(
+                    "tensor-parallel pass-through op %r is not in the "
+                    "registry (parallel/tp_rules.py drifted from the "
+                    "registry)" % pass_op)
+    return problems
 
 
 def apply_op(op: Operator, attrs, op_ctx: OpContext, *inputs):

@@ -285,6 +285,21 @@ def expand_dims(attrs, ctx, data):
     return jnp.expand_dims(data, int(attrs["axis"]))
 
 
+@register("squeeze", params={"axis": None})
+def squeeze(attrs, ctx, data):
+    """Drop size-1 dims (``axis=None`` drops all; int or tuple selects).
+    Inverse of expand_dims; tp_rules treats it as activation-sharding
+    pass-through, which the registry selfcheck cross-checks."""
+    axis = attrs["axis"]
+    if axis is None:
+        return jnp.squeeze(data)
+    if isinstance(axis, (tuple, list)):
+        axis = tuple(int(a) for a in axis)
+    else:
+        axis = int(axis)
+    return jnp.squeeze(data, axis)
+
+
 @register("Reshape", params={"shape": (), "reverse": False,
                              "target_shape": (), "keep_highest": False},
           aliases=("reshape",))

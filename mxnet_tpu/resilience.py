@@ -332,7 +332,7 @@ def with_timeout(fn, timeout, name=None, args=(), kwargs=None):
     def runner():
         try:
             result.append(fn(*args, **(kwargs or {})))
-        except BaseException as e:      # surfaced on the caller
+        except BaseException as e:  # mxlint: allow-broad-except(stored and re-raised on the caller after join)
             error.append(e)
 
     t = threading.Thread(target=runner, daemon=True,
@@ -365,7 +365,7 @@ def atomic_write(path, write_fn, fault_site=None):
             os.fsync(fd)
         finally:
             os.close(fd)
-    except BaseException:
+    except BaseException:  # mxlint: allow-broad-except(cleanup-and-reraise; the bare raise below propagates everything)
         try:
             os.remove(tmp)
         except OSError:

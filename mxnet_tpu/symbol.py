@@ -550,20 +550,46 @@ class Symbol:
                 return heads
             outs = jax.eval_shape(fn, var_vals)
             out_types = [np.dtype(o.dtype) for o in outs]
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(dtype trace is best-effort over arbitrary fcomputes; fall back to float32)
             out_types = [np.dtype("float32")] * len(self._entries)
         return arg_types, out_types, aux_types
 
+    # -------------------------------------------------------- verification
+    def verify(self, shapes=None, types=None, tp_size=1,
+               check_registry=False, **shape_kwargs):
+        """Statically verify the graph BEFORE any compile/device time.
+
+        Runs the :mod:`mxnet_tpu.analysis` graph verifier: per-node
+        shape/dtype consistency against the op registry, missing
+        param-shape rules, dead inputs, duplicate names, cycles, and
+        (``tp_size`` > 1) tensor-parallel sharding coverage.  Input
+        shapes go in like ``infer_shape``'s kwargs::
+
+            report = net.verify(data=(32, 3, 224, 224))
+            if not report.ok:
+                print(report)          # node-level diagnostics
+            report.raise_if_errors()   # or fail hard
+
+        Returns an :class:`mxnet_tpu.analysis.Report`.
+        """
+        from .analysis import verify_symbol
+        known = dict(shapes or {})
+        known.update(shape_kwargs)
+        return verify_symbol(self, shapes=known, types=types,
+                             tp_size=tp_size, check_registry=check_registry)
+
     # ------------------------------------------------------------- binding
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, shared_exec=None):
+             aux_states=None, group2ctx=None, shared_exec=None,
+             strict=False):
         from .executor import Executor
         return Executor(self, ctx or current_context(), args, args_grad,
                         grad_req, aux_states, group2ctx=group2ctx,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, strict=strict)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    group2ctx=None, shared_exec=None, **kwargs):
+                    group2ctx=None, shared_exec=None, strict=False,
+                    **kwargs):
         """Infer shapes from kwargs, allocate arrays, bind.
 
         Reference: python/mxnet/symbol.py:1163 (python-side allocation then
@@ -589,7 +615,8 @@ class Symbol:
             if reqs.get(n, "null") != "null":
                 args_grad[n] = nd.zeros(s, ctx=ctx, dtype=t)
         return self.bind(ctx, args, args_grad, reqs, aux_states,
-                         group2ctx=group2ctx, shared_exec=shared_exec)
+                         group2ctx=group2ctx, shared_exec=shared_exec,
+                         strict=strict)
 
     # -------------------------------------------------------------- ser/de
     def tojson(self):
@@ -694,7 +721,7 @@ def _resolve_input_shapes(node, var_shapes, var_dtypes, topo, seed,
         try:
             st = jax.eval_shape(fn, var_vals)
             out[nm] = tuple(st.shape)
-        except Exception:
+        except Exception:  # mxlint: allow-broad-except(sub-graph shape resolution is best-effort; Symbol.verify localizes the real error)
             pass
     return out
 

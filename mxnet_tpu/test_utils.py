@@ -132,7 +132,7 @@ def _dtype_rank(dt):
     try:  # bfloat16 and friends are extension dtypes with finfo
         import ml_dtypes  # noqa: F401
         return np.finfo(dt).nmant
-    except Exception:
+    except (ImportError, ValueError):
         return 0
 
 

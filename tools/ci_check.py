@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""ci_check — the repo's static-analysis gate, runnable standalone or
+from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
+tier-1).
+
+Three stages, all of which must be clean:
+
+1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
+   the TPU-hazard rules MXL001-005; pragmas with reasons are the only
+   accepted suppressions.
+2. **op-registry self-check** — alias/hook/TP-rule drift
+   (:func:`mxnet_tpu.ops.registry.selfcheck`).
+3. **graph verifier** over every model-zoo entry with its canonical
+   input shape — zero diagnostics expected (warnings included: the zoo
+   is the reference corpus, it must be spotless).
+
+Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
+finding.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+LINT_DIRS = ("mxnet_tpu", "tools", "examples")
+
+
+def run(repo_root=_ROOT, out=None):
+    """Run all stages; returns a list of failure strings (empty = clean).
+
+    ``out``: optional callable for progress lines (default: print).
+    """
+    say = out or (lambda s: print(s))
+    failures = []
+
+    # stage 1: source lint (no jax needed; keep it first so a broken
+    # interpreter environment still reports style hazards)
+    sys.path.insert(0, repo_root)
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "mxlint", os.path.join(repo_root, "tools", "mxlint.py"))
+        mxlint = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mxlint)
+        paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
+        findings = mxlint.lint_paths(paths)
+        say("ci_check[1/3] mxlint: %d finding(s) over %s"
+            % (len(findings), "/".join(LINT_DIRS)))
+        for f in findings:
+            failures.append("mxlint: %s" % f)
+            say("  " + str(f))
+
+        # stage 2: registry self-check
+        from mxnet_tpu.ops import registry
+        problems = registry.selfcheck()
+        say("ci_check[2/3] registry selfcheck: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("registry: %s" % p)
+            say("  " + p)
+
+        # stage 3: verify the model zoo (warnings count — the zoo is
+        # the reference corpus and must produce zero diagnostics)
+        from mxnet_tpu.analysis import verify_model
+        from mxnet_tpu.models import _MODELS
+        for name in _MODELS:
+            _net, report = verify_model(name)
+            status = "OK" if not len(report) else "%d finding(s)" \
+                % len(report)
+            say("ci_check[3/3] verify model %-22s %s" % (name, status))
+            for d in report:
+                failures.append("model %s: %s" % (name, d))
+                say("  " + str(d))
+    finally:
+        sys.path.remove(repo_root)
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ci_check")
+    ap.add_argument("--repo-root", default=_ROOT)
+    args = ap.parse_args(argv)
+    failures = run(os.path.abspath(args.repo_root))
+    if failures:
+        print("ci_check: FAILED (%d finding(s))" % len(failures))
+        return 1
+    print("ci_check: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -1,0 +1,696 @@
+#!/usr/bin/env python
+"""mxlint — AST-based TPU-hazard linter (stdlib only, no jax required).
+
+The graph verifier (mxnet_tpu.analysis.verifier) catches defects *in the
+graph*; this linter catches the hazards that live *in the source* — the
+patterns that cost silent TPU time (host round-trips, recompiles) or
+swallow real failures, which no runtime check ever sees.
+
+Rule catalog (IDs are stable; docs/api/analysis.md is the reference):
+
+=======  ============================================================
+MXL001   broad exception handler: bare ``except:``, ``except
+         Exception`` or ``except BaseException`` (also inside a
+         tuple).  Narrow to concrete types, or annotate the except
+         line with ``# mxlint: allow-broad-except(<reason>)``.
+MXL002   host sync inside a jitted function: ``float()/int()`` of a
+         traced value, ``np.asarray``/``np.array`` on a traced value,
+         or ``.item()``/``.tolist()`` anywhere in a jit body.  Each
+         forces a device->host transfer (or a tracer error) inside
+         the compiled region.
+MXL003   jit recompile hazard: a non-static traced argument used
+         where Python concreteness is required — as a shape (e.g.
+         ``jnp.zeros(n)``, ``x.reshape(n, -1)``) or as a ``range()``
+         bound.  Mark it static (``static_argnums``/
+         ``static_argnames``) or derive it from ``x.shape``.
+MXL004   mutation of captured state inside a jit body: assigning or
+         calling mutating methods (append/update/...) on a name
+         captured from an enclosing scope.  Tracing runs ONCE — the
+         mutation happens at trace time, not per step.
+MXL005   train-step wrapper jitted without buffer donation: a
+         function whose name looks like a train step (``step``,
+         ``train_step``, ``*_step``) passed to ``jax.jit`` without
+         ``donate_argnums``/``donate_argnames`` — parameters and
+         optimizer state are then double-buffered in HBM.
+=======  ============================================================
+
+Pragmas: ``# mxlint: allow-broad-except(reason)`` (and the analogous
+``allow-host-sync`` / ``allow-recompile-hazard`` /
+``allow-capture-mutation`` / ``allow-missing-donate``) or the generic
+``# mxlint: disable=MXL002(reason)``, placed on the offending line or
+the line above it.  A non-empty reason is required — a bare pragma is
+itself reported (MXL000).
+
+Usage: ``python tools/mxlint.py [paths...]`` (default: mxnet_tpu/
+tools/ examples/ relative to the repo root); exits 1 on findings.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+__all__ = ["Finding", "lint_source", "lint_file", "lint_paths",
+           "iter_py_files", "RULES", "DEFAULT_LINT_DIRS"]
+
+RULES = {
+    "MXL000": "malformed mxlint pragma (empty reason or unknown name)",
+    "MXL001": "broad exception handler",
+    "MXL002": "host sync inside a jitted function",
+    "MXL003": "jit recompile hazard (non-static traced arg needs "
+              "Python concreteness)",
+    "MXL004": "mutation of captured state inside a jit body",
+    "MXL005": "train-step wrapper jitted without donate_argnums",
+}
+
+DEFAULT_LINT_DIRS = ("mxnet_tpu", "tools", "examples")
+
+_PRAGMA_NAMES = {
+    "allow-broad-except": "MXL001",
+    "allow-host-sync": "MXL002",
+    "allow-recompile-hazard": "MXL003",
+    "allow-capture-mutation": "MXL004",
+    "allow-missing-donate": "MXL005",
+}
+
+_PRAGMA_RE = re.compile(
+    r"#\s*mxlint:\s*(?P<name>[a-z-]+|disable=MXL\d{3})\s*"
+    r"\(\s*(?P<reason>[^)]*?)\s*\)")
+
+_BROAD_EXC = ("Exception", "BaseException")
+
+# host-sync call surfaces: module-function form and method form
+_HOST_SYNC_FUNCS = {"float", "int"}
+_HOST_SYNC_NP = {"asarray", "array"}          # np.asarray / np.array / onp.*
+_NP_MODULES = {"np", "numpy", "onp"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+
+# shape-consuming positions for MXL003
+_SHAPE_FUNCS = {"zeros", "ones", "full", "empty", "arange", "broadcast_to",
+                "eye", "tri", "linspace"}
+_SHAPE_METHODS = {"reshape", "resize", "broadcast_to"}
+# attribute reads on a traced value that yield Python-concrete info
+_CONCRETE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+_MUTATING_METHODS = {"append", "extend", "insert", "add", "discard",
+                     "update", "pop", "popitem", "setdefault", "clear",
+                     "remove", "sort", "reverse"}
+
+_STEP_NAME_RE = re.compile(r"(^|_)(train_)?step(_|$)|^train_step")
+
+
+class Finding:
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path, line, rule, message):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def __repr__(self):
+        return "<Finding %s %s:%d>" % (self.rule, self.path, self.line)
+
+    def __str__(self):
+        return "%s:%d: %s %s" % (self.path, self.line, self.rule,
+                                 self.message)
+
+
+# ---------------------------------------------------------------- pragmas
+
+def _collect_pragmas(source, findings, path):
+    """{line_number: set(rule_ids)} of valid pragmas, via the tokenizer so
+    strings containing '# mxlint:' don't count."""
+    import io
+    import tokenize
+    out = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for lineno, text in comments:
+        # only the colon-prefixed form is a pragma attempt; prose that
+        # merely mentions the linter's name is not our business
+        if re.search(r"#\s*mxlint\s*:", text) is None:
+            continue
+        m = _PRAGMA_RE.search(text)
+        if m is None:
+            findings.append(Finding(
+                path, lineno, "MXL000",
+                "unparseable mxlint pragma %r (expected "
+                "'# mxlint: allow-<rule>(reason)' or "
+                "'# mxlint: disable=MXLnnn(reason)')" % text.strip()))
+            continue
+        name, reason = m.group("name"), m.group("reason")
+        if name.startswith("disable="):
+            rule = name[len("disable="):]
+        else:
+            rule = _PRAGMA_NAMES.get(name)
+        if rule is None or rule not in RULES:
+            findings.append(Finding(
+                path, lineno, "MXL000",
+                "unknown mxlint pragma name %r" % name))
+            continue
+        if not reason:
+            findings.append(Finding(
+                path, lineno, "MXL000",
+                "mxlint pragma %s requires a non-empty reason" % name))
+            continue
+        out.setdefault(lineno, set()).add(rule)
+    return out
+
+
+def _suppressed(pragmas, lineno, rule):
+    return (rule in pragmas.get(lineno, ()) or
+            rule in pragmas.get(lineno - 1, ()))
+
+
+# ------------------------------------------------------------ ast helpers
+
+def _dotted(node):
+    """'jax.jit'-style dotted name of an expression, or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node):
+    """True for ``jit`` / ``jax.jit`` / ``pjit`` / ``jax.pjit``."""
+    d = _dotted(node)
+    return d in ("jit", "jax.jit", "pjit", "jax.pjit",
+                 "jax.experimental.pjit.pjit")
+
+
+def _jit_call_of(node):
+    """If ``node`` is a Call invoking jit (directly or through
+    functools.partial(jax.jit, ...)), return that Call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_expr(node.func):
+        return node
+    d = _dotted(node.func)
+    if d in ("functools.partial", "partial") and node.args \
+            and _is_jit_expr(node.args[0]):
+        return node
+    return None
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+def _const_int(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, int) else None
+
+
+def _static_names(jit_call, fn_node):
+    """Parameter names of ``fn_node`` marked static in the jit call."""
+    static = set()
+    if jit_call is None or fn_node is None:
+        return static
+    params = [a.arg for a in
+              (fn_node.args.posonlyargs + fn_node.args.args)] \
+        if not isinstance(fn_node, ast.Lambda) else \
+        [a.arg for a in fn_node.args.args]
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                s = _const_str(v)
+                if s is not None:
+                    static.add(s)
+        elif kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                i = _const_int(v)
+                if i is not None and 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+def _local_names(fn_node):
+    """Names bound anywhere inside the function TREE (params, assignments,
+    loop/with/comprehension targets, inner defs/imports — including those
+    of nested functions).  For the capture-mutation rule the relevant
+    boundary is the jit trace: anything bound inside the traced function,
+    even in a nested scope, is trace-local state; only names that come
+    from OUTSIDE the jitted function (closure/global/``self``) persist
+    across calls and make mutation a hazard."""
+    names = set()
+
+    def add_params(f):
+        a = f.args
+        for grp in (getattr(a, "posonlyargs", []), a.args, a.kwonlyargs):
+            names.update(x.arg for x in grp)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+
+    add_params(fn_node)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            add_params(node)
+        elif isinstance(node, ast.Lambda):
+            add_params(node)
+        elif isinstance(node, ast.ClassDef):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for al in node.names:
+                names.add((al.asname or al.name).split(".")[0])
+    names.difference_update(_external_names(fn_node))
+    return names
+
+
+def _external_names(fn_node):
+    """Names that refer to state OUTSIDE the jit boundary even though
+    they appear in Store context inside it: ``global`` declarations
+    anywhere in the tree, plus ``nonlocal`` declarations at the ROOT
+    function level (a nonlocal in a nested def resolves to a binding in
+    an enclosing scope that is still inside the traced function, which
+    is trace-local and fine)."""
+    ext = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Global):
+            ext.update(node.names)
+    # ast.walk has no pruning; do a manual stop-at-nested-def traversal
+    stack = list(fn_node.body) if isinstance(fn_node.body, list) else []
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Nonlocal):
+            ext.update(node.names)
+        stack.extend(ast.iter_child_nodes(node))
+    return ext
+
+
+def _refs_param_concretely(expr, traced):
+    """True if ``expr`` references a traced name OTHER than through a
+    concrete accessor (x.shape / x.ndim / x.dtype / x.size / len(x)),
+    reached through any access chain (``batch[k].shape[1:]`` counts)."""
+    parents = {}
+    for parent in ast.walk(expr):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    def concrete(node):
+        cur = node
+        while True:
+            p = parents.get(id(cur))
+            if isinstance(p, ast.Attribute) and p.value is cur:
+                if p.attr in _CONCRETE_ATTRS:
+                    return True
+                cur = p          # x.T.shape: keep climbing the chain
+            elif isinstance(p, ast.Subscript) and p.value is cur:
+                cur = p          # batch[k].shape: through the subscript
+            elif isinstance(p, ast.Call) and isinstance(
+                    p.func, ast.Name) and p.func.id == "len" \
+                    and cur in p.args:
+                return True      # len(x) is rank info, concrete
+            else:
+                return False
+
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in traced \
+                and isinstance(node.ctx, ast.Load) and not concrete(node):
+            return True
+    return False
+
+
+# -------------------------------------------------------- per-rule visitors
+
+def _check_broad_except(tree, findings, pragmas, path):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = None
+        if node.type is None:
+            broad = "bare except:"
+        else:
+            types = node.type.elts if isinstance(node.type, ast.Tuple) \
+                else [node.type]
+            for t in types:
+                d = _dotted(t)
+                if d in _BROAD_EXC or (d or "").endswith(".Exception"):
+                    broad = "except %s" % d
+                    break
+        if broad is None:
+            continue
+        if _suppressed(pragmas, node.lineno, "MXL001"):
+            continue
+        findings.append(Finding(
+            path, node.lineno, "MXL001",
+            "%s swallows unrelated failures; narrow to the concrete "
+            "exception types or annotate with "
+            "'# mxlint: allow-broad-except(<reason>)'" % broad))
+
+
+class _JitScope:
+    """A function (def or lambda) whose body is traced under jit."""
+    __slots__ = ("fn", "jit_call", "how")
+
+    def __init__(self, fn, jit_call, how):
+        self.fn = fn            # FunctionDef | Lambda
+        self.jit_call = jit_call  # Call | None (bare @jax.jit decorator)
+        self.how = how          # 'decorator' | 'call'
+
+
+def _find_jit_scopes(tree):
+    """All jit-traced function scopes: decorated defs, local defs passed
+    to a jit call by name, and lambdas passed to jit inline."""
+    scopes = []
+    defs_by_scope = {}       # id(scope-node) -> {name: FunctionDef}
+
+    # index function defs by their enclosing function/module scope
+    def index(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_scope.setdefault(id(scope), {})[child.name] = child
+                index(child, child)
+            elif isinstance(child, ast.Lambda):
+                index(child, child)
+            elif isinstance(child, ast.ClassDef):
+                index(child, scope)
+            else:
+                index(child, scope)
+
+    index(tree, tree)
+
+    seen = set()
+
+    def add(fn, jit_call, how):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        scopes.append(_JitScope(fn, jit_call, how))
+
+    # 1) decorated defs
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            if _is_jit_expr(deco):
+                add(node, None, "decorator")
+            else:
+                c = _jit_call_of(deco)
+                if c is not None:
+                    add(node, c, "decorator")
+
+    # 2) jit(<name>, ...) / jit(<lambda>, ...) call sites, resolved
+    #    against defs visible in the same enclosing scope chain
+    scope_stack = [tree]
+
+    def walk(node):
+        jc = _jit_call_of(node)
+        if jc is not None and not (jc.args and _is_jit_expr(jc.args[0])):
+            target = jc.args[0] if jc.args else None
+            if isinstance(target, ast.Lambda):
+                add(target, jc, "call")
+            elif isinstance(target, ast.Name):
+                for scope in reversed(scope_stack):
+                    fns = defs_by_scope.get(id(scope), {})
+                    if target.id in fns:
+                        add(fns[target.id], jc, "call")
+                        break
+        is_scope = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda))
+        if is_scope:
+            scope_stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+        if is_scope:
+            scope_stack.pop()
+
+    walk(tree)
+    return scopes
+
+
+def _traced_names(scope):
+    """Names holding traced values inside a jit scope: the function's own
+    parameters minus static ones, for the outer fn and any nested defs
+    (nested fns are traced too when called from the jit body)."""
+    fn = scope.fn
+    static = _static_names(scope.jit_call, fn)
+    traced = set()
+
+    def params_of(f):
+        a = f.args
+        out = [x.arg for x in getattr(a, "posonlyargs", []) + a.args
+               + a.kwonlyargs]
+        if a.vararg:
+            out.append(a.vararg.arg)
+        return out
+
+    traced.update(p for p in params_of(fn) if p not in static)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            traced.update(params_of(node))
+    return traced, static
+
+
+def _check_jit_hazards(tree, findings, pragmas, path):
+    for scope in _find_jit_scopes(tree):
+        fn = scope.fn
+        traced, static = _traced_names(scope)
+        locals_ = _local_names(fn)
+        external = _external_names(fn)
+
+        for node in ast.walk(fn):
+            # ---- MXL002: host syncs
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                fname = d.split(".")[-1] if d else None
+                is_sync = False
+                what = None
+                if d in _HOST_SYNC_FUNCS and node.args and \
+                        _refs_param_concretely(node.args[0], traced):
+                    is_sync, what = True, "%s() of a traced value" % d
+                elif d and "." in d and fname in _HOST_SYNC_NP and \
+                        d.split(".")[0] in _NP_MODULES and node.args and \
+                        _refs_param_concretely(node.args[0], traced):
+                    is_sync, what = True, ("%s on a traced value pulls it "
+                                           "to the host" % d)
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _HOST_SYNC_METHODS and \
+                        not node.args:
+                    is_sync, what = True, (".%s() forces a device->host "
+                                           "transfer" % node.func.attr)
+                if is_sync and not _suppressed(pragmas, node.lineno,
+                                               "MXL002"):
+                    findings.append(Finding(
+                        path, node.lineno, "MXL002",
+                        "%s inside jit-traced function %r; hoist it out "
+                        "of the compiled region or use jnp equivalents"
+                        % (what, getattr(fn, "name", "<lambda>"))))
+
+                # ---- MXL003: traced value in a shape position
+                hazard_args = ()
+                if d and fname in _SHAPE_FUNCS and "." in d:
+                    hazard_args = node.args[:1]
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SHAPE_METHODS:
+                    hazard_args = node.args
+                elif d == "range":
+                    hazard_args = node.args
+                tr_nonstatic = traced - static
+                for arg in hazard_args:
+                    if _refs_param_concretely(arg, tr_nonstatic):
+                        if _suppressed(pragmas, node.lineno, "MXL003"):
+                            continue
+                        findings.append(Finding(
+                            path, node.lineno, "MXL003",
+                            "traced argument used as a Python-concrete "
+                            "value in %s() inside jit-traced function "
+                            "%r: mark it static (static_argnums/"
+                            "static_argnames) or derive it from .shape"
+                            % (d or node.func.attr,
+                               getattr(fn, "name", "<lambda>"))))
+                        break
+
+                # mutating method on a captured name (MXL004)
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATING_METHODS and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id not in locals_ and \
+                        node.func.value.id not in _NP_MODULES:
+                    if not _suppressed(pragmas, node.lineno, "MXL004"):
+                        findings.append(Finding(
+                            path, node.lineno, "MXL004",
+                            "call to %s.%s() mutates state captured from "
+                            "an enclosing scope inside jit-traced "
+                            "function %r; tracing runs once, so this "
+                            "does not happen per step — thread the state "
+                            "through arguments/returns instead"
+                            % (node.func.value.id, node.func.attr,
+                               getattr(fn, "name", "<lambda>"))))
+
+            # ---- MXL004: stores into captured containers/objects
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    base = tgt
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        base = base.value
+                    if not isinstance(base, ast.Name):
+                        continue
+                    if base is tgt:
+                        # plain name rebinding is scoped by python itself;
+                        # only flag rebinds that reach OUTSIDE the jit
+                        # boundary (global anywhere, nonlocal at the root)
+                        if base.id not in external:
+                            continue
+                    elif base.id in locals_:
+                        continue
+                    if _suppressed(pragmas, node.lineno, "MXL004"):
+                        continue
+                    findings.append(Finding(
+                        path, node.lineno, "MXL004",
+                        "assignment into %r mutates state captured from "
+                        "an enclosing scope inside jit-traced function "
+                        "%r; the write happens at trace time only"
+                        % (base.id, getattr(fn, "name", "<lambda>"))))
+
+
+def _check_missing_donate(tree, findings, pragmas, path):
+    for node in ast.walk(tree):
+        jc = _jit_call_of(node)
+        if jc is None or not jc.args:
+            continue
+        target = jc.args[0]
+        if _is_jit_expr(target):
+            continue     # functools.partial(jax.jit, ...): decorator form
+        name = target.id if isinstance(target, ast.Name) else None
+        if name is None or not _STEP_NAME_RE.search(name):
+            continue
+        kwargs = {kw.arg for kw in jc.keywords}
+        if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+            continue
+        if _suppressed(pragmas, jc.lineno, "MXL005"):
+            continue
+        findings.append(Finding(
+            path, jc.lineno, "MXL005",
+            "train-step function %r jitted without donate_argnums/"
+            "donate_argnames: params and optimizer state are "
+            "double-buffered in HBM; donate the state arguments" % name))
+
+    # decorator form: @jax.jit / @partial(jax.jit, ...) on a *step def
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _STEP_NAME_RE.search(node.name):
+            continue
+        for deco in node.decorator_list:
+            jc = _jit_call_of(deco)
+            bare = _is_jit_expr(deco)
+            if jc is None and not bare:
+                continue
+            kwargs = {kw.arg for kw in jc.keywords} if jc else set()
+            if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+                continue
+            if _suppressed(pragmas, node.lineno, "MXL005") or \
+                    _suppressed(pragmas, deco.lineno, "MXL005"):
+                continue
+            findings.append(Finding(
+                path, deco.lineno, "MXL005",
+                "train-step function %r jitted without donate_argnums/"
+                "donate_argnames: params and optimizer state are "
+                "double-buffered in HBM; donate the state arguments"
+                % node.name))
+
+
+# ---------------------------------------------------------------- driver
+
+def lint_source(source, path="<string>"):
+    """Lint one source string; returns a list of Findings."""
+    findings = []
+    pragmas = _collect_pragmas(source, findings, path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        findings.append(Finding(path, e.lineno or 0, "MXL000",
+                                "file does not parse: %s" % e.msg))
+        return findings
+    _check_broad_except(tree, findings, pragmas, path)
+    _check_jit_hazards(tree, findings, pragmas, path)
+    _check_missing_donate(tree, findings, pragmas, path)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_file(path):
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in sorted(dirs)
+                       if d not in ("__pycache__", ".git")]
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    yield os.path.join(root, fname)
+
+
+def lint_paths(paths):
+    findings = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path))
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description="TPU-hazard source linter (MXL001-005)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: mxnet_tpu/ "
+                         "tools/ examples/ next to this script)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print("%s  %s" % (rid, RULES[rid]))
+        return 0
+    paths = args.paths
+    if not paths:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(root, d) for d in DEFAULT_LINT_DIRS]
+    findings, n_files = [], 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        findings.extend(lint_file(path))
+    for f in findings:
+        print(f)
+    print("mxlint: %d finding(s) over %d file(s)"
+          % (len(findings), n_files))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
