@@ -6,7 +6,15 @@ img/s on 1x K80 (the reference's own published p2.xlarge number,
 BASELINE.md).  Runs the fused pjit train step (mxnet_tpu.parallel.
 ShardedTrainer) on all available local devices.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"telemetry"} — the ``telemetry`` block is
+``mxnet_tpu.telemetry.report()`` (step-time p50/p90/p99, samples/sec,
+compile count/time, per-phase span breakdown), the standardized fields
+the BENCH trajectory tracks across rounds.
+
+``--dry-run`` (or BENCH_DRYRUN=1) swaps in a tiny MLP and a handful of
+steps so the full pipeline — trainer, telemetry, report — is exercised
+in seconds on any backend.
 """
 from __future__ import annotations
 
@@ -22,6 +30,9 @@ BASELINE_IMG_S = 45.52  # reference ResNet-50 train, 1x K80, batch 32
 
 def main():
     import threading
+
+    dry_run = "--dry-run" in sys.argv[1:] or \
+        os.environ.get("BENCH_DRYRUN", "0") == "1"
 
     # Init watchdog: a dead accelerator tunnel makes jax.devices() hang
     # forever, which would leave NO bench artifact at all.  Fail loudly
@@ -55,6 +66,42 @@ def main():
     init_done.set()
     n_dev = len(devices)
     platform = devices[0].platform
+
+    if dry_run:
+        # tiny MLP, a handful of real optimizer steps: exercises the
+        # trainer + telemetry + report pipeline end-to-end in seconds
+        batch = 8 * n_dev
+        net = models.get_model("mlp", num_classes=10)
+        mesh = build_mesh(tp=1)
+        trainer = ShardedTrainer(
+            net, mesh,
+            data_shapes={"data": (batch, 64)},
+            label_shapes={"softmax_label": (batch,)},
+            optimizer="sgd", learning_rate=0.1, dtype="float32")
+        rng = np.random.RandomState(0)
+        batch_dict = trainer.put_batch({
+            "data": rng.uniform(-1, 1, (batch, 64)).astype(np.float32),
+            "softmax_label":
+                rng.randint(0, 10, batch).astype(np.float32)})
+        float(trainer.step(batch_dict))  # compile
+        # drop the warmup/compile step from the step window so the
+        # reported percentiles/throughput cover only the timed loop
+        # (compile counters are process-lifetime and survive)
+        from mxnet_tpu import telemetry
+        telemetry.reset_steps()
+        t0 = time.perf_counter()
+        steps = 5
+        for _ in range(steps):
+            loss = trainer.step(batch_dict)
+        assert np.isfinite(float(loss))
+        dt = time.perf_counter() - t0
+        _emit({
+            "metric": "dryrun_mlp_train_samples_per_sec_per_chip",
+            "value": round(steps * batch / dt / n_dev, 2),
+            "unit": "samples/s/chip",
+            "vs_baseline": 0,
+        })
+        return
 
     # batch 128/chip: the reference benchmarks batch 32 on 12GB GPUs; the
     # TPU has the HBM for 128 and the tunnel dispatch overhead amortizes
@@ -108,15 +155,19 @@ def main():
     # update (forward+backward+optimizer+aux).  BENCH_SCAN=1 for the
     # per-step dispatch path.
     scan = int(os.environ.get("BENCH_SCAN", "10"))
+    from mxnet_tpu import telemetry
     if scan > 1:
         steps = max(scan, (steps // scan) * scan)
         float(np.asarray(trainer.run_steps(batch_dict, scan))[-1])  # compile
+        # exclude warmup/compile steps from the reported step window
+        telemetry.reset_steps()
         t0 = time.perf_counter()
         for _ in range(steps // scan):
             losses = trainer.run_steps(batch_dict, scan)
         assert np.isfinite(float(np.asarray(losses)[-1]))
         dt = time.perf_counter() - t0
     else:
+        telemetry.reset_steps()
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = trainer.step(batch_dict)
@@ -125,12 +176,28 @@ def main():
 
     img_per_sec = steps * batch / dt
     img_per_sec_chip = img_per_sec / n_dev
-    print(json.dumps({
+    _emit({
         "metric": "resnet%d_train_images_per_sec_per_chip" % num_layers,
         "value": round(img_per_sec_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_S, 3),
-    }))
+    })
+
+
+def _emit(result):
+    """Attach the standardized telemetry report (step-time percentiles,
+    throughput, compile count — the BENCH trajectory fields) and print
+    the one-line JSON artifact."""
+    from mxnet_tpu import telemetry
+    rep = telemetry.report()
+    result["telemetry"] = {
+        "steps": rep["steps"],
+        "step_time_s": rep["step_time_s"],
+        "throughput": rep["throughput"],
+        "compile": rep["compile"],
+        "phases": rep["phases"],
+    }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

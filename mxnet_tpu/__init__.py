@@ -67,6 +67,7 @@ from . import module as mod  # reference alias (python/mxnet/__init__.py)
 from .module import Module
 from . import rnn
 from . import profiler
+from . import telemetry
 from . import monitor
 from . import monitor as mon  # reference alias (python/mxnet/__init__.py)
 from .monitor import Monitor

@@ -113,6 +113,15 @@ _CATALOG = {
                               "on every bind (Executor and Module) and "
                               "fail with node-level diagnostics before "
                               "any XLA compile"),
+    # telemetry subsystem (docs/api/telemetry.md)
+    "MXNET_TPU_TELEMETRY_JSONL": ("", "honored",
+                                  "append one JSON line per training "
+                                  "step (span timings + full counter/"
+                                  "gauge snapshot) to this file"),
+    "MXNET_TPU_TELEMETRY_PORT": ("0", "honored",
+                                 "serve Prometheus text metrics on "
+                                 "http://0.0.0.0:PORT/metrics "
+                                 "(0 = off)"),
 }
 
 

@@ -307,8 +307,9 @@ class Executor:
     def forward_backward(self, **kwargs):
         """Fused training step: outputs + gradients in one XLA program.
         Equivalent to forward(is_train=True) followed by backward()."""
-        from . import profiler
-        with profiler.record_scope("forward_backward", category="executor"):
+        from . import telemetry
+        with telemetry.span("executor.forward_backward",
+                            category="executor"):
             return self._forward_backward(**kwargs)
 
     def _forward_backward(self, **kwargs):
@@ -347,8 +348,8 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         """Run the forward graph.  kwargs update named input arrays
         (reference python/mxnet/executor.py:95)."""
-        from . import profiler
-        with profiler.record_scope("forward", category="executor"):
+        from . import telemetry
+        with telemetry.span("executor.forward", category="executor"):
             return self._forward(is_train, **kwargs)
 
     def _forward(self, is_train=False, **kwargs):
@@ -402,6 +403,11 @@ class Executor:
 
     def backward(self, out_grads=None, is_train=True):
         """Accumulate gradients into the bound grad arrays."""
+        from . import telemetry
+        with telemetry.span("executor.backward", category="executor"):
+            return self._backward(out_grads, is_train)
+
+    def _backward(self, out_grads=None, is_train=True):
         if self._outputs is None:
             raise MXNetError("call forward(is_train=True) before backward()")
         if not self._last_train:

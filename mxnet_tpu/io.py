@@ -14,6 +14,7 @@ import gzip
 import os
 import struct
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
@@ -21,7 +22,17 @@ import numpy as np
 from .base import MXNetError
 from . import ndarray
 from . import resilience
+from . import telemetry
 from .ndarray import NDArray, array
+
+# prefetcher observability (docs/api/telemetry.md): queue depth +
+# consumer stall time, per iterator family (host thread vs device stager)
+_HOST_STALL = telemetry.counter(
+    "mxtpu_io_prefetch_stall_seconds_total").labels(iter="host")
+_HOST_DEPTH = telemetry.gauge("mxtpu_io_prefetch_depth").labels(iter="host")
+_DEV_STALL = telemetry.counter(
+    "mxtpu_io_prefetch_stall_seconds_total").labels(iter="device")
+_DEV_DEPTH = telemetry.gauge("mxtpu_io_prefetch_depth").labels(iter="device")
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "DevicePrefetchIter",
            "ResizeIter",
@@ -204,6 +215,13 @@ class PrefetchingIter(DataIter):
                     self.prefetch_errors[i] = e
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
+                # producer side drives the depth gauge: a composite
+                # batch counts as staged once EVERY slot is ready, and
+                # the value must hold between iter_next calls so
+                # scrapes/snapshots see it (the consumer zeroes it when
+                # it takes the batch)
+                if all(e.is_set() for e in self.data_ready):
+                    _HOST_DEPTH.set(1)
 
         self.prefetch_threads = [
             threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
@@ -245,8 +263,12 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        # consumer stall: time blocked on the prefetch threads — nonzero
+        # totals mean the pipeline (not the device) bounds throughput
+        t0 = time.perf_counter()
         for e in self.data_ready:
             e.wait()
+        _HOST_STALL.inc(time.perf_counter() - t0)
         errs = [e for e in self.prefetch_errors if e is not None]
         if errs:
             # re-arm EVERY slot before raising so a caller that treats
@@ -276,6 +298,7 @@ class PrefetchingIter(DataIter):
             e.clear()
         for e in self.data_taken:
             e.set()
+        _HOST_DEPTH.set(0)
         return True
 
     def next(self):
@@ -410,6 +433,7 @@ class DevicePrefetchIter:
                     staged = self._stage(self._to_host_dict(batch))
                     if not self._put(("item", staged)):
                         return
+                    _DEV_DEPTH.set(self._queue.qsize())
             except BaseException as e:  # mxlint: allow-broad-except(surfaced on the consumer via the error queue item)
                 self._put(("error", e))
                 return
@@ -423,7 +447,10 @@ class DevicePrefetchIter:
     def __next__(self):
         if self._exhausted:
             raise StopIteration     # iterator protocol: stays exhausted
+        t0 = time.perf_counter()
         kind, val = self._queue.get()
+        _DEV_STALL.inc(time.perf_counter() - t0)
+        _DEV_DEPTH.set(self._queue.qsize())
         if kind == "end":
             self._exhausted = True
             raise StopIteration

@@ -15,6 +15,15 @@ import subprocess
 
 import numpy as np
 
+from . import telemetry
+
+# per-record counters for the native reader (source label separates it
+# from the pure-python recordio path)
+_NAT_READS = telemetry.counter(
+    "mxtpu_io_records_total").labels(source="native")
+_NAT_BAD = telemetry.counter(
+    "mxtpu_io_bad_records_total").labels(source="native")
+
 _LIB = None
 _TRIED = False
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -103,6 +112,7 @@ class NativeRecordIOReader:
         if self._bad_quota <= 0:
             raise exc
         self.bad_records += 1
+        _NAT_BAD.inc()
         if self.bad_records > self._bad_quota:
             raise IOError(
                 "%s: bad-record quota exhausted (%d > %d); last "
@@ -143,6 +153,7 @@ class NativeRecordIOReader:
                 continue
             if dropped:
                 continue
+            _NAT_READS.inc()
             return bytes(bytearray(self._buf[:n]))
 
     def read_float_batch(self, batch, record_floats):
@@ -155,6 +166,8 @@ class NativeRecordIOReader:
             labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             record_floats, batch)
+        if n > 0:
+            _NAT_READS.inc(int(n))
         return int(n), labels, data
 
     def close(self):
@@ -263,6 +276,7 @@ class ImageRecordIter:
         if n <= 0:
             raise StopIteration
         n = int(n)
+        _NAT_READS.inc(n)
         if n < self.batch_size and self._round:
             # pad the tail by wrapping real samples (reference round_batch
             # pads with wrapped data, never zero images); pad count lets

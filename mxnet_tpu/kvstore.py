@@ -22,12 +22,29 @@ from __future__ import annotations
 
 import pickle
 
+import numpy as np
+
 from .base import MXNetError
 from . import ndarray
+from . import telemetry
 from .ndarray import NDArray
 from . import optimizer as opt
 
 __all__ = ["KVStore", "create"]
+
+_PUSH_BYTES = telemetry.counter("mxtpu_kvstore_push_bytes_total")
+_PULL_BYTES = telemetry.counter("mxtpu_kvstore_pull_bytes_total")
+
+
+def _nbytes(arr):
+    """Size in bytes of one pushed/pulled array (traffic accounting)."""
+    n = 1
+    for d in arr.shape:
+        n *= int(d)
+    try:
+        return n * np.dtype(arr.dtype).itemsize
+    except TypeError:
+        return n * 4
 
 
 def _ctype_key_value(keys, vals):
@@ -70,6 +87,10 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._optimizer_states = None
+        # label children bound once (push/pull run per parameter sync
+        # per step — the hot-path pattern, see docs/api/telemetry.md)
+        self._push_bytes = _PUSH_BYTES.labels(store=kv_type)
+        self._pull_bytes = _PULL_BYTES.labels(store=kv_type)
 
     # ----------------------------------------------------------------- info
     @property
@@ -100,6 +121,7 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
+        self._push_bytes.inc(sum(_nbytes(v) for v in vals))
         uniq, grouped = _group_kv_pairs(keys, vals)
         for k, group in zip(uniq, grouped):
             merged = group[0].copy()
@@ -115,6 +137,7 @@ class KVStore:
     def pull(self, key, out=None, priority=0):
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
+        self._pull_bytes.inc(sum(_nbytes(o) for o in outs))
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s has not been inited" % str(k))

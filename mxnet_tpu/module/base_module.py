@@ -28,6 +28,19 @@ def _as_list(obj):
     return [obj]
 
 
+def _batch_samples(batch, data_iter):
+    """Samples one batch contributes to throughput accounting: the batch
+    dim of the first data array (pad rows excluded when declared)."""
+    try:
+        n = int(batch.data[0].shape[0])
+    except (AttributeError, IndexError, TypeError):
+        n = int(getattr(data_iter, "batch_size", 0) or 0)
+    pad = getattr(batch, "pad", None)
+    if pad:
+        n = max(0, n - int(pad))
+    return n
+
+
 def _check_input_names(symbol, names, typename, throw):
     """Every requested input name must be a symbol argument; on a miss,
     suggest the non-aux arguments (same diagnostic contract as reference
@@ -171,10 +184,23 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        from .. import telemetry
+        fetch_span = telemetry.span("data.fetch", category="io")
+
         for epoch in range(begin_epoch, num_epoch):
             started = time.time()
             eval_metric.reset()
-            for nbatch, batch in enumerate(train_data):
+            nbatch = 0
+            data_iter = iter(train_data)
+            while True:
+                # the fetch is a traced span of its own: a loop starved
+                # by the input pipeline shows up as data.fetch time, not
+                # as mysteriously slow steps
+                with fetch_span:
+                    batch = next(data_iter, None)
+                if batch is None:
+                    break
+                step_t0 = time.perf_counter()
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(batch)
@@ -188,6 +214,10 @@ class BaseModule:
                                          locals=locals())
                     for cb in _as_list(batch_end_callback):
                         cb(info)
+                telemetry.step_end(
+                    samples=_batch_samples(batch, train_data),
+                    step_time=time.perf_counter() - step_t0)
+                nbatch += 1
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

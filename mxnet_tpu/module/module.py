@@ -385,35 +385,43 @@ class Module(BaseModule):
     # ------------------------------------------------------------- compute
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        self._exec_group.forward(data_batch, is_train)
+        from .. import telemetry
+        with telemetry.span("module.forward", category="module"):
+            self._exec_group.forward(data_batch, is_train)
 
     def forward_backward(self, data_batch):
         """Fused train step (reference runs forward and backward as
         separate engine pushes; here one XLA program shares the forward
         between primal and vjp)."""
         assert self.binded and self.params_initialized
-        self._exec_group.forward_backward(data_batch)
+        from .. import telemetry
+        with telemetry.span("module.forward_backward", category="module"):
+            self._exec_group.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        self._exec_group.backward(out_grads=out_grads)
+        from .. import telemetry
+        with telemetry.span("module.backward", category="module"):
+            self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
         """Apply gradients (reference module.py:561-581)."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
 
+        from .. import telemetry
         self._params_dirty = True
-        if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore)
-        else:
-            _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
-                           updater=self._updater,
-                           num_device=len(self._context),
-                           kvstore=self._kvstore)
+        with telemetry.span("module.update", category="module"):
+            if self._update_on_kvstore:
+                _update_params_on_kvstore(self._exec_group.param_arrays,
+                                          self._exec_group.grad_arrays,
+                                          self._kvstore)
+            else:
+                _update_params(self._exec_group.param_arrays,
+                               self._exec_group.grad_arrays,
+                               updater=self._updater,
+                               num_device=len(self._context),
+                               kvstore=self._kvstore)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -10,9 +10,14 @@ from __future__ import annotations
 import logging
 import re
 
+import numpy as np
+
+from . import telemetry
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
+
+_STAT_GAUGE = telemetry.gauge("mxtpu_monitor_stat")
 
 
 class Monitor:
@@ -70,6 +75,19 @@ class Monitor:
                     v = v.asnumpy()
                 s += str(v) + "\t"
             res.append((n, k, s))
+            # mirror the stat into the telemetry registry (labeled by
+            # tensor name) so installed monitors land in the JSONL
+            # step-log / Prometheus surface, not only the log lines.
+            # toc() already synced the values, so this costs no extra
+            # device round trip; non-scalar stats record their mean.
+            try:
+                first = v_list[0]
+                if isinstance(first, NDArray):
+                    first = first.asnumpy()
+                _STAT_GAUGE.labels(tensor=str(k)).set(
+                    float(np.mean(np.asarray(first))))
+            except (TypeError, ValueError, IndexError):
+                pass  # non-numeric or empty custom stat_func output
         self.queue = []
         return res
 

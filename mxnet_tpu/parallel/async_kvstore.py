@@ -31,9 +31,14 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from .. import telemetry
 from ..kvstore import KVStore, _ctype_key_value, _group_kv_pairs
 
 __all__ = ["AsyncKVStore", "ParameterServer"]
+
+# push/pull byte children come bound from KVStore.__init__
+# (store="dist_async"); only the in-flight gauge is module-level
+_PENDING = telemetry.gauge("mxtpu_kvstore_pending_async")
 
 
 def _send_msg(sock, obj):
@@ -268,8 +273,14 @@ class AsyncKVStore(KVStore):
 
     def _rpc_to(self, sidx, *msg):
         sock = self._socks[sidx]
-        _send_msg(sock, msg)
-        resp = _recv_msg(sock)
+        # in-flight depth: the async contract means a slow server shows
+        # up as this gauge sticking above 0, not as a training stall
+        _PENDING.inc()
+        try:
+            _send_msg(sock, msg)
+            resp = _recv_msg(sock)
+        finally:
+            _PENDING.dec()
         if resp[0] == "err":
             raise MXNetError("dist_async server %d: %s" % (sidx, resp[1]))
         return resp[1] if len(resp) > 1 else None
@@ -329,6 +340,7 @@ class AsyncKVStore(KVStore):
             merged = group[0].asnumpy()
             for other in group[1:]:
                 merged = merged + other.asnumpy()
+            self._push_bytes.inc(merged.nbytes)
             plan = self._plan_of(k, merged.size)
             if plan is None:
                 self._rpc_to(self._server_of(k), "push", k, merged)
@@ -352,6 +364,7 @@ class AsyncKVStore(KVStore):
                     cache[k] = np.concatenate(
                         [np.asarray(p).reshape(-1) for p in parts]
                     ).reshape(o.shape)
+                self._pull_bytes.inc(np.asarray(cache[k]).nbytes)
             o[:] = cache[k]
 
     def set_optimizer(self, optimizer):

@@ -24,7 +24,7 @@ import logging
 import numpy as np
 
 from ..base import MXNetError
-from ..kvstore import KVStore
+from ..kvstore import KVStore, _nbytes
 
 __all__ = ["DistKVStore"]
 
@@ -119,6 +119,7 @@ class DistKVStore(KVStore):
             for other in group[1:]:
                 m += other
             merged[k] = m
+            self._push_bytes.inc(_nbytes(m))
         if self._num_workers > 1:
             summed = self.allreduce({k: m.data for k, m in merged.items()})
             # addressable_data(0) is this host's replica of the reduced

@@ -29,19 +29,22 @@ def make_pipeline_mesh(n_stages, devices=None):
     return make_1d_mesh("pipe", n_stages, devices)
 
 
-def _stage_loop(stage_fn, params_stack, x_stack, axis_name, remat):
+def _stage_loop(stage_fn, params_stack, x_stack, axis_name, remat,
+                n_stages):
     """Per-device body under shard_map.
 
     params_stack: (1, ...) this device's stage params (leading stage axis
     sharded to size 1).  x_stack: (M, B_u, ...) all microbatches,
     replicated.  Returns (M, B_u, ...) outputs of the LAST stage
     (garbage on other devices; caller slices stage S-1's shard).
+    ``n_stages`` is threaded in statically (the scan length and the
+    ppermute ring need python ints; jax 0.4.x has no lax.axis_size).
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    n = n_stages
     sid = lax.axis_index(axis_name)
     m = x_stack.shape[0]
     params = jax.tree.map(lambda p: p[0], params_stack)
@@ -88,6 +91,20 @@ def pipeline_apply(stage_fn, params_stack, x, mesh, microbatches,
     chunks.  Returns (batch, ...) outputs of the final stage, replicated.
     """
     import jax
+    from .. import telemetry
+    if not jax.core.trace_state_clean():
+        # caller is tracing (jit(pipeline_apply) is a supported
+        # pattern): a span here would record one trace-time interval
+        # and then nothing per execution — worse than no data
+        return _pipeline_apply(stage_fn, params_stack, x, mesh,
+                               microbatches, remat)
+    with telemetry.span("pipeline.apply", category="trainer"):
+        return _pipeline_apply(stage_fn, params_stack, x, mesh,
+                               microbatches, remat)
+
+
+def _pipeline_apply(stage_fn, params_stack, x, mesh, microbatches, remat):
+    import jax
     import jax.numpy as jnp
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
@@ -100,7 +117,7 @@ def pipeline_apply(stage_fn, params_stack, x, mesh, microbatches,
     x_stack = x.reshape((microbatches, b // microbatches) + x.shape[1:])
 
     body = functools.partial(_stage_loop, stage_fn, axis_name="pipe",
-                             remat=remat)
+                             remat=remat, n_stages=int(n))
     out = shard_map(
         lambda p, xs: jax.lax.psum(body(p, xs), "pipe"),
         mesh=mesh,
@@ -118,12 +135,18 @@ def pipeline_grad(loss_fn, stage_fn, params_stack, x, labels, mesh,
     stacked stage params — jax.grad runs the schedule in reverse
     (ppermute transposes to the opposite ring direction)."""
     import jax
+    from .. import telemetry
 
     def full(p):
-        y = pipeline_apply(stage_fn, p, x, mesh, microbatches, remat=remat)
+        y = _pipeline_apply(stage_fn, p, x, mesh, microbatches,
+                            remat=remat)
         return loss_fn(y, labels)
 
-    return jax.value_and_grad(full)(params_stack)
+    if not jax.core.trace_state_clean():
+        # under an outer trace a span records nothing per execution
+        return jax.value_and_grad(full)(params_stack)
+    with telemetry.span("pipeline.grad", category="trainer"):
+        return jax.value_and_grad(full)(params_stack)
 
 
 # ===================================================================
@@ -289,7 +312,10 @@ def hetero_pipeline_loss(branches, x_stack, params_stack, microbatches,
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    # one branch per pipeline stage, one stage per device on the axis:
+    # the branch count IS the axis size, and it is static (the scan
+    # length below needs a python int; jax 0.4.x has no lax.axis_size)
+    n = len(branches)
     sid = lax.axis_index(axis_name)
     m = x_stack.shape[0]
     row = params_stack[0]

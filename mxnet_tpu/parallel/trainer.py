@@ -1352,7 +1352,28 @@ class ShardedTrainer:
     def step(self, batch):
         """One fused training step.  ``batch``: dict name -> host array
         with GLOBAL batch dim (or a dict from :meth:`put_batch`).
-        Returns the (device) loss scalar."""
+        Returns the (device) loss scalar.
+
+        Telemetry: each call is a ``trainer.step`` span and one
+        ``step_end`` record (step time is host-side dispatch+staging —
+        on an async backend the device may still be computing)."""
+        import time as _time
+        from .. import telemetry
+        t0 = _time.perf_counter()
+        with telemetry.span("trainer.step", category="trainer"):
+            loss = self._step_impl(batch)
+        telemetry.step_end(samples=self._batch_samples(batch),
+                           step_time=_time.perf_counter() - t0)
+        return loss
+
+    def _batch_samples(self, batch):
+        try:
+            first = next(iter(batch.values()))
+            return int(first.shape[0])
+        except (StopIteration, AttributeError, IndexError, TypeError):
+            return 0
+
+    def _step_impl(self, batch):
         import jax
         import jax.numpy as jnp
         self._key, sub = jax.random.split(self._key)
@@ -1391,6 +1412,22 @@ class ShardedTrainer:
         step, stage the next batch with :meth:`put_batch` while the chip
         runs (double buffering) and call :meth:`step` per batch.
         """
+        import time as _time
+        from .. import telemetry
+        t0 = _time.perf_counter()
+        with telemetry.span("trainer.run_steps", category="trainer"):
+            losses = self._run_steps_impl(batch, num_steps)
+        # the scan chain IS num_steps full optimizer updates observed
+        # once from the host: counters/percentiles advance per inner
+        # step, but the JSONL gets ONE record (count=num_steps) — per-
+        # record snapshots of an opaque chain would be byte-identical
+        telemetry.step_end(
+            samples=self._batch_samples(batch),
+            step_time=(_time.perf_counter() - t0) / max(1, num_steps),
+            count=num_steps)
+        return losses
+
+    def _run_steps_impl(self, batch, num_steps):
         import jax
         import jax.numpy as jnp
         import numpy as _np

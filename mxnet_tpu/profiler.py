@@ -23,7 +23,7 @@ import threading
 import time
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "record_event", "is_running"]
+           "record_event", "is_running", "now_us"]
 
 _state = {
     "mode": "symbolic",      # 'symbolic' | 'all'
@@ -37,32 +37,54 @@ _lock = threading.Lock()
 _t0 = time.perf_counter()
 
 
-def _now_us():
+def now_us():
+    """Microseconds on the profiler's clock (trace-event timebase).
+    Public so the telemetry span tracer stamps its events on the same
+    axis as the operator events recorded here."""
     return (time.perf_counter() - _t0) * 1e6
+
+
+_now_us = now_us
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """Reference MXSetProfilerConfig (c_api.cc:79-95)."""
     if mode not in ("symbolic", "all", "imperative"):
         raise ValueError("invalid profiler mode %r" % mode)
-    _state["mode"] = mode
-    _state["filename"] = filename
+    # _lock guards ALL _state mutation: config can race span callbacks
+    # (telemetry spans fire from prefetcher threads) and dump_profile
+    with _lock:
+        _state["mode"] = mode
+        _state["filename"] = filename
 
 
 def profiler_set_state(state="stop"):
     """Reference MXSetProfilerState: 'run' | 'stop'."""
     if state == "run":
-        _state["running"] = True
-        if _state["xla_dir"] and not _state["xla_active"]:
+        with _lock:
+            _state["running"] = True
+            start_xla = _state["xla_dir"] and not _state["xla_active"]
+            if start_xla:
+                # claim the slot under the lock (a racing 'run' must
+                # not double-start); rolled back below if start fails
+                _state["xla_active"] = True
+        if start_xla:
             import jax
-            jax.profiler.start_trace(_state["xla_dir"])
-            _state["xla_active"] = True
+            try:
+                jax.profiler.start_trace(_state["xla_dir"])
+            except BaseException:  # mxlint: allow-broad-except(rollback-and-reraise: the flag must not claim a trace that never started)
+                with _lock:
+                    _state["xla_active"] = False
+                raise
     elif state == "stop":
-        _state["running"] = False
-        if _state["xla_active"]:
+        with _lock:
+            _state["running"] = False
+            stop_xla = _state["xla_active"]
+            if stop_xla:
+                _state["xla_active"] = False
+        if stop_xla:
             import jax
             jax.profiler.stop_trace()
-            _state["xla_active"] = False
     else:
         raise ValueError("invalid profiler state %r" % state)
 
@@ -111,9 +133,10 @@ def dump_profile(finished=True):
         events = list(_state["events"])
         if finished:
             _state["events"] = []
-    with open(_state["filename"], "w") as f:
+        filename = _state["filename"]
+    with open(filename, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    return _state["filename"]
+    return filename
 
 
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":

@@ -17,8 +17,20 @@ from collections import namedtuple
 
 import numpy as np
 
+from . import telemetry
+
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
+
+# per-record counters, bound once (hot path: one locked add per record)
+_REC_READS = telemetry.counter(
+    "mxtpu_io_records_total").labels(source="recordio")
+_REC_BAD = telemetry.counter(
+    "mxtpu_io_bad_records_total").labels(source="recordio")
+_REC_RESYNCS = telemetry.counter(
+    "mxtpu_io_resyncs_total").labels(source="recordio")
+_REC_SKIPPED = telemetry.counter(
+    "mxtpu_io_skipped_bytes_total").labels(source="recordio")
 
 _MAGIC = 0xced7230a
 _LENGTH_MASK = (1 << 29) - 1
@@ -186,6 +198,7 @@ class MXRecordIO:
         if self._bad_quota <= 0:
             raise exc
         self.bad_records += 1
+        _REC_BAD.inc()
         if self.bad_records > self._bad_quota:
             raise IOError(
                 "%s: bad-record quota exhausted (%d corrupt/truncated "
@@ -210,6 +223,7 @@ class MXRecordIO:
             chunk = self.fid.read(1 << 16)
             if not chunk:
                 self.skipped_bytes += base + len(tail) - start
+                _REC_SKIPPED.inc(max(0, base + len(tail) - start))
                 return False
             buf = tail + chunk
             i = buf.find(magic_bytes)
@@ -219,6 +233,8 @@ class MXRecordIO:
                     self.fid.seek(off)
                     self.resyncs += 1
                     self.skipped_bytes += off - start
+                    _REC_RESYNCS.inc()
+                    _REC_SKIPPED.inc(off - start)
                     return True
                 i = buf.find(magic_bytes, i + 1)
             keep = min(3, len(buf))
@@ -244,7 +260,10 @@ class MXRecordIO:
             start = self.fid.tell()
             try:
                 resilience.fault_point("recordio.read")
-                return self._read_record()
+                rec = self._read_record()
+                if rec is not None:
+                    _REC_READS.inc()
+                return rec
             except resilience.FaultInjected as e:
                 self._note_bad_record(e)
                 try:

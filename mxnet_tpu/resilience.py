@@ -45,6 +45,10 @@ import time
 import zlib
 
 from .base import MXNetError
+from . import telemetry as _telemetry
+
+_RETRIES = _telemetry.counter("mxtpu_retry_total")
+_FAULTS = _telemetry.counter("mxtpu_fault_injected_total")
 
 __all__ = [
     "KNOWN_SITES", "FaultInjected", "TimeoutError",
@@ -201,6 +205,7 @@ def fault_point(site):
             return
         s.hits += 1
         hit, kind, delay = s.hits, s.kind, s.delay
+    _FAULTS.labels(site=site).inc()
     if kind == "delay":
         time.sleep(delay)
         return
@@ -287,6 +292,7 @@ def retry_call(fn, args=(), kwargs=None, retries=3,
                 if rem <= 0:
                     break
                 delay = min(delay, rem)
+            _RETRIES.labels(site=what).inc()
             if on_retry is not None:
                 on_retry(attempt + 1, e, delay)
             else:

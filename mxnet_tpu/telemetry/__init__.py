@@ -1,0 +1,74 @@
+"""Telemetry: unified metrics, tracing spans, and run reports.
+
+The process-wide observability subsystem.  The reference framework's
+only runtime window is the engine profiler's Chrome-trace dump
+(``src/engine/profiler.{h,cc}``, SURVEY §5.1); this package keeps that
+trace (spans feed it — see :mod:`mxnet_tpu.profiler`) and adds the
+aggregation layer every TPU optimization decision needs: per-step cost
+attribution, compile accounting, and the scattered robustness counters
+(bad-record skips, retries, prefetch stalls, kvstore traffic, watchdog
+restarts) absorbed into one registry.
+
+Three engines:
+
+* **metrics registry** (:mod:`.registry`) — thread-safe counters,
+  gauges, and fixed-bucket histograms with label support; every metric
+  is declared in :data:`CATALOG` (:mod:`.catalog`), and creation of an
+  undeclared name raises at the emit site;
+* **span tracer** (:mod:`.spans`) — ``telemetry.span("fwd")`` context
+  manager/decorator recording wall time per phase, wired through the
+  executor, Module, both trainers, and the IO stack, and mirrored into
+  the Chrome trace when the profiler is running;
+* **exporters** (:mod:`.exporters`) — a JSONL step-log
+  (``MXNET_TPU_TELEMETRY_JSONL``), Prometheus text format
+  (:func:`render_prom`, served on ``MXNET_TPU_TELEMETRY_PORT``), and
+  the end-of-run :func:`report` dict ``bench.py`` emits.
+
+Compile events come from ``jax.monitoring`` listeners where available
+(:mod:`.compile`), else a first-call-vs-steady-state heuristic.
+
+See ``docs/api/telemetry.md`` for the full metric catalog, env knobs,
+and exporter formats.
+"""
+from __future__ import annotations
+
+import os as _os
+
+from .catalog import CATALOG, selfcheck
+from .registry import (REGISTRY, Registry, Counter, Gauge, Histogram,
+                       counter, gauge, histogram)
+from .spans import span, drain_step_spans, step_span_totals
+from .exporters import (step_end, render_prom, report, start_http_server,
+                        jsonl_path, reset, reset_steps)
+from . import compile as compile_events
+from .exporters import _init_env_state
+
+__all__ = [
+    "CATALOG", "selfcheck",
+    "REGISTRY", "Registry", "Counter", "Gauge", "Histogram",
+    "counter", "gauge", "histogram",
+    "span", "drain_step_spans", "step_span_totals",
+    "step_end", "render_prom", "report", "start_http_server",
+    "jsonl_path", "reset", "reset_steps", "compile_events",
+]
+
+# best-effort process-wide init: compile listener (jax.monitoring) and
+# env-derived gauges.  Both are cheap and dependency-light; the http
+# endpoint starts only when MXNET_TPU_TELEMETRY_PORT is set.
+compile_events.install()
+_init_env_state()
+try:
+    _port = int(_os.environ.get("MXNET_TPU_TELEMETRY_PORT", "0"))
+except ValueError:
+    _port = 0
+if _port > 0:
+    try:
+        start_http_server(_port)
+    except (OSError, OverflowError, ValueError):
+        # OverflowError: out-of-range port (socket.bind raises it, not
+        # OSError) — an env typo must not break `import mxnet_tpu`
+        import logging as _logging
+        _logging.getLogger(__name__).warning(
+            "MXNET_TPU_TELEMETRY_PORT=%s: cannot bind the metrics "
+            "endpoint; telemetry continues without it",
+            _os.environ["MXNET_TPU_TELEMETRY_PORT"])

@@ -1,0 +1,122 @@
+"""The declared metric catalog: every metric the framework emits.
+
+Reference analogue: the profiler's fixed per-device stat tables
+(``src/engine/profiler.h:32-58``) — the set of observable quantities is
+part of the framework contract, not ad-hoc.  Each entry is
+``name -> (kind, label names, help)``; the registry refuses to create a
+metric that is not declared here (a typo'd name fails at the emit site,
+not silently in a dashboard), and ``tools/ci_check.py`` cross-checks
+this table against the hand-written catalog in
+``docs/api/telemetry.md`` in both directions — the same drift-guard
+pattern that caught the unregistered ``squeeze`` op in the op registry.
+
+Naming follows Prometheus conventions: ``_total`` counters,
+``_seconds``/``_bytes`` units, gauges unsuffixed.
+"""
+from __future__ import annotations
+
+__all__ = ["CATALOG", "COUNTER", "GAUGE", "HISTOGRAM", "selfcheck"]
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# seconds-scale latency buckets (histogram default): 0.5 ms .. 10 s
+TIME_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+# name -> (kind, labelnames tuple, help)
+CATALOG = {
+    # ------------------------------------------------- training steps
+    "mxtpu_step_total": (COUNTER, (), "training steps completed"),
+    "mxtpu_samples_total": (COUNTER, (),
+                            "samples consumed by training steps"),
+    "mxtpu_step_seconds": (HISTOGRAM, (),
+                           "host wall time per training step"),
+    "mxtpu_span_seconds": (HISTOGRAM, ("span",),
+                           "wall time per traced span (executor/module/"
+                           "trainer/io phases)"),
+    # ------------------------------------------------- XLA compilation
+    "mxtpu_compile_total": (COUNTER, (),
+                            "XLA backend compiles observed in this "
+                            "process (jax.monitoring)"),
+    "mxtpu_compile_seconds_total": (COUNTER, (),
+                                    "total XLA backend compile time"),
+    # ------------------------------------------------------------- IO
+    "mxtpu_io_records_total": (COUNTER, ("source",),
+                               "records read (source=recordio|native)"),
+    "mxtpu_io_bad_records_total": (COUNTER, ("source",),
+                                   "corrupt/truncated records skipped "
+                                   "under MXNET_TPU_BAD_RECORD_QUOTA"),
+    "mxtpu_io_resyncs_total": (COUNTER, ("source",),
+                               "magic-resync scans after a corrupt "
+                               "record"),
+    "mxtpu_io_skipped_bytes_total": (COUNTER, ("source",),
+                                     "bytes skipped while resyncing "
+                                     "past corrupt records"),
+    "mxtpu_io_prefetch_depth": (GAUGE, ("iter",),
+                                "staged batches currently queued "
+                                "(iter=host|device)"),
+    "mxtpu_io_prefetch_stall_seconds_total": (
+        COUNTER, ("iter",),
+        "time the consumer blocked waiting on the prefetcher"),
+    # -------------------------------------------------------- kvstore
+    "mxtpu_kvstore_push_bytes_total": (COUNTER, ("store",),
+                                       "gradient bytes pushed "
+                                       "(store=local|device|dist_sync|"
+                                       "dist_async)"),
+    "mxtpu_kvstore_pull_bytes_total": (COUNTER, ("store",),
+                                       "weight bytes pulled"),
+    "mxtpu_kvstore_pending_async": (GAUGE, (),
+                                    "dist_async push/pull RPCs "
+                                    "currently in flight"),
+    # ----------------------------------------------------- resilience
+    "mxtpu_retry_total": (COUNTER, ("site",),
+                          "retry attempts scheduled by "
+                          "resilience.retry_call"),
+    "mxtpu_fault_injected_total": (COUNTER, ("site",),
+                                   "armed fault_point seams that fired"),
+    "mxtpu_watchdog_restarts": (GAUGE, (),
+                                "restart attempt this process is "
+                                "running under (MXNET_TPU_RESTART_COUNT "
+                                "from tools/launch.py)"),
+    # -------------------------------------------------------- monitor
+    "mxtpu_monitor_stat": (GAUGE, ("tensor",),
+                           "latest Monitor stat value per matched "
+                           "tensor"),
+}
+
+
+def selfcheck():
+    """Validate the catalog itself; returns a list of problem strings
+    (empty = clean).  Checked: prometheus-legal metric and label names,
+    counter ``_total``/unit suffixes, no reserved label names."""
+    import re
+    problems = []
+    name_re = re.compile(r"^[a-z_][a-z0-9_]*$")
+    for name, (kind, labels, help_) in sorted(CATALOG.items()):
+        if not name_re.match(name):
+            problems.append("metric %r: illegal prometheus name" % name)
+        if not name.startswith("mxtpu_"):
+            problems.append("metric %r: missing mxtpu_ namespace" % name)
+        if kind not in (COUNTER, GAUGE, HISTOGRAM):
+            problems.append("metric %r: unknown kind %r" % (name, kind))
+        if kind == COUNTER and not name.endswith("_total"):
+            problems.append("metric %r: counters end in _total" % name)
+        if kind != COUNTER and name.endswith("_total"):
+            problems.append("metric %r: _total reserved for counters"
+                            % name)
+        if not isinstance(labels, tuple):
+            problems.append("metric %r: labelnames must be a tuple"
+                            % name)
+            continue
+        for lbl in labels:
+            if not name_re.match(lbl) or lbl.startswith("__"):
+                problems.append("metric %r: illegal label %r"
+                                % (name, lbl))
+            if lbl in ("le", "quantile"):
+                problems.append("metric %r: label %r is reserved by "
+                                "histograms/summaries" % (name, lbl))
+        if not help_:
+            problems.append("metric %r: empty help string" % name)
+    return problems

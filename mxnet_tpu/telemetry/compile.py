@@ -1,0 +1,59 @@
+"""XLA compile-event capture.
+
+A Learned Performance Model for TPUs (PAPERS.md) treats compile count /
+time as first-class run facts: an unexpected recompile per step is the
+single most common TPU performance bug.  Two capture modes:
+
+* **jax.monitoring** (preferred): JAX emits a
+  ``/jax/core/compile/backend_compile_duration`` duration event per
+  backend compile; a process-wide listener feeds
+  ``mxtpu_compile_total`` / ``mxtpu_compile_seconds_total``.
+* **first-call heuristic** (fallback when the listener API is absent):
+  ``report()`` classifies steps whose wall time dwarfs the steady-state
+  median as compile-inflated — see
+  :func:`mxnet_tpu.telemetry.exporters.report`.
+"""
+from __future__ import annotations
+
+from .registry import counter
+
+__all__ = ["install", "installed"]
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_installed = None   # None = not attempted, True/False = outcome
+
+
+def install():
+    """Register the jax.monitoring duration listener once per process.
+    Returns True when listening, False when the API is unavailable
+    (report() then falls back to the step-time heuristic)."""
+    global _installed
+    if _installed is not None:
+        return _installed
+    try:
+        from jax import monitoring
+        register = monitoring.register_event_duration_secs_listener
+    except (ImportError, AttributeError):
+        _installed = False
+        return False
+    c_total = counter("mxtpu_compile_total")
+    c_secs = counter("mxtpu_compile_seconds_total")
+
+    def _on_duration(name, dur, **kwargs):
+        if name == _COMPILE_EVENT:
+            c_total.inc()
+            c_secs.inc(float(dur))
+
+    try:
+        register(_on_duration)
+    except TypeError:
+        # listener signature changed under us: degrade to the heuristic
+        _installed = False
+        return False
+    _installed = True
+    return True
+
+
+def installed():
+    """True when the jax.monitoring listener is active."""
+    return bool(_installed)

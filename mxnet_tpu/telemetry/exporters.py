@@ -1,0 +1,352 @@
+"""Exporters: JSONL step-log, Prometheus text format, end-of-run report.
+
+Three consumers of the same registry, mirroring how TVM's autotuning
+loop (PAPERS.md) is driven by measured structured run records rather
+than log scraping:
+
+* :func:`step_end` — the per-step emitter every training loop calls
+  (Module.fit, ShardedTrainer.step, bench.py).  It advances the step
+  counters/histograms and, when ``MXNET_TPU_TELEMETRY_JSONL`` names a
+  file, appends ONE json line per step carrying the step time, that
+  step's span timings, and a full counter/gauge snapshot.
+* :func:`render_prom` — Prometheus text exposition of every metric,
+  served by :func:`start_http_server` (``MXNET_TPU_TELEMETRY_PORT``).
+* :func:`report` — the end-of-run dict (step-time percentiles,
+  throughput, compile count/time, per-phase breakdown) that
+  ``bench.py`` embeds in its ``BENCH_*.json`` output.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .catalog import COUNTER, GAUGE, HISTOGRAM
+from .registry import REGISTRY, counter, gauge, histogram
+from . import compile as compile_mod
+from .spans import drain_step_spans
+
+__all__ = ["step_end", "render_prom", "report", "start_http_server",
+           "jsonl_path", "reset", "reset_steps"]
+
+# retained step durations for percentiles (bounded: ~12h at 10 steps/s)
+_MAX_DURS = 500_000
+_lock = threading.Lock()
+_step_durs = deque(maxlen=_MAX_DURS)
+_jsonl = {"path": None, "fh": None}
+# compile count/time already attributed by the first-call heuristic in
+# windows discarded by reset_steps() (no jax.monitoring listener only)
+_heur_carry = {"count": 0, "time": 0.0}
+
+
+def jsonl_path():
+    """Current step-log destination (``MXNET_TPU_TELEMETRY_JSONL``), or
+    None when the step-log is off."""
+    return os.environ.get("MXNET_TPU_TELEMETRY_JSONL") or None
+
+
+def _jsonl_handle():
+    """Open/rotate/close the step-log handle to match the env var (a
+    test or a launcher may change it mid-process).  An unwritable path
+    disables the step-log with one warning — the observability layer
+    must never kill the training loop it observes."""
+    path = jsonl_path()
+    if path != _jsonl["path"]:
+        if _jsonl["fh"] is not None:
+            try:
+                _jsonl["fh"].close()
+            except OSError:
+                pass
+        fh = None
+        if path:
+            try:
+                fh = open(path, "a")
+            except OSError as e:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "MXNET_TPU_TELEMETRY_JSONL=%r cannot be opened "
+                    "(%s); step-log disabled for this run", path, e)
+        # record the path even on failure so the open is not retried
+        # (and the warning not repeated) on every subsequent step
+        _jsonl["fh"] = fh
+        _jsonl["path"] = path
+    return _jsonl["fh"]
+
+
+def step_end(samples=None, step_time=None, extra=None, count=1):
+    """Mark ``count`` training steps complete (1 for ordinary loops;
+    ``ShardedTrainer.run_steps`` passes its scan length, since the
+    chain IS count full optimizer updates observed once from the host).
+
+    ``samples``: samples PER STEP (feeds throughput); ``step_time``:
+    host wall seconds per step (feeds the ``mxtpu_step_seconds``
+    histogram and the percentile window); ``extra``: dict merged into
+    the JSONL record (trainers attach e.g. the loss).  Emits ONE JSONL
+    record per call — a ``count`` > 1 record carries the whole chain's
+    span timings and says so via its ``count`` field.  Cheap when the
+    JSONL is off: three counter updates."""
+    count = max(1, int(count))
+    counter("mxtpu_step_total").inc(count)
+    if samples:
+        counter("mxtpu_samples_total").inc(samples * count)
+    if step_time is not None:
+        h = histogram("mxtpu_step_seconds")
+        for _ in range(count):
+            h.observe(step_time)
+        with _lock:
+            _step_durs.extend([float(step_time)] * count)
+    spans = drain_step_spans()
+    with _lock:
+        fh = _jsonl_handle()
+        if fh is None:
+            return
+        rec = {
+            "ts": round(time.time(), 6),
+            "step": int(counter("mxtpu_step_total").get()),
+            "step_time_s": step_time,
+            "samples": samples,
+            "spans": spans,
+            "counters": REGISTRY.flat(kinds=(COUNTER,)),
+            "gauges": REGISTRY.flat(kinds=(GAUGE,)),
+        }
+        if count > 1:
+            rec["count"] = count
+        if extra:
+            rec.update(extra)
+        fh.write(json.dumps(rec) + "\n")
+        fh.flush()
+
+
+# ------------------------------------------------------------- prometheus
+
+def _escape(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(key, extra=None):
+    parts = ['%s="%s"' % (k, _escape(v)) for k, v in key]
+    if extra:
+        parts.extend('%s="%s"' % (k, _escape(v))
+                     for k, v in extra.items())
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+def _fmt_num(x):
+    if x == float("inf"):
+        return "+Inf"
+    f = float(x)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_prom():
+    """The registry in Prometheus text exposition format (v0.0.4):
+    ``# HELP`` / ``# TYPE`` headers, cumulative ``_bucket`` series with
+    ``le`` labels for histograms."""
+    lines = []
+    for name, m in sorted(REGISTRY.metrics().items()):
+        samples = m.samples()
+        if not samples:
+            continue
+        lines.append("# HELP %s %s" % (name, _escape(m.help)))
+        lines.append("# TYPE %s %s" % (name, m.kind))
+        for key, val in sorted(samples.items()):
+            if m.kind == HISTOGRAM:
+                cum = 0
+                bounds = list(m.buckets) + [float("inf")]
+                for ub, n in zip(bounds, val["buckets"]):
+                    cum += n
+                    lines.append("%s_bucket%s %s" % (
+                        name, _fmt_labels(key, {"le": _fmt_num(ub)}),
+                        cum))
+                lines.append("%s_sum%s %s"
+                             % (name, _fmt_labels(key),
+                                _fmt_num(val["sum"])))
+                lines.append("%s_count%s %s"
+                             % (name, _fmt_labels(key), val["count"]))
+            else:
+                lines.append("%s%s %s" % (name, _fmt_labels(key),
+                                          _fmt_num(val)))
+    return "\n".join(lines) + "\n"
+
+
+_server = {"httpd": None, "thread": None}
+
+
+def start_http_server(port=None):
+    """Serve ``render_prom()`` on ``/metrics`` from a daemon thread
+    (stdlib only).  ``port=None`` reads ``MXNET_TPU_TELEMETRY_PORT``;
+    0 binds an ephemeral port.  Returns the server object (its
+    ``server_address[1]`` is the bound port); idempotent per process.
+    """
+    if _server["httpd"] is not None:
+        return _server["httpd"]
+    if port is None:
+        try:
+            port = int(os.environ.get("MXNET_TPU_TELEMETRY_PORT", "0"))
+        except ValueError:
+            port = 0
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            body = render_prom().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass   # scrapes must not spam the training log
+
+    httpd = ThreadingHTTPServer(("0.0.0.0", int(port)), _Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="mxtpu-telemetry-http")
+    t.start()
+    _server["httpd"] = httpd
+    _server["thread"] = t
+    return httpd
+
+
+# ----------------------------------------------------------------- report
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _heuristic_compiles(durs):
+    """First-call-vs-steady-state estimate: steps whose wall time dwarfs
+    the median are counted as compile-inflated, the excess over the
+    median as compile time.  Used only when jax.monitoring is absent."""
+    if len(durs) < 2:
+        return 0, 0.0
+    s = sorted(durs)
+    p50 = _percentile(s, 0.50)
+    thresh = max(4.0 * p50, p50 + 0.05)
+    hits = [d for d in durs if d > thresh]
+    return len(hits), sum(d - p50 for d in hits)
+
+
+def report():
+    """End-of-run summary dict: step count + step-time percentiles,
+    throughput (samples/sec and records/sec over summed step time),
+    compile count/time, per-phase span breakdown, and the full counter
+    snapshot.  ``tools/bench.py`` embeds this in its JSON output."""
+    with _lock:
+        durs = list(_step_durs)
+    sdurs = sorted(durs)
+    total_time = sum(durs)
+    steps = int(counter("mxtpu_step_total").get())
+    samples = counter("mxtpu_samples_total").get()
+    records = sum(counter("mxtpu_io_records_total").samples().values())
+
+    if compile_mod.installed():
+        compile_count = int(counter("mxtpu_compile_total").get())
+        compile_time = counter("mxtpu_compile_seconds_total").get()
+        compile_source = "jax.monitoring"
+    else:
+        compile_count, compile_time = _heuristic_compiles(durs)
+        with _lock:
+            compile_count += _heur_carry["count"]
+            compile_time += _heur_carry["time"]
+        compile_source = "heuristic"
+
+    phases = {}
+    for key, val in histogram("mxtpu_span_seconds").samples().items():
+        name = dict(key).get("span", "?")
+        phases[name] = {
+            "count": val["count"],
+            "total_s": round(val["sum"], 6),
+            "mean_s": round(val["sum"] / max(1, val["count"]), 6),
+        }
+
+    return {
+        "steps": steps,
+        "step_time_s": {
+            "p50": round(_percentile(sdurs, 0.50), 6),
+            "p90": round(_percentile(sdurs, 0.90), 6),
+            "p99": round(_percentile(sdurs, 0.99), 6),
+            "mean": round(total_time / len(durs), 6) if durs else 0.0,
+            "min": round(sdurs[0], 6) if sdurs else 0.0,
+            "max": round(sdurs[-1], 6) if sdurs else 0.0,
+        },
+        "throughput": {
+            "samples_per_sec": round(samples / total_time, 3)
+            if total_time else 0.0,
+            "records_per_sec": round(records / total_time, 3)
+            if total_time else 0.0,
+        },
+        "compile": {
+            "count": compile_count,
+            "total_s": round(float(compile_time), 6),
+            "source": compile_source,
+        },
+        "phases": phases,
+        "counters": REGISTRY.flat(kinds=(COUNTER,)),
+    }
+
+
+def reset_steps():
+    """Clear only the per-step window — step/samples counters, the
+    step-time histogram/percentiles, the span histogram and per-step
+    accumulator — keeping process-lifetime counters (compile, IO,
+    kvstore, resilience) intact.  ``bench.py`` calls this after its
+    warmup/compile steps so the reported percentiles and throughput
+    cover exactly the timed loop, while compile accounting still spans
+    the whole process (the heuristic fallback carries the discarded
+    window's compile attribution forward)."""
+    drain_step_spans()
+    counter("mxtpu_step_total")._clear()
+    counter("mxtpu_samples_total")._clear()
+    histogram("mxtpu_step_seconds")._clear()
+    histogram("mxtpu_span_seconds")._clear()
+    with _lock:
+        if not compile_mod.installed() and _step_durs:
+            # without jax.monitoring the first-call heuristic is the
+            # only compile signal, and it lives in the durations being
+            # discarded — bank its estimate so report() keeps it
+            c, t = _heuristic_compiles(list(_step_durs))
+            _heur_carry["count"] += c
+            _heur_carry["time"] += t
+        _step_durs.clear()
+
+
+def reset():
+    """Clear every sample, the percentile window, the per-step span
+    accumulator, and the step-log handle (the env var is re-read on the
+    next step).  Metric objects and cached label children stay valid."""
+    REGISTRY.reset()
+    drain_step_spans()
+    with _lock:
+        _step_durs.clear()
+        _heur_carry["count"] = 0
+        _heur_carry["time"] = 0.0
+        if _jsonl["fh"] is not None:
+            try:
+                _jsonl["fh"].close()
+            except OSError:
+                pass
+        _jsonl["fh"] = None
+        _jsonl["path"] = None
+    _init_env_state()
+
+
+def _init_env_state():
+    """Seed env-derived gauges: the watchdog restart attempt this
+    process runs under (tools/launch.py resume contract)."""
+    try:
+        restarts = int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
+    except ValueError:
+        restarts = 0
+    gauge("mxtpu_watchdog_restarts").set(restarts)

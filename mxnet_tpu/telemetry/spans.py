@@ -1,0 +1,108 @@
+"""Span tracer: wall-time scopes feeding metrics AND the Chrome trace.
+
+``telemetry.span("fwd")`` is a context manager and a decorator.  Every
+span records its wall time into the ``mxtpu_span_seconds`` histogram
+(labeled by span name — the per-phase breakdown ``report()`` prints)
+and into the per-step accumulator the JSONL step-log drains; when the
+profiler is running (``profiler_set_state('run')``) the same interval
+is appended to the Chrome trace via :func:`mxnet_tpu.profiler.
+record_event`, so telemetry spans and the reference-parity operator
+events land in ONE trace file.
+
+Spans nest freely (executor.forward inside module.forward inside a fit
+step); each level is recorded independently, and the trace event
+carries the thread id so concurrent prefetcher/consumer spans render on
+separate trace rows.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+from .. import profiler
+from .registry import histogram
+
+__all__ = ["span", "drain_step_spans", "step_span_totals"]
+
+_SPAN_HIST = None          # created lazily (after catalog import settles)
+_step_lock = threading.Lock()
+_step_spans = {}           # name -> [total_seconds, count] since last step
+
+
+def _hist():
+    global _SPAN_HIST
+    if _SPAN_HIST is None:
+        _SPAN_HIST = histogram("mxtpu_span_seconds")
+    return _SPAN_HIST
+
+
+class span:
+    """Time a scope::
+
+        with telemetry.span("fwd"):
+            ...
+
+    or decorate a function::
+
+        @telemetry.span("data.fetch")
+        def next_batch(): ...
+
+    One instance may be shared (the decorator form re-enters it from
+    many threads): enter state lives on a per-instance thread-local
+    stack, not on the instance itself.
+    """
+
+    def __init__(self, name, category="span"):
+        self.name = name
+        self.category = category
+        self._tls = threading.local()
+
+    def __enter__(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append((time.perf_counter(), profiler.now_us()))
+        return self
+
+    def __exit__(self, *exc):
+        t0, start_us = self._tls.stack.pop()
+        dur = time.perf_counter() - t0
+        _hist().labels(span=self.name).observe(dur)
+        with _step_lock:
+            acc = _step_spans.get(self.name)
+            if acc is None:
+                _step_spans[self.name] = [dur, 1]
+            else:
+                acc[0] += dur
+                acc[1] += 1
+        if profiler.is_running():
+            profiler.record_event(
+                self.name, start_us, dur * 1e6, category=self.category,
+                tid=threading.get_ident() % (1 << 31))
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self:
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def drain_step_spans():
+    """Spans accumulated since the last drain, as
+    ``{name: {"total_s": s, "count": n}}`` — consumed by the JSONL
+    step-log so each record carries that step's phase timings."""
+    with _step_lock:
+        out = {name: {"total_s": v[0], "count": v[1]}
+               for name, v in _step_spans.items()}
+        _step_spans.clear()
+    return out
+
+
+def step_span_totals():
+    """Non-draining view of the current per-step accumulator."""
+    with _step_lock:
+        return {name: {"total_s": v[0], "count": v[1]}
+                for name, v in _step_spans.items()}

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Entry shim: ``python tools/bench.py [--dry-run]`` runs the repo-root
+benchmark (bench.py) with the repo on sys.path, so the bench is
+reachable from the tools/ directory like every other tool.  See the
+root ``bench.py`` docstring for knobs (BENCH_*) and the emitted JSON
+shape (incl. the standardized ``telemetry`` report block)."""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    sys.path.insert(0, _ROOT)
+    runpy.run_path(os.path.join(_ROOT, "bench.py"), run_name="__main__")

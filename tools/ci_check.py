@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Three stages, all of which must be clean:
+Four stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -13,6 +13,10 @@ Three stages, all of which must be clean:
 3. **graph verifier** over every model-zoo entry with its canonical
    input shape — zero diagnostics expected (warnings included: the zoo
    is the reference corpus, it must be spotless).
+4. **telemetry self-check** — the catalog validates
+   (:func:`mxnet_tpu.telemetry.selfcheck`) and every metric name in
+   ``docs/api/telemetry.md`` exists in ``telemetry.CATALOG`` and vice
+   versa (the drift-guard pattern that caught ``squeeze`` in PR 2).
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -21,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -47,7 +52,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/3] mxlint: %d finding(s) over %s"
+        say("ci_check[1/4] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -56,7 +61,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/3] registry selfcheck: %d problem(s)"
+        say("ci_check[2/4] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -70,13 +75,59 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/3] verify model %-22s %s" % (name, status))
+            say("ci_check[3/4] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
+
+        # stage 4: telemetry catalog vs docs drift guard
+        problems = telemetry_drift(repo_root)
+        say("ci_check[4/4] telemetry selfcheck: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("telemetry: %s" % p)
+            say("  " + p)
     finally:
         sys.path.remove(repo_root)
     return failures
+
+
+def telemetry_drift(repo_root=_ROOT):
+    """Cross-check the code metric catalog (``telemetry.CATALOG``)
+    against the hand-written one in ``docs/api/telemetry.md``, both
+    directions, plus the catalog's own self-validation.  Returns a list
+    of problem strings (empty = clean).
+
+    Doc names are every `` `mxtpu_*` `` token in the page; derived
+    histogram series (``_bucket``/``_sum``/``_count`` of a declared
+    histogram) are accepted as documentation of their parent."""
+    from mxnet_tpu import telemetry
+    problems = list(telemetry.selfcheck())
+    doc_path = os.path.join(repo_root, "docs", "api", "telemetry.md")
+    if not os.path.exists(doc_path):
+        problems.append("docs/api/telemetry.md is missing (the "
+                        "hand-written metric catalog)")
+        return problems
+    with open(doc_path) as f:
+        text = f.read()
+    doc_names = set(re.findall(r"`(mxtpu_[a-z0-9_]+)`", text))
+    code_names = set(telemetry.CATALOG)
+
+    def _derived(name):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    name[:-len(suffix)] in code_names:
+                return True
+        return False
+
+    for name in sorted(code_names - doc_names):
+        problems.append("metric %r is registered in telemetry.CATALOG "
+                        "but missing from docs/api/telemetry.md" % name)
+    for name in sorted(doc_names - code_names):
+        if not _derived(name):
+            problems.append("metric %r appears in docs/api/telemetry.md "
+                            "but is not in telemetry.CATALOG" % name)
+    return problems
 
 
 def main(argv=None):

@@ -126,6 +126,30 @@ def launch_local(opts, command):
             "launch.py: restarting job (attempt %d/%d) from the last "
             "complete checkpoint\n" % (attempt, opts.restart_budget))
         sys.stderr.flush()
+        _note_restart(attempt)
+
+
+def _note_restart(attempt):
+    """Surface a watchdog restart in the telemetry stream.
+
+    The launcher stays stdlib-only (importing the framework here would
+    drag jax into the supervisor), so it appends a supervisor event to
+    the JSONL step-log directly; the relaunched workers additionally
+    expose the attempt as the ``mxtpu_watchdog_restarts`` gauge via
+    MXNET_TPU_RESTART_COUNT (read at telemetry init)."""
+    path = os.environ.get("MXNET_TPU_TELEMETRY_JSONL")
+    if not path:
+        return
+    import json
+    import time
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({"ts": round(time.time(), 6),
+                                "event": "watchdog_restart",
+                                "attempt": attempt}) + "\n")
+    except OSError as e:
+        sys.stderr.write("launch.py: cannot append telemetry event to "
+                         "%s: %s\n" % (path, e))
 
 
 def launch_ssh(opts, command):
