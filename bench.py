@@ -186,8 +186,9 @@ def main():
 
 def _emit(result):
     """Attach the standardized telemetry report (step-time percentiles,
-    throughput, compile count — the BENCH trajectory fields) and print
-    the one-line JSON artifact."""
+    throughput, compile count, and the HBM block: static memory plans
+    per compiled program + peak live memory_stats — the BENCH
+    trajectory fields) and print the one-line JSON artifact."""
     from mxnet_tpu import telemetry
     rep = telemetry.report()
     result["telemetry"] = {
@@ -196,6 +197,10 @@ def _emit(result):
         "throughput": rep["throughput"],
         "compile": rep["compile"],
         "phases": rep["phases"],
+        # perf trajectory tracks HBM next to step time: the plan is the
+        # compile-time footprint, "live" the measured bytes_in_use/peak
+        # (None on backends without memory_stats, e.g. CPU smoke)
+        "memory": rep["memory"],
     }
     print(json.dumps(result))
 

@@ -21,11 +21,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
 def measure(mirror, batch, layers, image):
+    """The fused step's memory plan with mirroring on/off, via the
+    shared version-tolerant accessor (telemetry.memory.plan_of) —
+    no private memory_analysis() probing here."""
     os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1" if mirror else "0"
     import jax
     import jax.numpy as jnp
     from mxnet_tpu import models
     from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+    from mxnet_tpu.telemetry import memory as tmem
 
     net = models.get_model("resnet%d" % layers, num_classes=1000,
                            image_shape="3,%d,%d" % (image, image))
@@ -40,8 +44,8 @@ def measure(mirror, batch, layers, image):
     lowered = t._step_fn.lower(t.params, t.opt_state, t.aux, db,
                                jax.random.PRNGKey(0), jnp.float32(0.1),
                                jnp.float32(1))
-    ma = lowered.compile().memory_analysis()
-    return ma
+    return tmem.plan_of(lowered.compile(),
+                        "memcost.mirror=%s" % mirror)
 
 
 def main():
@@ -51,14 +55,16 @@ def main():
     p.add_argument("--image", type=int, default=224)
     args = p.parse_args()
     for mirror in (False, True):
-        ma = measure(mirror, args.batch, args.layers, args.image)
-        if ma is None:
+        plan = measure(mirror, args.batch, args.layers, args.image)
+        if plan is None or not plan.memory:
             print("mirror=%s: backend reports no memory analysis" % mirror)
             continue
+        m = plan.memory
         print("mirror=%-5s temp=%8.1f MB  args=%8.1f MB  out=%8.1f MB"
-              % (mirror, ma.temp_size_in_bytes / 1e6,
-                 ma.argument_size_in_bytes / 1e6,
-                 ma.output_size_in_bytes / 1e6))
+              "  total=%8.1f MB"
+              % (mirror, m.get("temp", 0) / 1e6,
+                 m.get("argument", 0) / 1e6,
+                 m.get("output", 0) / 1e6, plan.total_bytes / 1e6))
 
 
 if __name__ == "__main__":

@@ -122,6 +122,23 @@ _CATALOG = {
                                  "serve Prometheus text metrics on "
                                  "http://0.0.0.0:PORT/metrics "
                                  "(0 = off)"),
+    "MXNET_TPU_FLIGHT_DIR": ("", "honored",
+                             "write flight-recorder black-box dumps "
+                             "here on MXNetError/OOM/SIGTERM/crash "
+                             "(recording itself is always on; "
+                             "tools/flight_read.py pretty-prints)"),
+    "MXNET_TPU_FLIGHT_EVENTS": ("512", "honored",
+                                "flight-recorder ring capacity "
+                                "(oldest events fall off)"),
+    "MXNET_TPU_MEMORY_BUDGET": ("1.0", "honored",
+                                "fraction of device capacity a "
+                                "compiled program's static memory "
+                                "plan may use before dispatch raises "
+                                "(<=0 disables the budget check)"),
+    "MXNET_TPU_HBM_LIMIT_BYTES": ("", "honored",
+                                  "device-capacity override for the "
+                                  "memory budget check on backends "
+                                  "without memory_stats (CPU tests)"),
 }
 
 

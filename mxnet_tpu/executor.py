@@ -123,6 +123,10 @@ class Executor:
         self._last_train = False
         self._fwd_cache = {}
         self._bwd_cache = {}
+        # AOT executables keyed (program, id(jit fn)) — the memory plan
+        # comes from the same compile that runs the graph (see
+        # telemetry.memory.planned_executable)
+        self._aot_exes = {}
         # is_loss flag per head (loss heads seed ones, others zeros, when
         # backward() is called without explicit head gradients)
         self._head_is_loss = tuple(
@@ -304,6 +308,17 @@ class Executor:
         self._bwd_cache["fused"] = fn
         return fn
 
+    def _dispatch(self, program, fn, args):
+        """Run a compiled graph function through its AOT executable,
+        registering/budget-checking its memory plan on first use and
+        annotating a backend RESOURCE_EXHAUSTED with the plan + live
+        HBM forensics (telemetry.memory.dispatch_planned semantics:
+        aval drift downgrades to the jit wrapper permanently)."""
+        from .telemetry import memory as _tmem
+        with _tmem.annotate_oom(program):
+            return _tmem.dispatch_planned(self._aot_exes, program, fn,
+                                          args)
+
     def forward_backward(self, **kwargs):
         """Fused training step: outputs + gradients in one XLA program.
         Equivalent to forward(is_train=True) followed by backward()."""
@@ -326,7 +341,8 @@ class Executor:
         self._last_key = key
         self._last_train = True
         fn = self._get_fused_fn()
-        heads, aux_out, grads = fn(self._gather_vals(), key)
+        heads, aux_out, grads = self._dispatch(
+            "executor.fused", fn, (self._gather_vals(), key))
         for n, upd in zip(self._aux_names, aux_out):
             self.aux_dict[n]._set_data(upd)
         diff_names = [n for n in self._arg_names
@@ -373,7 +389,8 @@ class Executor:
             heads, aux_out = self._forward_monitored(is_train, key)
         else:
             fn = self._get_forward_fn(bool(is_train))
-            heads, aux_out = fn(self._gather_vals(), key)
+            heads, aux_out = self._dispatch(
+                "executor.forward", fn, (self._gather_vals(), key))
         if is_train:
             for n, upd in zip(self._aux_names, aux_out):
                 self.aux_dict[n]._set_data(upd)
@@ -419,7 +436,8 @@ class Executor:
         fn = self._get_backward_fn(with_heads)
         og = tuple(g.data if isinstance(g, NDArray) else g
                    for g in (out_grads or ()))
-        grads = fn(self._gather_vals(), self._last_key, og)
+        grads = self._dispatch("executor.backward", fn,
+                               (self._gather_vals(), self._last_key, og))
 
         diff_names = [n for n in self._arg_names
                       if self._grad_req[n] != "null"]

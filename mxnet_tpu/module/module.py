@@ -392,10 +392,14 @@ class Module(BaseModule):
     def forward_backward(self, data_batch):
         """Fused train step (reference runs forward and backward as
         separate engine pushes; here one XLA program shares the forward
-        between primal and vjp)."""
+        between primal and vjp).  An MXNetError here — including an
+        executor-annotated RESOURCE_EXHAUSTED — dumps the flight
+        recorder's black box when MXNET_TPU_FLIGHT_DIR is set."""
         assert self.binded and self.params_initialized
         from .. import telemetry
-        with telemetry.span("module.forward_backward", category="module"):
+        from ..telemetry import flight as _flight
+        with telemetry.span("module.forward_backward", category="module"), \
+                _flight.crash_guard("module.forward_backward"):
             self._exec_group.forward_backward(data_batch)
 
     def backward(self, out_grads=None):

@@ -282,6 +282,9 @@ class AsyncKVStore(KVStore):
         finally:
             _PENDING.dec()
         if resp[0] == "err":
+            telemetry.flight.record("kvstore", op="rpc_error",
+                                    store="dist_async", server=int(sidx),
+                                    message=str(resp[1])[:500])
             raise MXNetError("dist_async server %d: %s" % (sidx, resp[1]))
         return resp[1] if len(resp) > 1 else None
 

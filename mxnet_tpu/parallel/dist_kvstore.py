@@ -114,13 +114,21 @@ class DistKVStore(KVStore):
         keys, vals = _ctype_key_value(key, value)
         uniq, grouped = _group_kv_pairs(keys, vals)
         merged = {}
+        push_bytes = 0
         for k, group in zip(uniq, grouped):
             m = group[0].copy()
             for other in group[1:]:
                 m += other
             merged[k] = m
-            self._push_bytes.inc(_nbytes(m))
+            push_bytes += _nbytes(m)
+        self._push_bytes.inc(push_bytes)
         if self._num_workers > 1:
+            # cross-host collective: worth a flight-ring entry (a hang
+            # or peer death surfaces here), unlike the per-param local
+            # aggregation above
+            from ..telemetry import flight as _flight
+            _flight.record("kvstore", op="allreduce", store="dist_sync",
+                           keys=len(merged), bytes=push_bytes)
             summed = self.allreduce({k: m.data for k, m in merged.items()})
             # addressable_data(0) is this host's replica of the reduced
             # value — no host round trip

@@ -496,6 +496,12 @@ class ShardedTrainer:
 
         self._step_fn = self._build_step()
         self._scan_fns = {}
+        # AOT executables dispatched in place of the jit wrappers, keyed
+        # (program, id(fn)): the memory plan comes from the SAME compile
+        # that runs the step (jax shares no cache between lower().
+        # compile() and jit calls, so a separate analysis compile would
+        # double every compile)
+        self._aot_exes = {}
         self._fwd_fn = None
         self._step_count = 0
         # epoch this trainer resumed from (load_checkpoint sets it):
@@ -1230,6 +1236,7 @@ class ShardedTrainer:
                 self.opt_state = self._device_zero_slots()
         self._step_fn = self._build_step()
         self._scan_fns = {}
+        self._aot_exes = {}
         self._hyper_snapshot = self._hyper_state()
 
     def _cast_batch(self, batch):
@@ -1356,11 +1363,22 @@ class ShardedTrainer:
 
         Telemetry: each call is a ``trainer.step`` span and one
         ``step_end`` record (step time is host-side dispatch+staging —
-        on an async backend the device may still be computing)."""
+        on an async backend the device may still be computing).  The
+        first call registers the compiled step's memory plan
+        (``mxtpu_memory_plan_bytes{program="trainer.step"}``) and
+        budget-checks it before dispatch; a backend RESOURCE_EXHAUSTED
+        is re-raised with the plan + live-bytes forensics attached, and
+        any MXNetError dumps the flight recorder's black box
+        (MXNET_TPU_FLIGHT_DIR)."""
         import time as _time
         from .. import telemetry
+        from ..telemetry import flight as _flight, memory as _tmem
+        _flight.record("step_begin", program="trainer.step",
+                       step=self._step_count + 1)
         t0 = _time.perf_counter()
-        with telemetry.span("trainer.step", category="trainer"):
+        with telemetry.span("trainer.step", category="trainer"), \
+                _flight.crash_guard("trainer.step"), \
+                _tmem.annotate_oom("trainer.step"):
             loss = self._step_impl(batch)
         telemetry.step_end(samples=self._batch_samples(batch),
                            step_time=_time.perf_counter() - t0)
@@ -1373,9 +1391,22 @@ class ShardedTrainer:
         except (StopIteration, AttributeError, IndexError, TypeError):
             return 0
 
+    def _dispatch_planned(self, program, fn, args):
+        """Dispatch through the AOT executable with the memory plan
+        registered + budget-checked on first use
+        (telemetry.memory.dispatch_planned).  Process-spanning meshes
+        keep the plain jit dispatch (AOT example staging is a
+        per-process choice)."""
+        if self._multiproc:
+            return fn(*args)
+        from ..telemetry import memory as _tmem
+        return _tmem.dispatch_planned(self._aot_exes, program, fn, args)
+
     def _step_impl(self, batch):
         import jax
         import jax.numpy as jnp
+        from .. import resilience
+        resilience.fault_point("trainer.step")
         self._key, sub = jax.random.split(self._key)
         first = next(iter(batch.values()))
         if isinstance(first, jax.Array):
@@ -1392,9 +1423,10 @@ class ShardedTrainer:
         lr = (opt.lr_scheduler(opt.num_update)
               if opt.lr_scheduler is not None else opt.lr)
         self._ensure_state_formats(self._step_fn)
-        self.params, self.opt_state, self.aux, loss = self._step_fn(
-            self.params, self.opt_state, self.aux, dev_batch, sub,
-            jnp.float32(lr), jnp.float32(opt.num_update))
+        args = (self.params, self.opt_state, self.aux, dev_batch, sub,
+                jnp.float32(lr), jnp.float32(opt.num_update))
+        self.params, self.opt_state, self.aux, loss = \
+            self._dispatch_planned("trainer.step", self._step_fn, args)
         return loss
 
     def run_steps(self, batch, num_steps):
@@ -1414,8 +1446,13 @@ class ShardedTrainer:
         """
         import time as _time
         from .. import telemetry
+        from ..telemetry import flight as _flight, memory as _tmem
+        _flight.record("step_begin", program="trainer.run_steps",
+                       step=self._step_count + 1, count=num_steps)
         t0 = _time.perf_counter()
-        with telemetry.span("trainer.run_steps", category="trainer"):
+        with telemetry.span("trainer.run_steps", category="trainer"), \
+                _flight.crash_guard("trainer.run_steps"), \
+                _tmem.annotate_oom("trainer.run_steps"):
             losses = self._run_steps_impl(batch, num_steps)
         # the scan chain IS num_steps full optimizer updates observed
         # once from the host: counters/percentiles advance per inner
@@ -1451,10 +1488,11 @@ class ShardedTrainer:
                        if opt.lr_scheduler is not None else opt.lr)
         self._key, sub = jax.random.split(self._key)
         self._ensure_state_formats(fn)
-        self.params, self.opt_state, self.aux, losses = fn(
-            self.params, self.opt_state, self.aux, dev_batch, sub,
-            jnp.asarray(_np.asarray(lrs, _np.float32)),
-            jnp.asarray(_np.asarray(ts, _np.float32)))
+        args = (self.params, self.opt_state, self.aux, dev_batch, sub,
+                jnp.asarray(_np.asarray(lrs, _np.float32)),
+                jnp.asarray(_np.asarray(ts, _np.float32)))
+        self.params, self.opt_state, self.aux, losses = \
+            self._dispatch_planned("trainer.run_steps", fn, args)
         return losses
 
     def forward(self, batch, is_train=False):
@@ -1740,6 +1778,13 @@ class ShardedTrainer:
                 logging.warning(
                     "preemption signal %d: checkpointing to %r epoch "
                     "%d and exiting", signum, prefix, epoch)
+                # black box first: if the grace window expires mid-save
+                # the flight dump still tells the postmortem what the
+                # run was doing when the preemption landed
+                from ..telemetry import flight as _flight
+                _flight.record("preemption", signum=int(signum),
+                               epoch=epoch)
+                _flight.dump("sigterm")
                 self.save_checkpoint(
                     prefix, epoch,
                     save_optimizer_states=save_optimizer_states)

@@ -66,6 +66,7 @@ __all__ = [
 KNOWN_SITES = (
     "recordio.read", "checkpoint.save", "checkpoint.load",
     "multihost.init", "multihost.barrier", "io.prefetch",
+    "trainer.step",
 )
 
 
@@ -206,6 +207,7 @@ def fault_point(site):
         s.hits += 1
         hit, kind, delay = s.hits, s.kind, s.delay
     _FAULTS.labels(site=site).inc()
+    _telemetry.flight.record("fault", site=site, hit=hit, fault_kind=kind)
     if kind == "delay":
         time.sleep(delay)
         return

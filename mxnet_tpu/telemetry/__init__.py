@@ -22,7 +22,16 @@ Three engines:
 * **exporters** (:mod:`.exporters`) — a JSONL step-log
   (``MXNET_TPU_TELEMETRY_JSONL``), Prometheus text format
   (:func:`render_prom`, served on ``MXNET_TPU_TELEMETRY_PORT``), and
-  the end-of-run :func:`report` dict ``bench.py`` emits.
+  the end-of-run :func:`report` dict ``bench.py`` emits;
+* **memory observability** (:mod:`.memory`) — static XLA memory plans
+  per compiled program (``memory_analysis``/``cost_analysis`` gauges),
+  live ``device.memory_stats()`` sampling at step boundaries, a
+  pre-dispatch budget check (``MXNET_TPU_MEMORY_BUDGET``), and
+  ``RESOURCE_EXHAUSTED`` annotation with plan + live-buffer forensics;
+* **flight recorder** (:mod:`.flight`) — a bounded ring of recent
+  structured events dumped to a JSON black box
+  (``MXNET_TPU_FLIGHT_DIR``) on MXNetError/OOM/SIGTERM/crash;
+  ``tools/flight_read.py`` pretty-prints a dump.
 
 Compile events come from ``jax.monitoring`` listeners where available
 (:mod:`.compile`), else a first-call-vs-steady-state heuristic.
@@ -38,6 +47,8 @@ from .catalog import CATALOG, selfcheck
 from .registry import (REGISTRY, Registry, Counter, Gauge, Histogram,
                        counter, gauge, histogram)
 from .spans import span, drain_step_spans, step_span_totals
+from . import flight
+from . import memory
 from .exporters import (step_end, render_prom, report, start_http_server,
                         jsonl_path, reset, reset_steps)
 from . import compile as compile_events
@@ -50,6 +61,7 @@ __all__ = [
     "span", "drain_step_spans", "step_span_totals",
     "step_end", "render_prom", "report", "start_http_server",
     "jsonl_path", "reset", "reset_steps", "compile_events",
+    "flight", "memory",
 ]
 
 # best-effort process-wide init: compile listener (jax.monitoring) and
@@ -57,6 +69,10 @@ __all__ = [
 # endpoint starts only when MXNET_TPU_TELEMETRY_PORT is set.
 compile_events.install()
 _init_env_state()
+# black-box mode: an uncaught crash must leave a flight dump for the
+# launch.py watchdog to collect
+if flight.dump_dir():
+    flight.install_excepthook()
 try:
     _port = int(_os.environ.get("MXNET_TPU_TELEMETRY_PORT", "0"))
 except ValueError:

@@ -84,6 +84,34 @@ CATALOG = {
     "mxtpu_monitor_stat": (GAUGE, ("tensor",),
                            "latest Monitor stat value per matched "
                            "tensor"),
+    # ------------------------------------------------ memory / HBM
+    "mxtpu_memory_plan_bytes": (GAUGE, ("program", "category"),
+                                "static XLA memory plan of a compiled "
+                                "program (category=argument|output|temp|"
+                                "alias|generated_code|total)"),
+    "mxtpu_program_flops": (GAUGE, ("program",),
+                            "XLA cost-analysis FLOPs per execution of "
+                            "a compiled program"),
+    "mxtpu_program_bytes_accessed": (GAUGE, ("program",),
+                                     "XLA cost-analysis bytes accessed "
+                                     "per execution (HBM traffic)"),
+    "mxtpu_hbm_bytes_in_use": (GAUGE, ("device",),
+                               "live device memory in use "
+                               "(device.memory_stats, sampled at step "
+                               "boundaries)"),
+    "mxtpu_hbm_peak_bytes": (GAUGE, ("device",),
+                             "peak device memory in use since process "
+                             "start (device.memory_stats)"),
+    "mxtpu_oom_total": (COUNTER, ("program",),
+                        "RESOURCE_EXHAUSTED errors annotated with the "
+                        "memory plan and live-bytes snapshot"),
+    # ------------------------------------------------ flight recorder
+    "mxtpu_flight_events_total": (COUNTER, ("kind",),
+                                  "structured events recorded into the "
+                                  "flight-recorder ring"),
+    "mxtpu_flight_dumps_total": (COUNTER, ("reason",),
+                                 "flight-recorder black-box dumps "
+                                 "written (MXNET_TPU_FLIGHT_DIR)"),
 }
 
 

@@ -43,6 +43,8 @@ def install():
         if name == _COMPILE_EVENT:
             c_total.inc()
             c_secs.inc(float(dur))
+            from . import flight
+            flight.record("compile", duration_s=round(float(dur), 6))
 
     try:
         register(_on_duration)

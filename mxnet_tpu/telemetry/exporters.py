@@ -26,6 +26,8 @@ from collections import deque
 from .catalog import COUNTER, GAUGE, HISTOGRAM
 from .registry import REGISTRY, counter, gauge, histogram
 from . import compile as compile_mod
+from . import flight
+from . import memory as memory_mod
 from .spans import drain_step_spans
 
 __all__ = ["step_end", "render_prom", "report", "start_http_server",
@@ -39,6 +41,8 @@ _jsonl = {"path": None, "fh": None}
 # compile count/time already attributed by the first-call heuristic in
 # windows discarded by reset_steps() (no jax.monitoring listener only)
 _heur_carry = {"count": 0, "time": 0.0}
+# counter snapshot at the previous step boundary (flight-event deltas)
+_last_counters = {}
 
 
 def jsonl_path():
@@ -98,17 +102,34 @@ def step_end(samples=None, step_time=None, extra=None, count=1):
         with _lock:
             _step_durs.extend([float(step_time)] * count)
     spans = drain_step_spans()
+    # live HBM sample at the step boundary (inert on backends without
+    # memory_stats): the gauges land in the JSONL snapshot below and in
+    # any later flight dump
+    memory_mod.sample_live_memory()
+    step_no = int(counter("mxtpu_step_total").get())
+    counters = REGISTRY.flat(kinds=(COUNTER,))
+    with _lock:
+        deltas = {k: v - _last_counters.get(k, 0)
+                  for k, v in counters.items()
+                  if v != _last_counters.get(k, 0)}
+        _last_counters.clear()
+        _last_counters.update(counters)
+    ev = {"step": step_no, "step_time_s": step_time, "samples": samples,
+          "spans": spans, "counter_deltas": deltas}
+    if count > 1:
+        ev["count"] = count
+    flight.record("step_end", **ev)
     with _lock:
         fh = _jsonl_handle()
         if fh is None:
             return
         rec = {
             "ts": round(time.time(), 6),
-            "step": int(counter("mxtpu_step_total").get()),
+            "step": step_no,
             "step_time_s": step_time,
             "samples": samples,
             "spans": spans,
-            "counters": REGISTRY.flat(kinds=(COUNTER,)),
+            "counters": counters,
             "gauges": REGISTRY.flat(kinds=(GAUGE,)),
         }
         if count > 1:
@@ -293,6 +314,10 @@ def report():
             "source": compile_source,
         },
         "phases": phases,
+        "memory": {
+            "plans": memory_mod.plans_dict(),
+            "live": memory_mod.sample_live_memory(),
+        },
         "counters": REGISTRY.flat(kinds=(COUNTER,)),
     }
 
@@ -324,12 +349,16 @@ def reset_steps():
 
 def reset():
     """Clear every sample, the percentile window, the per-step span
-    accumulator, and the step-log handle (the env var is re-read on the
-    next step).  Metric objects and cached label children stay valid."""
+    accumulator, the flight ring + memory-plan registry, and the
+    step-log handle (the env var is re-read on the next step).  Metric
+    objects and cached label children stay valid."""
     REGISTRY.reset()
     drain_step_spans()
+    flight.clear()
+    memory_mod.clear_plans()
     with _lock:
         _step_durs.clear()
+        _last_counters.clear()
         _heur_carry["count"] = 0
         _heur_carry["time"] = 0.0
         if _jsonl["fh"] is not None:

@@ -1,0 +1,267 @@
+"""Flight recorder: a black box for TPU runs.
+
+A bounded, thread-safe ring buffer of recent structured events — step
+begin/end, span timings, compile events, kvstore traffic, fault
+injections, memory plans, counter deltas — that is cheap enough to run
+always and is dumped to a JSON "black box" file when the run dies:
+on :class:`~mxnet_tpu.base.MXNetError` in a guarded training seam, on
+an annotated ``RESOURCE_EXHAUSTED`` (see :mod:`.memory`), on SIGTERM
+preemption (:meth:`ShardedTrainer.install_preemption_handler`), and on
+any uncaught exception (the excepthook installed when
+``MXNET_TPU_FLIGHT_DIR`` is set).  ``tools/launch.py``'s watchdog
+collects dumps left behind by a dead rank and records their paths in
+the supervisor JSONL event; ``tools/flight_read.py`` pretty-prints a
+dump.
+
+Recording is always on (the ring lives in memory and costs one lock +
+dict append per event); *dumping* requires ``MXNET_TPU_FLIGHT_DIR`` to
+name a writable directory — without it :func:`dump` is a no-op
+returning ``None``, so tests and casual runs never scatter files.
+
+Dump schema (``"schema": "mxtpu-flight/1"``)::
+
+    {
+      "schema": "mxtpu-flight/1",
+      "reason": "oom" | "error" | "sigterm" | "crash" | <caller string>,
+      "ts": <unix seconds>, "pid": ..., "host": ...,
+      "restart_count": <MXNET_TPU_RESTART_COUNT>,
+      "error": <str or null>,
+      "events": [{"seq": n, "ts": ..., "kind": ..., ...fields}, ...],
+      "counters": {...}, "gauges": {...},     # registry snapshot
+      "memory_plans": {program: plan dict},   # telemetry.memory
+      "live_memory": {...} | null             # device.memory_stats
+    }
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from collections import deque
+
+from ..base import MXNetError
+from .catalog import COUNTER, GAUGE
+from .registry import REGISTRY, counter
+
+__all__ = ["FlightRecorder", "RECORDER", "record", "events", "clear",
+           "dump", "dump_dir", "capacity", "crash_guard",
+           "install_excepthook"]
+
+DEFAULT_CAPACITY = 512
+
+
+def dump_dir():
+    """Dump destination directory (``MXNET_TPU_FLIGHT_DIR``), or None
+    when black-box dumping is off."""
+    return os.environ.get("MXNET_TPU_FLIGHT_DIR") or None
+
+
+def capacity():
+    """Ring capacity (``MXNET_TPU_FLIGHT_EVENTS``, default 512)."""
+    try:
+        n = int(os.environ.get("MXNET_TPU_FLIGHT_EVENTS",
+                               str(DEFAULT_CAPACITY)))
+    except ValueError:
+        n = DEFAULT_CAPACITY
+    return max(8, n)
+
+
+class FlightRecorder:
+    """Bounded ring of structured events + the dump writer.
+
+    One module-level instance (:data:`RECORDER`) serves the process;
+    embedders and tests may build private ones.  All methods are
+    thread-safe; ``record`` is the hot path (one lock, one deque
+    append, one counter inc).
+    """
+
+    def __init__(self, capacity_=None):
+        self._lock = threading.Lock()
+        self._events = deque(maxlen=capacity_ or capacity())
+        self._seq = 0
+        self._dumps = 0
+
+    # ----------------------------------------------------------- record
+    def record(self, kind, **fields):
+        """Append one event; returns its sequence number.  ``fields``
+        must be JSON-serializable (the dump writer falls back to repr
+        for anything that is not)."""
+        ev = {"kind": str(kind), "ts": round(time.time(), 6)}
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+        counter("mxtpu_flight_events_total").labels(kind=str(kind)).inc()
+        return ev["seq"]
+
+    def events(self):
+        """Snapshot of the ring, oldest first (copies, safe to mutate)."""
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+    # ------------------------------------------------------------- dump
+    def dump(self, reason, path=None, error=None, directory=None):
+        """Write the black box.  Returns the written path, or None when
+        dumping is disabled (no ``path``, no ``directory``, and
+        ``MXNET_TPU_FLIGHT_DIR`` unset).  Never raises: the recorder
+        must not replace the error it is documenting — write failures
+        are logged and swallowed."""
+        if path is None:
+            directory = directory or dump_dir()
+            if not directory:
+                return None
+            with self._lock:
+                self._dumps += 1
+                n = self._dumps
+            path = os.path.join(
+                directory, "flight-%d-%03d-%s.json"
+                % (os.getpid(), n, _slug(reason)))
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        try:
+            doc = self._payload(reason, error)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True,
+                          default=repr)
+            os.replace(tmp, path)
+        except Exception as e:  # mxlint: allow-broad-except(the black-box writer runs while the error it documents is propagating — a payload/serialization/IO failure here must never replace that error)
+            import logging
+            logging.getLogger(__name__).warning(
+                "flight recorder: cannot write black box %r: %s", path, e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        counter("mxtpu_flight_dumps_total").labels(
+            reason=_slug(reason)).inc()
+        return path
+
+    def _payload(self, reason, error):
+        from . import memory as memory_mod
+        try:
+            restart = int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
+        except ValueError:
+            restart = 0
+        return {
+            "schema": "mxtpu-flight/1",
+            "reason": str(reason),
+            "ts": round(time.time(), 6),
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "restart_count": restart,
+            "error": None if error is None else str(error),
+            "events": self.events(),
+            "counters": REGISTRY.flat(kinds=(COUNTER,)),
+            "gauges": REGISTRY.flat(kinds=(GAUGE,)),
+            "memory_plans": memory_mod.plans_dict(),
+            "live_memory": memory_mod.device_memory_stats(),
+        }
+
+
+def _slug(reason):
+    return "".join(c if c.isalnum() or c in "-_" else "-"
+                   for c in str(reason))[:40] or "dump"
+
+
+#: the process-wide recorder (module-level helpers below)
+RECORDER = FlightRecorder()
+
+
+def record(kind, **fields):
+    """Record one event on the default recorder."""
+    return RECORDER.record(kind, **fields)
+
+
+def events():
+    """Snapshot of the default recorder's ring, oldest first."""
+    return RECORDER.events()
+
+
+def clear():
+    """Empty the default recorder's ring (telemetry.reset calls this)."""
+    RECORDER.clear()
+
+
+def dump(reason, path=None, error=None, directory=None):
+    """Dump the default recorder — see :meth:`FlightRecorder.dump`."""
+    return RECORDER.dump(reason, path=path, error=error,
+                         directory=directory)
+
+
+class crash_guard:
+    """Context manager: on :class:`MXNetError` (fault injections, budget
+    violations, annotated OOMs — anything the framework raises on
+    purpose), record an ``error`` event and dump the black box, then
+    re-raise unchanged.  Nested guards dump once: the innermost tags the
+    exception and outer levels pass it through.
+
+    ::
+
+        with flight.crash_guard("trainer.step"):
+            loss = step(...)
+    """
+
+    def __init__(self, site, recorder=None):
+        self.site = site
+        self._rec = recorder or RECORDER
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        if exc is None or not isinstance(exc, MXNetError):
+            return False
+        if getattr(exc, "_mxtpu_flight_dumped", False):
+            return False
+        try:
+            exc._mxtpu_flight_dumped = True
+        except AttributeError:
+            pass
+        from .memory import HbmOomError
+        reason = "oom" if isinstance(exc, HbmOomError) else "error"
+        self._rec.record("error", site=self.site,
+                         error_type=type(exc).__name__,
+                         message=str(exc)[:2000])
+        self._rec.dump(reason, error=exc)
+        return False
+
+
+_hook_installed = [False]
+
+
+def install_excepthook():
+    """Chain a ``sys.excepthook`` that dumps the black box (reason
+    ``crash``) on any uncaught exception, then delegates to the previous
+    hook.  Installed automatically at import when
+    ``MXNET_TPU_FLIGHT_DIR`` is set, so a worker that dies leaves a dump
+    for the launch.py watchdog to collect.  Idempotent."""
+    if _hook_installed[0]:
+        return
+    _hook_installed[0] = True
+    prev = sys.excepthook
+
+    def hook(etype, value, tb):
+        if not getattr(value, "_mxtpu_flight_dumped", False):
+            try:
+                RECORDER.record("crash", error_type=etype.__name__,
+                                message=str(value)[:2000])
+                RECORDER.dump("crash", error=value)
+            except Exception:  # mxlint: allow-broad-except(the excepthook must never mask the original crash with its own failure)
+                pass
+        prev(etype, value, tb)
+
+    sys.excepthook = hook

@@ -332,6 +332,127 @@ def test_preemption_handler_checkpoints_on_sigterm(tmp_path):
         prefix, load_optimizer_states=True) == 3
 
 
+# --------------------------------------- flight recorder / OOM forensics
+
+def _load_flight_read():
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "flight_read", os.path.join(root, "tools", "flight_read.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _flight_dumps(d):
+    return sorted(f for f in os.listdir(str(d))
+                  if f.startswith("flight-") and f.endswith(".json"))
+
+
+class _OomRaiser:
+    """Stands in for the compiled step: a backend RESOURCE_EXHAUSTED."""
+
+    def __call__(self, *args, **kwargs):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "9437184 bytes.")
+
+
+def test_injected_oom_is_annotated_and_black_boxed(tmp_path, monkeypatch):
+    """Acceptance (ISSUE 4): RESOURCE_EXHAUSTED during a ShardedTrainer
+    step produces (a) an MXNetError whose message carries the static
+    memory plan breakdown and live-bytes snapshot, and (b) a flight
+    dump with the recent step/compile/plan events."""
+    import json
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import memory as tmem
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    telemetry.reset()
+    t = _trainer()
+    x, y = _cluster_batch(0)
+    # a clean step compiles the program and registers its memory plan
+    t.step({"data": x, "softmax_label": y})
+    assert tmem.get_plan("trainer.step") is not None
+    t._step_fn = _OomRaiser()
+    with pytest.raises(MXNetError) as ei:
+        t.step({"data": x, "softmax_label": y})
+    assert isinstance(ei.value, tmem.HbmOomError)
+    msg = str(ei.value)
+    assert "RESOURCE_EXHAUSTED" in msg
+    assert "static memory plan" in msg
+    assert "argument=" in msg and "temp=" in msg and "total=" in msg
+    assert "live device memory" in msg      # snapshot (or its absence)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    dumps = _flight_dumps(tmp_path)
+    assert len(dumps) == 1
+    doc = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    assert doc["reason"] == "oom"
+    kinds = [e["kind"] for e in doc["events"]]
+    for want in ("step_begin", "step_end", "memory_plan", "oom"):
+        assert want in kinds, (want, kinds)
+    assert "trainer.step" in doc["memory_plans"]
+    assert doc["memory_plans"]["trainer.step"]["total_bytes"] > 0
+    # the reader parses and formats it
+    fr = _load_flight_read()
+    assert "reason=oom" in fr.format_dump(fr.load(
+        os.path.join(str(tmp_path), dumps[0])))
+    # and the recovery path still works: restore the real step fn
+    t2 = _trainer()
+    loss = float(t2.step({"data": x, "softmax_label": y}))
+    assert np.isfinite(loss)
+
+
+def test_trainer_fault_seam_dumps_black_box(tmp_path, monkeypatch):
+    """The trainer.step fault seam (MXNET_TPU_FAULTS) exercises the
+    dump-on-MXNetError path end to end."""
+    import json
+    from mxnet_tpu import telemetry
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    telemetry.reset()
+    t = _trainer()
+    x, y = _cluster_batch(0)
+    t.step({"data": x, "softmax_label": y})
+    R.configure_faults("trainer.step:n=1")
+    with pytest.raises(R.FaultInjected):
+        t.step({"data": x, "softmax_label": y})
+    # n=1 exhausted: training continues after the injected failure
+    R.clear_faults()
+    float(t.step({"data": x, "softmax_label": y}))
+    dumps = _flight_dumps(tmp_path)
+    assert len(dumps) == 1
+    doc = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    assert doc["reason"] == "error"
+    faults = [e for e in doc["events"] if e["kind"] == "fault"]
+    assert faults and faults[-1]["site"] == "trainer.step"
+
+
+def test_preemption_dump_written_with_checkpoint(tmp_path, monkeypatch):
+    """SIGTERM preemption leaves BOTH a checkpoint and a black box."""
+    import json
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    prefix = str(tmp_path / "pre")
+    t = _trainer()
+    x, y = _cluster_batch(0)
+    t.step({"data": x, "softmax_label": y})
+    handler = t.install_preemption_handler(prefix, exit_process=False)
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(100):
+            if handler.triggered:
+                break
+            time.sleep(0.01)
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    assert handler.triggered
+    assert find_checkpoints(prefix, require_states=True) == [1]
+    dumps = _flight_dumps(tmp_path)
+    assert len(dumps) == 1
+    doc = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    assert doc["reason"] == "sigterm"
+    pre = [e for e in doc["events"] if e["kind"] == "preemption"]
+    assert pre and pre[0]["epoch"] == 1
+
+
 # ----------------------------------------------------- data pipeline layer
 
 def _write_rec(path, n=60, seed=0):

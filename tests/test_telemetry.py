@@ -4,9 +4,13 @@ Covers the contracts in docs/api/telemetry.md: labeled counter/gauge/
 histogram semantics and thread safety, catalog enforcement, span
 nesting + Chrome-trace round trip, JSONL/Prometheus golden outputs,
 report() percentiles/throughput/compile accounting, the absorbed
-IO/kvstore/resilience counters, and an end-to-end Module.fit run on a
-zoo model with the JSONL step-log enabled.
+IO/kvstore/resilience counters, an end-to-end Module.fit run on a
+zoo model with the JSONL step-log enabled, the memory-observability
+layer (version-tolerant plan accessors, plan gauges, HBM budget check,
+RESOURCE_EXHAUSTED annotation), and the flight recorder (ring
+wraparound, thread safety, dump schema + reader, crash-guard dedup).
 """
+import importlib.util
 import json
 import os
 import threading
@@ -18,11 +22,23 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import telemetry
 from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry import flight
+from mxnet_tpu.telemetry import memory as tmem
+
+
+def _load_tool(name):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 @pytest.fixture(autouse=True)
 def _fresh_telemetry(monkeypatch):
     monkeypatch.delenv("MXNET_TPU_TELEMETRY_JSONL", raising=False)
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
     telemetry.reset()
     yield
     telemetry.reset()
@@ -284,13 +300,237 @@ def test_http_endpoint():
 
 def test_selfcheck_and_docs_drift():
     assert telemetry.selfcheck() == []
-    import importlib.util
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    spec = importlib.util.spec_from_file_location(
-        "ci_check", os.path.join(root, "tools", "ci_check.py"))
-    cc = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(cc)
+    cc = _load_tool("ci_check")
     assert cc.telemetry_drift(root) == []
+
+
+# ------------------------------------------------------- memory / HBM
+
+class _AttrMA:
+    """jax 0.4.x CompiledMemoryStats shape: *_size_in_bytes attributes."""
+    argument_size_in_bytes = 1000
+    output_size_in_bytes = 200
+    temp_size_in_bytes = 300
+    alias_size_in_bytes = 150
+    generated_code_size_in_bytes = 50
+
+
+class _FakeCompiled:
+    def memory_analysis(self):
+        return _AttrMA()
+
+    def cost_analysis(self):
+        # jax <= 0.4.x list-of-dict form, space-separated key
+        return [{"flops": 1e6, "bytes accessed": 2e6}]
+
+
+def test_memory_accessors_version_tolerant():
+    assert tmem.memory_analysis_of(_FakeCompiled()) == {
+        "argument": 1000, "output": 200, "temp": 300,
+        "alias": 150, "generated_code": 50}
+    assert tmem.cost_analysis_of(_FakeCompiled()) == {
+        "flops": 1e6, "bytes_accessed": 2e6}
+
+    class DictForm:          # jax >= 0.5 plain-dict shapes
+        def memory_analysis(self):
+            return {"argument": 7, "temp": 3}
+
+        def cost_analysis(self):
+            return {"flops": 5.0, "bytes_accessed": 9.0}
+
+    assert tmem.memory_analysis_of(DictForm()) == {"argument": 7,
+                                                   "temp": 3}
+    assert tmem.cost_analysis_of(DictForm())["bytes_accessed"] == 9.0
+
+    class Absent:            # backend without analyses
+        def memory_analysis(self):
+            return None
+
+        def cost_analysis(self):
+            raise RuntimeError("unsupported")
+
+    assert tmem.memory_analysis_of(Absent()) is None
+    assert tmem.cost_analysis_of(Absent()) is None
+    assert tmem.plan_of(Absent(), "x") is None
+    assert tmem.memory_analysis_of(object()) is None   # no method at all
+
+
+def test_plan_totals_and_register_gauges():
+    plan = tmem.plan_of(_FakeCompiled(), "unit.prog")
+    # arg + out + temp + code - alias
+    assert plan.total_bytes == 1000 + 200 + 300 + 50 - 150
+    tmem.register_plan(plan)
+    g = telemetry.gauge("mxtpu_memory_plan_bytes")
+    assert g.labels(program="unit.prog", category="argument").get() == 1000
+    assert g.labels(program="unit.prog", category="total").get() == 1400
+    assert telemetry.gauge("mxtpu_program_flops").labels(
+        program="unit.prog").get() == 1e6
+    assert tmem.get_plan("unit.prog") is plan
+    rep = telemetry.report()
+    assert rep["memory"]["plans"]["unit.prog"]["total_bytes"] == 1400
+    assert any(e["kind"] == "memory_plan" for e in flight.events())
+    # the breakdown string names every category
+    for cat in ("argument", "output", "temp", "total"):
+        assert cat in plan.breakdown()
+
+
+def test_budget_check_raises_with_breakdown(monkeypatch):
+    plan = tmem.plan_of(_FakeCompiled(), "unit.budget")
+    # capacity unknown: inert
+    monkeypatch.delenv("MXNET_TPU_HBM_LIMIT_BYTES", raising=False)
+    tmem.check_budget(plan)
+    # explicit capacity below the plan: descriptive raise
+    monkeypatch.setenv("MXNET_TPU_HBM_LIMIT_BYTES", "1000")
+    with pytest.raises(MXNetError) as ei:
+        tmem.check_budget(plan)
+    msg = str(ei.value)
+    assert "unit.budget" in msg
+    assert "argument=" in msg and "temp=" in msg
+    assert "MXNET_BACKWARD_DO_MIRROR" in msg     # remat advice
+    assert "batch size" in msg
+    # disabled check never raises
+    monkeypatch.setenv("MXNET_TPU_MEMORY_BUDGET", "0")
+    tmem.check_budget(plan)
+    monkeypatch.setenv("MXNET_TPU_MEMORY_BUDGET", "2.0")
+    tmem.check_budget(plan)                      # 2x1000 covers 1400
+
+
+def test_planned_executable_real_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a):
+        return (a @ a).sum()
+
+    x = jnp.ones((8, 8))
+    exe = tmem.planned_executable("unit.jit", f, (x,))
+    assert float(exe(x)) == 512.0
+    plan = tmem.get_plan("unit.jit")
+    assert plan is not None and (plan.memory or plan.cost)
+    # a function with no .lower degrades to itself, no plan
+    calls = []
+
+    def plain(a):
+        calls.append(1)
+        return a
+
+    assert tmem.planned_executable("unit.plain", plain, (x,)) is plain
+    assert tmem.get_plan("unit.plain") is None
+
+
+def test_annotate_oom_message_counter_and_passthrough():
+    tmem.register_plan(tmem.plan_of(_FakeCompiled(), "unit.oom"))
+    with pytest.raises(tmem.HbmOomError) as ei:
+        with tmem.annotate_oom("unit.oom"):
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory while "
+                               "trying to allocate 9437184 bytes.")
+    msg = str(ei.value)
+    assert "RESOURCE_EXHAUSTED" in msg
+    assert "static memory plan" in msg and "argument=" in msg
+    assert "live device memory" in msg
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    assert telemetry.counter("mxtpu_oom_total").labels(
+        program="unit.oom").get() == 1
+    assert any(e["kind"] == "oom" for e in flight.events())
+    # non-OOM errors pass through untouched
+    with pytest.raises(ValueError, match="plain"):
+        with tmem.annotate_oom("unit.oom"):
+            raise ValueError("plain failure")
+    assert telemetry.counter("mxtpu_oom_total").labels(
+        program="unit.oom").get() == 1
+
+
+# --------------------------------------------------- flight recorder
+
+def test_flight_ring_wraparound():
+    r = flight.FlightRecorder(capacity_=16)
+    for i in range(50):
+        r.record("unit", i=i)
+    evs = r.events()
+    assert len(evs) == 16
+    assert evs[0]["i"] == 34 and evs[-1]["i"] == 49
+    assert evs[-1]["seq"] == 50          # seq keeps counting past drops
+    r.clear()
+    assert len(r.events()) == 0
+
+
+def test_flight_thread_safety():
+    r = flight.FlightRecorder(capacity_=100_000)
+    n_threads, n_iter = 8, 500
+
+    def work(tid):
+        for i in range(n_iter):
+            r.record("unit", tid=tid, i=i)
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = r.events()
+    assert len(evs) == n_threads * n_iter
+    assert len({e["seq"] for e in evs}) == len(evs)   # no seq collisions
+
+
+def test_flight_dump_schema_and_reader(tmp_path):
+    flight.record("unit", detail="x")
+    assert flight.dump("unit") is None        # no dir configured: no-op
+    path = flight.dump("unit test!", directory=str(tmp_path))
+    assert path and os.path.exists(path)
+    assert "unit-test-" in os.path.basename(path)     # slugged reason
+    fr = _load_tool("flight_read")
+    doc = fr.load(path)
+    assert doc["schema"] == "mxtpu-flight/1"
+    assert doc["pid"] == os.getpid()
+    assert any(e["kind"] == "unit" for e in doc["events"])
+    text = fr.format_dump(doc)
+    assert "reason=unit test!" in text and "events" in text
+    assert telemetry.counter("mxtpu_flight_dumps_total").labels(
+        reason="unit-test-").get() == 1
+    # a malformed file is rejected with a named error
+    bad = tmp_path / "bad.json"
+    bad.write_text("{\"schema\": \"nope\"}")
+    with pytest.raises(ValueError, match="schema"):
+        fr.load(str(bad))
+
+
+def test_crash_guard_dumps_once_and_only_mxnet_errors(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    with pytest.raises(MXNetError, match="boom"):
+        with flight.crash_guard("outer"):
+            with flight.crash_guard("inner"):
+                raise MXNetError("boom")
+    dumps = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("flight-")]
+    assert len(dumps) == 1                     # nested guards dedup
+    doc = _load_tool("flight_read").load(str(tmp_path / dumps[0]))
+    assert doc["reason"] == "error"
+    assert doc["error"] == "boom"
+    errs = [e for e in doc["events"] if e["kind"] == "error"]
+    assert errs and errs[0]["site"] == "inner"
+    # non-framework errors are not black-boxed by the guard
+    with pytest.raises(ValueError):
+        with flight.crash_guard("outer"):
+            raise ValueError("not ours")
+    assert len([f for f in os.listdir(str(tmp_path))
+                if f.startswith("flight-")]) == 1
+
+
+def test_step_end_records_flight_event_with_deltas():
+    telemetry.step_end(samples=8, step_time=0.01)
+    telemetry.counter("mxtpu_io_records_total").labels(
+        source="native").inc(5)
+    telemetry.step_end(samples=8, step_time=0.01)
+    ends = [e for e in flight.events() if e["kind"] == "step_end"]
+    assert len(ends) == 2
+    d = ends[1]["counter_deltas"]
+    assert d["mxtpu_step_total"] == 1
+    assert d['mxtpu_io_records_total{source="native"}'] == 5
+    assert ends[1]["step"] == 2
 
 
 # ------------------------------------------------- absorbed counters

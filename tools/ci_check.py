@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Four stages, all of which must be clean:
+Five stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -17,6 +17,11 @@ Four stages, all of which must be clean:
    (:func:`mxnet_tpu.telemetry.selfcheck`) and every metric name in
    ``docs/api/telemetry.md`` exists in ``telemetry.CATALOG`` and vice
    versa (the drift-guard pattern that caught ``squeeze`` in PR 2).
+5. **flight-recorder smoke** — a fault injected through
+   ``MXNET_TPU_FAULTS`` at the ``trainer.step`` seam of a tiny trainer
+   must produce a well-formed black-box dump in
+   ``MXNET_TPU_FLIGHT_DIR`` that ``tools/flight_read.py`` parses and
+   formats.
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -52,7 +57,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/4] mxlint: %d finding(s) over %s"
+        say("ci_check[1/5] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -61,7 +66,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/4] registry selfcheck: %d problem(s)"
+        say("ci_check[2/5] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -75,17 +80,24 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/4] verify model %-22s %s" % (name, status))
+            say("ci_check[3/5] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/4] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/5] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
+            say("  " + p)
+
+        # stage 5: flight-recorder smoke (fault -> black box -> reader)
+        problems = flight_smoke(repo_root)
+        say("ci_check[5/5] flight smoke: %d problem(s)" % len(problems))
+        for p in problems:
+            failures.append("flight: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -127,6 +139,80 @@ def telemetry_drift(repo_root=_ROOT):
         if not _derived(name):
             problems.append("metric %r appears in docs/api/telemetry.md "
                             "but is not in telemetry.CATALOG" % name)
+    return problems
+
+
+def flight_smoke(repo_root=_ROOT):
+    """End-to-end black-box check: arm a ``trainer.step`` fault through
+    ``MXNET_TPU_FAULTS``, run a tiny ShardedTrainer step, and require a
+    well-formed flight dump that ``tools/flight_read.py`` parses and
+    formats.  Returns a list of problem strings (empty = clean)."""
+    import importlib.util
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import models, resilience
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_flight_smoke_")
+    saved = {k: os.environ.get(k)
+             for k in ("MXNET_TPU_FLIGHT_DIR", "MXNET_TPU_FAULTS")}
+    try:
+        os.environ["MXNET_TPU_FLIGHT_DIR"] = tmpdir
+        net = models.get_model("mlp", num_classes=10)
+        trainer = ShardedTrainer(
+            net, build_mesh(tp=1),
+            data_shapes={"data": (8, 64)},
+            label_shapes={"softmax_label": (8,)}, dtype="float32")
+        batch = {"data": np.zeros((8, 64), np.float32),
+                 "softmax_label": np.zeros((8,), np.float32)}
+        # one clean step so the dump carries a memory plan + step events
+        float(trainer.step(batch))
+        os.environ["MXNET_TPU_FAULTS"] = "trainer.step:n=1"
+        try:
+            trainer.step(batch)
+            problems.append("armed trainer.step fault did not raise")
+        except MXNetError:
+            pass
+        dumps = sorted(f for f in os.listdir(tmpdir)
+                       if f.startswith("flight-") and f.endswith(".json"))
+        if not dumps:
+            problems.append("no flight dump written to "
+                            "MXNET_TPU_FLIGHT_DIR on the injected fault")
+            return problems
+        spec = importlib.util.spec_from_file_location(
+            "flight_read", os.path.join(repo_root, "tools",
+                                        "flight_read.py"))
+        fr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fr)
+        for name in dumps:
+            path = os.path.join(tmpdir, name)
+            try:
+                doc = fr.load(path)
+            except ValueError as e:
+                problems.append("flight_read rejects %s: %s" % (name, e))
+                continue
+            kinds = {e.get("kind") for e in doc["events"]}
+            for want in ("step_end", "fault", "memory_plan"):
+                if want not in kinds:
+                    problems.append("dump %s: missing %r event (got %s)"
+                                    % (name, want, sorted(kinds)))
+            text = fr.format_dump(doc)
+            if "reason=error" not in text:
+                problems.append("dump %s: formatted report lacks the "
+                                "reason header" % name)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resilience.clear_faults()
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 
 

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""flight_read — pretty-print a flight-recorder black-box dump.
+
+The reader half of ``mxnet_tpu.telemetry.flight``: loads a
+``mxtpu-flight/1`` JSON dump (validating the schema), and prints a
+postmortem-ordered report — header, the event timeline (relative
+timestamps, condensed fields), memory plans, live memory, and the
+non-zero counters.  Stdlib-only, so it runs on a supervisor host with
+no jax installed.
+
+Usage::
+
+    python tools/flight_read.py DUMP.json [--events N] [--json]
+
+``--json`` re-emits the parsed document (schema-validated passthrough
+for piping into jq); ``--events N`` limits the timeline to the last N
+events (default: all).  Exits 1 on a malformed dump.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+SCHEMA = "mxtpu-flight/1"
+
+#: keys every well-formed dump carries
+REQUIRED = ("schema", "reason", "ts", "pid", "events", "counters",
+            "gauges", "memory_plans")
+
+
+def load(path):
+    """Parse + validate one dump.  Raises ValueError naming the problem
+    (malformed JSON, wrong schema, missing keys, non-list events)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError("cannot read flight dump %r: %s" % (path, e))
+    if not isinstance(doc, dict):
+        raise ValueError("flight dump %r: not a JSON object" % path)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError("flight dump %r: schema %r (expected %r)"
+                         % (path, doc.get("schema"), SCHEMA))
+    missing = [k for k in REQUIRED if k not in doc]
+    if missing:
+        raise ValueError("flight dump %r: missing keys %s"
+                         % (path, missing))
+    if not isinstance(doc["events"], list):
+        raise ValueError("flight dump %r: events is not a list" % path)
+    return doc
+
+
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return ("%.1f %s" if unit != "B" else "%.0f %s") % (n, unit)
+        n /= 1024.0
+
+
+def _fmt_fields(ev):
+    skip = ("kind", "ts", "seq")
+    parts = []
+    for k in sorted(ev):
+        if k in skip or ev[k] is None:
+            continue
+        v = ev[k]
+        if isinstance(v, dict):
+            v = "{%d keys}" % len(v)
+        elif isinstance(v, float):
+            v = "%.6g" % v
+        s = "%s=%s" % (k, v)
+        parts.append(s if len(s) <= 60 else s[:57] + "...")
+    return " ".join(parts)
+
+
+def format_dump(doc, max_events=None):
+    """The human-readable report as one string."""
+    lines = []
+    lines.append("flight dump: reason=%s  pid=%s  host=%s  restarts=%s"
+                 % (doc["reason"], doc["pid"], doc.get("host", "?"),
+                    doc.get("restart_count", 0)))
+    if doc.get("error"):
+        lines.append("error: %s" % str(doc["error"]).split("\n")[0][:200])
+    t_end = doc["ts"]
+
+    events = doc["events"]
+    shown = events if max_events is None else events[-max_events:]
+    lines.append("")
+    lines.append("events (%d recorded, %d shown; t=0 is the dump):"
+                 % (len(events), len(shown)))
+    for ev in shown:
+        rel = ev.get("ts", t_end) - t_end
+        lines.append("  %+9.3fs  %-16s %s"
+                     % (rel, ev.get("kind", "?"), _fmt_fields(ev)))
+
+    plans = doc.get("memory_plans") or {}
+    if plans:
+        lines.append("")
+        lines.append("memory plans:")
+        for name in sorted(plans):
+            p = plans[name]
+            cats = ["%s=%s" % (k[:-len("_bytes")], _fmt_bytes(v))
+                    for k, v in sorted(p.items())
+                    if k.endswith("_bytes")]
+            extra = ["%s=%.3g" % (k, p[k]) for k in ("flops",
+                                                     "bytes_accessed")
+                     if k in p]
+            lines.append("  %-24s %s" % (name, "  ".join(cats + extra)))
+
+    live = doc.get("live_memory")
+    if live:
+        lines.append("")
+        lines.append("live memory: " + "  ".join(
+            "%s=%s" % (k, _fmt_bytes(v)) for k, v in sorted(live.items())
+            if "bytes" in k))
+
+    counters = {k: v for k, v in (doc.get("counters") or {}).items() if v}
+    if counters:
+        lines.append("")
+        lines.append("counters:")
+        for k in sorted(counters):
+            v = counters[k]
+            lines.append("  %-56s %s" % (k, "%.6g" % v
+                                         if isinstance(v, float) else v))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="flight_read")
+    ap.add_argument("dump", help="flight-recorder JSON dump to read")
+    ap.add_argument("--events", type=int, default=None, metavar="N",
+                    help="show only the last N events")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the validated document as JSON")
+    args = ap.parse_args(argv)
+    try:
+        doc = load(args.dump)
+    except ValueError as e:
+        print("flight_read: %s" % e, file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        print(format_dump(doc, max_events=args.events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

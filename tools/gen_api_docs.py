@@ -82,13 +82,19 @@ SEE_ALSO = {
                  "[analysis](analysis.md) — MXG007 sharding-coverage "
                  "verification against tp_rules",
                  "[telemetry](telemetry.md) — trainer/pipeline spans, "
-                 "kvstore traffic counters"],
+                 "kvstore traffic counters, the trainer step's memory "
+                 "plan + HBM budget check, and the flight-recorder "
+                 "black box dumped on step failures"],
     "symbol": ["[analysis](analysis.md) — `Symbol.verify()`, "
                "`bind(strict=True)`, the MXG0xx diagnostic catalog"],
     "kvstore": ["[telemetry](telemetry.md) — push/pull byte counters "
                 "and the dist_async in-flight gauge"],
     "profiler": ["[telemetry](telemetry.md) — spans feed these Chrome "
-                 "traces; metrics/exporters live there"],
+                 "traces; metrics/exporters live there, as do the "
+                 "memory-plan gauges (`telemetry.memory`) and the "
+                 "flight-recorder black box (`telemetry.flight`, "
+                 "MXNET_TPU_FLIGHT_DIR) for after-the-fact profiling "
+                 "of a dead run"],
 }
 
 

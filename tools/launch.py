@@ -47,6 +47,7 @@ def _run_workers_once(opts, command, attempt):
     procs = []
     base_env = dmlc_opts(opts)
     base_env["MXNET_TPU_RESTART_COUNT"] = str(attempt)
+    flight_before = _flight_dump_names()
     for rank in range(opts.num_workers):
         env = dict(base_env)
         env["MXNET_TPU_PROCESS_ID"] = str(rank)
@@ -90,7 +91,53 @@ def _run_workers_once(opts, command, attempt):
                 code = code or rc
         if live:
             time.sleep(hb)
+    if failed_rank is not None:
+        # postmortem breadcrumb: any black box the dead worker (or its
+        # torn-down peers) left behind — collected AFTER the grace
+        # teardown so SIGTERM'd survivors' dumps are included too
+        _note_worker_death(attempt, failed_rank, code,
+                           sorted(_flight_dump_names() - flight_before))
     return code
+
+
+def _flight_dump_names():
+    """Flight-recorder dump paths currently in MXNET_TPU_FLIGHT_DIR
+    (empty set when black-box dumping is off or the dir is unreadable —
+    the supervisor stays stdlib-only and never imports the framework)."""
+    d = os.environ.get("MXNET_TPU_FLIGHT_DIR")
+    if not d:
+        return set()
+    try:
+        return {os.path.join(d, f) for f in os.listdir(d)
+                if f.startswith("flight-") and f.endswith(".json")}
+    except OSError:
+        return set()
+
+
+def _note_worker_death(attempt, rank, code, flight_dumps):
+    """Append a worker-death event (with any collected flight dumps) to
+    the supervisor JSONL stream — the machine-readable twin of the
+    stderr dead-rank message."""
+    path = os.environ.get("MXNET_TPU_TELEMETRY_JSONL")
+    if flight_dumps:
+        sys.stderr.write("launch.py: collected %d flight dump(s) from "
+                         "the dead attempt: %s\n"
+                         % (len(flight_dumps), ", ".join(flight_dumps)))
+    if not path:
+        return
+    import json
+    import time
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({"ts": round(time.time(), 6),
+                                "event": "worker_death",
+                                "attempt": attempt,
+                                "rank": rank,
+                                "exit_code": code,
+                                "flight_dumps": flight_dumps}) + "\n")
+    except OSError as e:
+        sys.stderr.write("launch.py: cannot append telemetry event to "
+                         "%s: %s\n" % (path, e))
 
 
 def launch_local(opts, command):

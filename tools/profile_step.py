@@ -96,15 +96,18 @@ def main():
     float(loss)
     t_scan = (time.perf_counter() - t0) / k
 
-    # --- cost analysis
-    try:
-        lowered = step_fn.lower(trainer.params, trainer.opt_state,
-                                trainer.aux, staged, kk, lr, tt)
-        cost = lowered.compile().cost_analysis()
-        flops = cost.get("flops", float("nan"))
-    except Exception as e:  # mxlint: allow-broad-except(cost_analysis availability and failure modes are backend-dependent)
-        print("cost_analysis unavailable:", e)
+    # --- cost analysis: the warmup steps already registered the fused
+    # step's plan (telemetry.memory.planned_executable runs on first
+    # dispatch), so read it instead of lowering + compiling again
+    from mxnet_tpu.telemetry import memory as tmem
+    plan = tmem.get_plan("trainer.step")
+    if plan is None or "flops" not in plan.cost:
+        print("cost_analysis unavailable on this backend")
         flops = float("nan")
+    else:
+        flops = plan.cost["flops"]
+        if plan.memory:
+            print("memory plan:", plan.breakdown())
 
     def report(name, dt):
         ips = batch / dt
