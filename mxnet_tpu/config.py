@@ -130,6 +130,21 @@ _CATALOG = {
     "MXNET_TPU_FLIGHT_EVENTS": ("512", "honored",
                                 "flight-recorder ring capacity "
                                 "(oldest events fall off)"),
+    "MXNET_TPU_SKEW_EVERY": ("8", "honored",
+                             "measure the pre-collective timestamp "
+                             "barrier (collective wait + rank skew) "
+                             "every N collectives (each measured step "
+                             "pays a fleet-wide host sync; 1 = every "
+                             "step); 0 disables"),
+    "MXNET_TPU_CAPTURE_DIR": ("", "honored",
+                              "enable on-demand live capture: SIGUSR1 "
+                              "(or the /debug/capture endpoint) writes "
+                              "a bounded jax.profiler trace window + a "
+                              "flight snapshot under this directory "
+                              "without restarting the worker"),
+    "MXNET_TPU_CAPTURE_SECONDS": ("3", "honored",
+                                  "length of the on-demand capture "
+                                  "trace window in seconds"),
     "MXNET_TPU_MEMORY_BUDGET": ("1.0", "honored",
                                 "fraction of device capacity a "
                                 "compiled program's static memory "

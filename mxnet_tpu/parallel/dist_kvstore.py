@@ -125,10 +125,20 @@ class DistKVStore(KVStore):
         if self._num_workers > 1:
             # cross-host collective: worth a flight-ring entry (a hang
             # or peer death surfaces here), unlike the per-param local
-            # aggregation above
+            # aggregation above.  The distview timestamp barrier just
+            # before it measures — not infers — how long this rank
+            # waited on its slowest peer (straggler attribution:
+            # collective wait lands on the FAST ranks).
+            from ..telemetry import distview as _dv
             from ..telemetry import flight as _flight
-            _flight.record("kvstore", op="allreduce", store="dist_sync",
-                           keys=len(merged), bytes=push_bytes)
+            skew = _dv.pre_collective_barrier("kvstore.push")
+            ev = {"op": "allreduce", "store": "dist_sync",
+                  "keys": len(merged), "bytes": push_bytes}
+            if skew is not None:
+                ev["wait_s"] = round(skew["wait_s"], 6)
+                ev["skew_s"] = round(skew["skew_s"], 6)
+                ev["slowest_rank"] = skew["slowest_rank"]
+            _flight.record("kvstore", **ev)
             summed = self.allreduce({k: m.data for k, m in merged.items()})
             # addressable_data(0) is this host's replica of the reduced
             # value — no host round trip

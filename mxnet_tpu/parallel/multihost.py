@@ -173,6 +173,11 @@ def gather_to_host(arr):
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
+# first sync_global_devices compiles its collective program; that call's
+# wall time must not land in the wait histogram (see attempt() below)
+_barrier_state = {"warm": False}
+
+
 def process_barrier(name="mxnet_tpu_multihost"):
     """Block until every process reaches this point (checkpoint
     write/read ordering across ranks).
@@ -197,10 +202,24 @@ def process_barrier(name="mxnet_tpu_multihost"):
     def attempt():
         resilience.fault_point("multihost.barrier")
         if jax.process_count() > 1:
+            import time as _time
             from jax.experimental import multihost_utils
+            t0 = _time.perf_counter()
             resilience.with_timeout(
                 lambda: multihost_utils.sync_global_devices(name),
                 timeout, name="process_barrier(%r)" % name)
+            # the barrier IS a collective wait: how long this rank
+            # stalled for its slowest peer (straggler attribution,
+            # telemetry.distview) — except the process's FIRST barrier,
+            # whose duration is dominated by the sync program's XLA
+            # compile, not peer wait (same warm-up rule as distview's
+            # timestamp barrier)
+            if _barrier_state["warm"]:
+                from ..telemetry.registry import histogram
+                histogram("mxtpu_collective_wait_seconds").observe(
+                    _time.perf_counter() - t0)
+            else:
+                _barrier_state["warm"] = True
 
     resilience.retry_call(
         attempt,

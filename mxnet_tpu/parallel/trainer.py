@@ -504,6 +504,9 @@ class ShardedTrainer:
         self._aot_exes = {}
         self._fwd_fn = None
         self._step_count = 0
+        # current step's straggler-attribution accumulator (reset by
+        # step()/run_steps(); see telemetry.distview)
+        self._seg = {"input_s": 0.0, "collective_s": 0.0, "skew": None}
         # epoch this trainer resumed from (load_checkpoint sets it):
         # _step_count restarts at 0 after a resume, so anything deriving
         # a global step/epoch must add this offset
@@ -1364,6 +1367,13 @@ class ShardedTrainer:
         Telemetry: each call is a ``trainer.step`` span and one
         ``step_end`` record (step time is host-side dispatch+staging —
         on an async backend the device may still be computing).  The
+        step is split into compute / input-wait / collective-wait
+        segments (``mxtpu_step_segment_seconds``, telemetry.distview):
+        input-wait is the host->device staging time, and on a
+        process-spanning mesh a pre-collective timestamp barrier
+        measures how long this rank waited for its slowest peer
+        (``mxtpu_collective_wait_seconds`` / skew gauge) — the
+        straggler-attribution signal tools/run_top.py aggregates.  The
         first call registers the compiled step's memory plan
         (``mxtpu_memory_plan_bytes{program="trainer.step"}``) and
         budget-checks it before dispatch; a backend RESOURCE_EXHAUSTED
@@ -1375,14 +1385,33 @@ class ShardedTrainer:
         from ..telemetry import flight as _flight, memory as _tmem
         _flight.record("step_begin", program="trainer.step",
                        step=self._step_count + 1)
+        self._seg = {"input_s": 0.0, "collective_s": 0.0, "skew": None}
         t0 = _time.perf_counter()
         with telemetry.span("trainer.step", category="trainer"), \
                 _flight.crash_guard("trainer.step"), \
                 _tmem.annotate_oom("trainer.step"):
             loss = self._step_impl(batch)
+        total = _time.perf_counter() - t0
         telemetry.step_end(samples=self._batch_samples(batch),
-                           step_time=_time.perf_counter() - t0)
+                           step_time=total,
+                           extra=self._segments_extra(total))
         return loss
+
+    def _segments_extra(self, total_s, count=1):
+        """The straggler-attribution fields for this step's JSONL
+        record: the segment split (recorded into
+        ``mxtpu_step_segment_seconds`` as a side effect) plus the
+        measured skew when the pre-collective barrier ran."""
+        from ..telemetry import distview as _dv
+        seg = self._seg
+        extra = {"segments": _dv.record_step_segments(
+            total_s, input_s=seg["input_s"],
+            collective_s=seg["collective_s"], count=count)}
+        sk = seg["skew"]
+        if sk is not None:
+            extra["skew_s"] = round(sk["skew_s"], 6)
+            extra["slowest_rank"] = sk["slowest_rank"]
+        return extra
 
     def _batch_samples(self, batch):
         try:
@@ -1402,17 +1431,38 @@ class ShardedTrainer:
         from ..telemetry import memory as _tmem
         return _tmem.dispatch_planned(self._aot_exes, program, fn, args)
 
+    def _stage_timed(self, batch):
+        """Stage a host batch, charging the wall time to the step's
+        ``input_wait`` segment (already-staged device batches cost 0)."""
+        import time as _time
+        import jax
+        first = next(iter(batch.values()))
+        if isinstance(first, jax.Array):
+            return batch
+        t0 = _time.perf_counter()
+        dev_batch = self.put_batch(batch)
+        self._seg["input_s"] += _time.perf_counter() - t0
+        return dev_batch
+
+    def _measure_collective_entry(self, site):
+        """On a process-spanning mesh, run the distview timestamp
+        barrier just before dispatching the collective-bearing program:
+        the measured wait/skew land in this step's segments."""
+        if not self._multiproc:
+            return
+        from ..telemetry import distview as _dv
+        info = _dv.pre_collective_barrier(site)
+        if info is not None:
+            self._seg["collective_s"] += info["wait_s"]
+            self._seg["skew"] = info
+
     def _step_impl(self, batch):
         import jax
         import jax.numpy as jnp
         from .. import resilience
         resilience.fault_point("trainer.step")
         self._key, sub = jax.random.split(self._key)
-        first = next(iter(batch.values()))
-        if isinstance(first, jax.Array):
-            dev_batch = batch
-        else:
-            dev_batch = self.put_batch(batch)
+        dev_batch = self._stage_timed(batch)
         opt = self.optimizer
         self._maybe_rebuild()
         self._step_count += 1
@@ -1425,6 +1475,7 @@ class ShardedTrainer:
         self._ensure_state_formats(self._step_fn)
         args = (self.params, self.opt_state, self.aux, dev_batch, sub,
                 jnp.float32(lr), jnp.float32(opt.num_update))
+        self._measure_collective_entry("trainer.step")
         self.params, self.opt_state, self.aux, loss = \
             self._dispatch_planned("trainer.step", self._step_fn, args)
         return loss
@@ -1449,6 +1500,7 @@ class ShardedTrainer:
         from ..telemetry import flight as _flight, memory as _tmem
         _flight.record("step_begin", program="trainer.run_steps",
                        step=self._step_count + 1, count=num_steps)
+        self._seg = {"input_s": 0.0, "collective_s": 0.0, "skew": None}
         t0 = _time.perf_counter()
         with telemetry.span("trainer.run_steps", category="trainer"), \
                 _flight.crash_guard("trainer.run_steps"), \
@@ -1458,10 +1510,12 @@ class ShardedTrainer:
         # once from the host: counters/percentiles advance per inner
         # step, but the JSONL gets ONE record (count=num_steps) — per-
         # record snapshots of an opaque chain would be byte-identical
+        total = _time.perf_counter() - t0
         telemetry.step_end(
             samples=self._batch_samples(batch),
-            step_time=(_time.perf_counter() - t0) / max(1, num_steps),
-            count=num_steps)
+            step_time=total / max(1, num_steps),
+            count=num_steps,
+            extra=self._segments_extra(total, count=num_steps))
         return losses
 
     def _run_steps_impl(self, batch, num_steps):
@@ -1469,9 +1523,7 @@ class ShardedTrainer:
         import jax.numpy as jnp
         import numpy as _np
 
-        first = next(iter(batch.values()))
-        dev_batch = batch if isinstance(first, jax.Array) \
-            else self.put_batch(batch)
+        dev_batch = self._stage_timed(batch)
         self._maybe_rebuild()
         fn = self._scan_fns.get(num_steps)
         if fn is None:
@@ -1491,6 +1543,7 @@ class ShardedTrainer:
         args = (self.params, self.opt_state, self.aux, dev_batch, sub,
                 jnp.asarray(_np.asarray(lrs, _np.float32)),
                 jnp.asarray(_np.asarray(ts, _np.float32)))
+        self._measure_collective_entry("trainer.run_steps")
         self.params, self.opt_state, self.aux, losses = \
             self._dispatch_planned("trainer.run_steps", fn, args)
         return losses

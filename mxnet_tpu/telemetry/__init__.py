@@ -49,8 +49,9 @@ from .registry import (REGISTRY, Registry, Counter, Gauge, Histogram,
 from .spans import span, drain_step_spans, step_span_totals
 from . import flight
 from . import memory
+from . import distview
 from .exporters import (step_end, render_prom, report, start_http_server,
-                        jsonl_path, reset, reset_steps)
+                        jsonl_path, env_port, reset, reset_steps)
 from . import compile as compile_events
 from .exporters import _init_env_state
 
@@ -60,8 +61,8 @@ __all__ = [
     "counter", "gauge", "histogram",
     "span", "drain_step_spans", "step_span_totals",
     "step_end", "render_prom", "report", "start_http_server",
-    "jsonl_path", "reset", "reset_steps", "compile_events",
-    "flight", "memory",
+    "jsonl_path", "env_port", "reset", "reset_steps", "compile_events",
+    "flight", "memory", "distview",
 ]
 
 # best-effort process-wide init: compile listener (jax.monitoring) and
@@ -73,10 +74,13 @@ _init_env_state()
 # launch.py watchdog to collect
 if flight.dump_dir():
     flight.install_excepthook()
-try:
-    _port = int(_os.environ.get("MXNET_TPU_TELEMETRY_PORT", "0"))
-except ValueError:
-    _port = 0
+# on-demand live capture: SIGUSR1 (relayed fleet-wide by tools/launch.py
+# --capture) writes a bounded profiler window + flight snapshot
+if distview.capture_dir():
+    distview.install_capture_handler()
+# the per-process index offset (env_port) keeps co-located multi-process
+# workers from racing to bind ONE fixed port
+_port = env_port()
 if _port > 0:
     try:
         start_http_server(_port)

@@ -112,6 +112,24 @@ CATALOG = {
     "mxtpu_flight_dumps_total": (COUNTER, ("reason",),
                                  "flight-recorder black-box dumps "
                                  "written (MXNET_TPU_FLIGHT_DIR)"),
+    # ------------------------------------ cross-rank view (distview)
+    "mxtpu_step_segment_seconds": (HISTOGRAM, ("segment",),
+                                   "per-step host wall time split into "
+                                   "segment=compute|input_wait|"
+                                   "collective_wait (straggler "
+                                   "attribution)"),
+    "mxtpu_collective_wait_seconds": (HISTOGRAM, (),
+                                      "time this rank stalled at a "
+                                      "pre-collective timestamp barrier "
+                                      "waiting for its slowest peer"),
+    "mxtpu_rank_step_skew_seconds": (GAUGE, (),
+                                     "arrival-time spread (max-min) "
+                                     "across ranks at the last "
+                                     "timestamp barrier — the "
+                                     "straggler's lead"),
+    "mxtpu_capture_total": (COUNTER, ("trigger",),
+                            "on-demand live capture windows started "
+                            "(trigger=signal|http|api)"),
 }
 
 

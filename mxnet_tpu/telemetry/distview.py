@@ -1,0 +1,704 @@
+"""Cross-rank run observability: straggler attribution, run-timeline
+aggregation, and on-demand live capture.
+
+Everything before this module is strictly per-rank: the JSONL step-log,
+the Prometheus endpoint, the Chrome trace, and the flight recorder each
+describe ONE process.  The question that dominates multi-host TPU
+operations — *which rank is slow, and is it compute, input, or the
+collective?* — needs a cross-rank layer, because one straggler stalls
+every ``psum`` ("A Learned Performance Model for TPUs", arXiv:2008.01040
+treats exactly this per-op/collective attribution as ground truth; here
+it is measured, not predicted).  Three pieces:
+
+* **straggler attribution** (worker half): each training step is split
+  into ``compute`` / ``input_wait`` / ``collective_wait`` segments
+  (:func:`record_step_segments` → ``mxtpu_step_segment_seconds``), and a
+  lightweight pre-collective *timestamp barrier*
+  (:func:`pre_collective_barrier`) measures — not infers — how long each
+  rank waits for its slowest peer (``mxtpu_collective_wait_seconds``)
+  and the arrival spread across ranks
+  (``mxtpu_rank_step_skew_seconds``);
+* **fleet aggregation** (supervisor half): :class:`RunAggregator` tails
+  every rank's JSONL step-log (``tools/launch.py`` gives each local
+  worker its own ``<base>.rank<N>`` stream) and merges them into ONE
+  run-level timeline — schema ``mxtpu-run/1`` — with per-step p50/max
+  across ranks, the worst-rank id, skew history, and restart/fault
+  events; ``tools/run_top.py`` renders it live and as a postmortem;
+* **on-demand live capture** (worker half): a SIGUSR1 handler
+  (:func:`install_capture_handler`) and the ``/debug/capture`` endpoint
+  capture a bounded ``jax.profiler`` trace window plus a flight-recorder
+  snapshot on a RUNNING rank without restarting it;
+  ``tools/launch.py --capture`` broadcasts the signal fleet-wide and
+  ``tools/xprof_top.py --trace`` feeds the result into the per-op
+  attribution flow.
+
+Import discipline: module-level imports are stdlib-only and in-package
+imports are deferred into the worker-half functions, so the supervisor
+(``tools/launch.py``), ``tools/run_top.py``, and ``tools/flight_read.py``
+can load this file by path (``importlib``) without dragging jax — or the
+framework — into a process that only aggregates text streams.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal as _signal
+import threading
+import time
+
+__all__ = [
+    "RUN_SCHEMA", "rank", "world", "skew_every",
+    "record_step_segments", "pre_collective_barrier",
+    "capture_dir", "capture_seconds", "capture_now", "capture_status",
+    "install_capture_handler",
+    "rank_jsonl_path", "split_jsonl", "RunAggregator",
+    "read_run_timeline", "summarize_run",
+]
+
+#: run-timeline schema tag (first line of the ``<base>.run`` JSONL)
+RUN_SCHEMA = "mxtpu-run/1"
+
+#: step segment names, in display order
+SEGMENTS = ("compute", "input_wait", "collective_wait")
+
+
+def rank():
+    """This process's rank in the launch.py job (0 outside one)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_PROCESS_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def world():
+    """Number of processes in the launch.py job (1 outside one)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_NUM_PROCESSES", "1") or 1)
+    except ValueError:
+        return 1
+
+
+# --------------------------------------------------- straggler attribution
+
+def skew_every():
+    """Measure the pre-collective timestamp barrier every N collectives
+    (``MXNET_TPU_SKEW_EVERY``; 0 disables).  The default samples every
+    8th collective: the barrier's allgather returns host values, so each
+    measured step gives up async-dispatch run-ahead — a fleet-wide host
+    sync that must not be the every-step default; ``1`` opts into
+    per-step measurement when hunting a straggler."""
+    try:
+        return max(0, int(os.environ.get("MXNET_TPU_SKEW_EVERY", "8")))
+    except ValueError:
+        return 8
+
+
+def record_step_segments(total_s, input_s=0.0, collective_s=0.0,
+                         count=1):
+    """Split one step's host wall time into the three segments and
+    record them into ``mxtpu_step_segment_seconds{segment=...}``.
+
+    ``compute`` is the remainder (``total - input - collective``,
+    floored at 0): on an async backend it covers dispatch *and* the
+    device wait, which is exactly the per-rank quantity the aggregator
+    compares across the fleet.  ``count`` > 1 (a ``run_steps`` scan
+    chain) observes the per-step average ``count`` times — exactly how
+    ``step_end`` feeds ``mxtpu_step_seconds`` — so the two histograms'
+    sums/counts stay comparable and a chain rank is not under-weighted
+    against single-step ranks.  Returns the (un-averaged) segments dict
+    for the JSONL record."""
+    input_s = max(0.0, float(input_s))
+    collective_s = max(0.0, float(collective_s))
+    compute_s = max(0.0, float(total_s) - input_s - collective_s)
+    seg = {"compute": compute_s, "input_wait": input_s,
+           "collective_wait": collective_s}
+    from mxnet_tpu.telemetry.registry import histogram
+    h = histogram("mxtpu_step_segment_seconds")
+    count = max(1, int(count))
+    scale = 1.0 / count
+    for name, val in seg.items():
+        child = h.labels(segment=name)
+        for _ in range(count):
+            child.observe(val * scale)
+    return {k: round(v, 6) for k, v in seg.items()}
+
+
+_skew_state = {"calls": 0}
+
+
+def pre_collective_barrier(site="trainer.step"):
+    """Timestamp barrier immediately before a cross-process collective.
+
+    Every rank allgathers its arrival wall-clock timestamp; the call
+    itself is the barrier, so each rank's *measured local wait* is how
+    long it stalled for its slowest peer — the time GSPMD's ``psum``
+    would otherwise hide inside the XLA program.  Records:
+
+    * ``mxtpu_collective_wait_seconds`` — this rank's wait (≈0 on the
+      straggler, ≈skew on the fastest rank: collective wait is paid by
+      the FAST ranks);
+    * ``mxtpu_rank_step_skew_seconds`` — the arrival spread
+      (max − min timestamp) across ranks, i.e. the straggler's lead.
+
+    Timestamps are wall clock, so cross-HOST skew inherits NTP error
+    (~ms); the *wait* is measured locally and is exact everywhere.
+    Returns ``{"wait_s", "skew_s", "slowest_rank", "rank"}``, or None
+    when disabled (``MXNET_TPU_SKEW_EVERY=0``), off-interval, or
+    single-process.  Never raises: a failed barrier degrades to
+    unmeasured skew, not a dead training loop."""
+    every = skew_every()
+    if every == 0:
+        return None
+    try:
+        import jax
+        if jax.process_count() <= 1:
+            return None
+        _skew_state["calls"] += 1
+        if (_skew_state["calls"] - 1) % every:
+            return None
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        if not _skew_state.get("warm"):
+            # the first allgather compiles its XLA program: measuring
+            # it would record seconds of "collective wait" that are
+            # really compile time — burn one untimed round first.
+            # warm flips BEFORE the attempt: if the warm-up raises on
+            # this rank only, retrying it next interval would have this
+            # rank issue one more allgather than its peers — a count
+            # desync that hangs the fleet, far worse than one polluted
+            # measurement
+            _skew_state["warm"] = True
+            multihost_utils.process_allgather(
+                np.asarray([0.0], np.float64))
+        t_arrive = time.time()
+        p0 = time.perf_counter()
+        ts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([t_arrive], np.float64))).reshape(-1)
+        wait_s = time.perf_counter() - p0
+        skew_s = float(ts.max() - ts.min())
+        slowest = int(ts.argmax())
+        my_rank = int(jax.process_index())
+    except Exception as e:  # mxlint: allow-broad-except(the skew probe is optional instrumentation wrapped around the hot loop — any backend/collective failure here must degrade to "skew unmeasured", never kill the step it observes)
+        logging.getLogger(__name__).warning(
+            "distview: pre-collective timestamp barrier failed at %s "
+            "(%s); skew unmeasured for this step", site, e)
+        return None
+    from mxnet_tpu.telemetry.registry import gauge, histogram
+    histogram("mxtpu_collective_wait_seconds").observe(wait_s)
+    gauge("mxtpu_rank_step_skew_seconds").set(skew_s)
+    from mxnet_tpu.telemetry import flight
+    flight.record("skew", site=site, wait_s=round(wait_s, 6),
+                  skew_s=round(skew_s, 6), slowest_rank=slowest)
+    return {"wait_s": wait_s, "skew_s": skew_s,
+            "slowest_rank": slowest, "rank": my_rank}
+
+
+# ------------------------------------------------- on-demand live capture
+
+def capture_dir():
+    """Destination for on-demand capture windows
+    (``MXNET_TPU_CAPTURE_DIR``), or None when capture is off."""
+    return os.environ.get("MXNET_TPU_CAPTURE_DIR") or None
+
+
+def capture_seconds():
+    """Bounded trace-window length (``MXNET_TPU_CAPTURE_SECONDS``,
+    default 3)."""
+    try:
+        return max(0.1, float(os.environ.get("MXNET_TPU_CAPTURE_SECONDS",
+                                             "3")))
+    except ValueError:
+        return 3.0
+
+
+_capture_lock = threading.Lock()
+_capture = {"active": False, "installed": False, "last": None}
+
+
+def capture_status():
+    """{"active": bool, "last": dict or None} for the /debug endpoint."""
+    with _capture_lock:
+        return {"active": _capture["active"], "last": _capture["last"]}
+
+
+def capture_now(trigger="api", seconds=None, directory=None):
+    """Capture a bounded ``jax.profiler`` trace window plus a flight
+    snapshot on THIS running rank, without restarting or pausing it.
+
+    The capture runs on a background (non-daemon — see the comment at
+    the thread spawn) thread: the signal/HTTP caller returns
+    immediately and training continues while xprof samples the device;
+    a process that exits mid-window lingers until the capture finishes
+    writing.
+    Files land under ``<dir>/rank<N>/`` (``MXNET_TPU_CAPTURE_DIR``, or
+    ``.profiles/capture``): the trace plus a
+    ``flight-*-capture.json`` ring snapshot, which is what
+    ``tools/xprof_top.py --trace`` and ``tools/flight_read.py`` consume.
+    One capture at a time; a concurrent trigger is reported and dropped.
+    Returns ``{"started": bool, "dir": path, ...}``."""
+    directory = directory or capture_dir() or os.path.join(".profiles",
+                                                           "capture")
+    window = capture_seconds() if seconds is None else \
+        max(0.1, float(seconds))
+    out = os.path.join(directory, "rank%d" % rank())
+    # non-blocking: the SIGUSR1 handler runs this on the MAIN thread,
+    # possibly between bytecodes of a capture_now/capture_status call
+    # that already holds the (non-reentrant) lock — blocking here would
+    # deadlock the training thread; a contended trigger is just dropped
+    if not _capture_lock.acquire(blocking=False):
+        return {"started": False, "dir": out,
+                "reason": "capture state busy"}
+    try:
+        if _capture["active"]:
+            return {"started": False, "dir": out,
+                    "reason": "capture already in progress"}
+        _capture["active"] = True
+    finally:
+        _capture_lock.release()
+
+    def _run():
+        info = {"trigger": trigger, "dir": out, "seconds": window,
+                "ts": round(time.time(), 6), "trace": False,
+                "flight": None}
+        try:
+            os.makedirs(out, exist_ok=True)
+            from mxnet_tpu.telemetry import flight
+            from mxnet_tpu.telemetry.registry import counter
+            counter("mxtpu_capture_total").labels(trigger=trigger).inc()
+            flight.record("capture", trigger=trigger, seconds=window,
+                          dir=out)
+            # the ring snapshot first: even if the profiler cannot trace
+            # this backend, the capture still yields the black box
+            info["flight"] = flight.dump("capture", directory=out)
+            import jax
+            jax.profiler.start_trace(out)
+            try:
+                time.sleep(window)
+            finally:
+                jax.profiler.stop_trace()
+            info["trace"] = True
+        except Exception as e:  # mxlint: allow-broad-except(on-demand capture piggybacks on a live training process — a profiler/backend failure must log and drop the window, never take the run down with it)
+            info["error"] = str(e)
+            logging.getLogger(__name__).warning(
+                "distview: on-demand capture failed (%s); training "
+                "continues", e)
+        finally:
+            with _capture_lock:
+                _capture["active"] = False
+                _capture["last"] = info
+
+    # NON-daemon on purpose: jax.profiler's first trace lazily imports
+    # its (heavy) xplane tooling, and a daemon thread killed mid-import
+    # at interpreter shutdown segfaults the worker — which the launch.py
+    # watchdog would read as a dead rank.  A non-daemon thread means a
+    # process that exits right after a capture finishes writing it.
+    threading.Thread(target=_run, daemon=False,
+                     name="mxtpu-capture").start()
+    return {"started": True, "dir": out, "seconds": window}
+
+
+def install_capture_handler(signum=None):
+    """Install the SIGUSR1 on-demand capture handler on this process.
+
+    Installed automatically at ``mxnet_tpu.telemetry`` import when
+    ``MXNET_TPU_CAPTURE_DIR`` is set (main thread only — signal
+    handlers cannot be registered elsewhere); idempotent.  The handler
+    only sets the capture off: the window itself runs on a background
+    thread, so an in-flight jitted step is never interrupted.
+    ``tools/launch.py`` relays SIGUSR1 to every worker, and
+    ``tools/launch.py --capture`` triggers that relay on a running job.
+    Returns True when the handler is (already) installed."""
+    if signum is None:
+        signum = _signal.SIGUSR1
+    if _capture["installed"]:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def handler(sig, frame):
+        capture_now(trigger="signal")
+
+    try:
+        _signal.signal(signum, handler)
+    except (ValueError, OSError):   # non-main thread race / exotic os
+        return False
+    _capture["installed"] = True
+    return True
+
+
+# ---------------------------------------------------- fleet aggregation
+# Everything below is stdlib-only: tools/launch.py loads this module by
+# file path and must never import jax (the supervisor stays light).
+
+def split_jsonl(buf):
+    """Tolerantly parse a chunk of a JSONL stream that may end
+    mid-append: returns ``(records, partial)`` where ``records`` are
+    the parsed dict lines (malformed/non-dict lines skipped) and
+    ``partial`` is the unterminated tail to carry into the next chunk.
+    The shared core of every live tailer (:meth:`RunAggregator.poll`,
+    ``tools/run_top.py --follow``)."""
+    lines = buf.split("\n")
+    partial = lines.pop()
+    records = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records, partial
+
+
+def rank_jsonl_path(base, r):
+    """Per-rank step-log path the launcher assigns to worker ``r``
+    (``<base>.rank<N>``); the supervisor keeps ``<base>`` for its own
+    events and writes the merged timeline to ``<base>.run``."""
+    return "%s.rank%d" % (base, int(r))
+
+
+class RunAggregator:
+    """Merge per-rank JSONL step-logs into one run-level timeline.
+
+    The supervisor polls :meth:`poll` (cheap: incremental reads from
+    the last byte offset per rank); whenever a step has been reported
+    by every rank — or falls ``window`` steps behind the newest, which
+    means some rank died or skipped it — ONE timeline record is
+    appended to ``out_path``:
+
+    ``{"kind": "step", "step": N, "n_ranks": k, "p50_s", "max_s",
+    "min_s", "worst_rank", "skew_s", "ranks": {rank: {"t_s",
+    "segments", "skew_s"}}}``
+
+    plus ``run_begin`` (the schema header), passthrough ``event``
+    records (worker start/death, watchdog restarts, flight dumps), and
+    a final ``run_end``.  All records are plain JSON lines so the
+    timeline can itself be tailed live (``tools/run_top.py --follow``).
+    """
+
+    def __init__(self, base_path, num_ranks, out_path=None, window=64):
+        self.base = base_path
+        self.n = max(1, int(num_ranks))
+        self.out_path = out_path or base_path + ".run"
+        self.window = max(1, int(window))
+        # tail each rank stream from its CURRENT end: workers append
+        # ('a' mode), so a rerun over the same base must not ingest the
+        # previous job's records — whose repeated step numbers would
+        # then shadow the new run's steps as duplicates
+        self._offsets = {}        # rank -> (byte offset, partial line)
+        for r in range(self.n):
+            try:
+                self._offsets[r] = (
+                    os.path.getsize(rank_jsonl_path(base_path, r)), "")
+            except OSError:
+                pass              # not created yet: start at 0
+        self._pending = {}        # (attempt, step) -> {rank: record}
+        self._emitted = set()     # (attempt, step) already written
+        self._floor = -1          # steps <= this were pruned from
+                                  # _emitted (still emitted; see feed)
+        self._attempt = 0         # current watchdog attempt
+        self._max_step = 0        # newest step seen in this attempt
+        self._steps_written = 0
+        self._seen_dumps = set()
+        self._lock = threading.Lock()
+        self._closed = False
+        try:
+            # fresh timeline per job: a reused base must not leave the
+            # old run's records above this run's run_begin header
+            open(self.out_path, "w").close()
+        except OSError:
+            pass
+        self._write({"schema": RUN_SCHEMA, "kind": "run_begin",
+                     "ts": round(time.time(), 6), "num_ranks": self.n,
+                     "base": os.path.basename(base_path)})
+
+    def begin_attempt(self, attempt):
+        """Start watchdog attempt N: flush the previous attempt's
+        partial steps (its telemetry step counters restart from the
+        resumed checkpoint, so step numbers repeat across attempts)."""
+        attempt = int(attempt)
+        with self._lock:
+            if attempt == self._attempt:
+                return
+            self._emit_ready(final=True)
+            self._attempt = attempt
+            self._max_step = 0
+            self._floor = -1
+
+    # ------------------------------------------------------------ output
+    def _write(self, rec):
+        try:
+            with open(self.out_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            logging.getLogger(__name__).warning(
+                "distview: cannot append run timeline %r: %s",
+                self.out_path, e)
+
+    def note_event(self, record):
+        """Pass a supervisor event (worker_start/worker_death/
+        watchdog_restart/...) through into the timeline."""
+        rec = {"kind": "event", "ts": round(time.time(), 6)}
+        rec.update(record)
+        with self._lock:
+            self._write(rec)
+
+    # ------------------------------------------------------------- input
+    def feed(self, r, rec):
+        """Ingest one parsed JSONL record from rank ``r``."""
+        step = rec.get("step")
+        if not isinstance(step, (int, float)):
+            return
+        step = int(step)
+        compact = {"t_s": rec.get("step_time_s"),
+                   "ts": rec.get("ts")}
+        if rec.get("segments"):
+            compact["segments"] = rec["segments"]
+        if rec.get("skew_s") is not None:
+            compact["skew_s"] = rec["skew_s"]
+        if rec.get("slowest_rank") is not None:
+            compact["slowest_rank"] = rec["slowest_rank"]
+        if rec.get("count"):
+            compact["count"] = rec["count"]
+        with self._lock:
+            key = (self._attempt, step)
+            # _floor covers keys pruned from _emitted: a rank lagging
+            # far behind the window must not re-open a step that was
+            # already flushed partial
+            if key in self._emitted or step <= self._floor:
+                return
+            self._pending.setdefault(key, {})[int(r)] = compact
+            self._max_step = max(self._max_step, step)
+            self._emit_ready()
+
+    def _emit_ready(self, final=False):
+        """Emit (under self._lock) every pending step that is complete,
+        or — when ``final`` or older than the window — partial."""
+        for key in sorted(self._pending):
+            attempt, step = key
+            ranks = self._pending[key]
+            complete = len(ranks) >= self.n
+            stale = (final or attempt < self._attempt
+                     or step <= self._max_step - self.window)
+            if not complete and not stale:
+                continue
+            del self._pending[key]
+            self._emitted.add(key)
+            # bound the dedup set (a multi-day supervisor would grow it
+            # forever): keys at or below _floor move into the scalar
+            # floor check in feed(), so a rank lagging past the pruned
+            # region still cannot re-open those steps
+            if len(self._emitted) > 8 * self.window:
+                self._floor = max(self._floor,
+                                  self._max_step - 4 * self.window)
+                self._emitted = {k for k in self._emitted
+                                 if k[0] == self._attempt
+                                 and k[1] > self._floor}
+            self._steps_written += 1
+            times = {r: v.get("t_s") for r, v in ranks.items()
+                     if isinstance(v.get("t_s"), (int, float))}
+            rec = {"kind": "step", "step": step, "attempt": attempt,
+                   "ts": round(max((v.get("ts") or 0)
+                                   for v in ranks.values()), 6),
+                   "n_ranks": len(ranks),
+                   "ranks": {str(r): ranks[r] for r in sorted(ranks)}}
+            if times:
+                vals = sorted(times.values())
+                rec["p50_s"] = round(vals[(len(vals) - 1) // 2], 6)
+                rec["min_s"] = round(vals[0], 6)
+                rec["max_s"] = round(vals[-1], 6)
+                rec["worst_rank"] = max(times, key=times.get)
+            skews = [v.get("skew_s") for v in ranks.values()
+                     if isinstance(v.get("skew_s"), (int, float))]
+            if skews:
+                rec["skew_s"] = round(max(skews), 6)
+            self._write(rec)
+
+    # -------------------------------------------------------------- poll
+    def poll(self):
+        """Incrementally read every rank's stream (and any new flight
+        dumps) and emit newly-complete steps.  Returns the number of
+        records ingested this call."""
+        fed = 0
+        for r in range(self.n):
+            path = rank_jsonl_path(self.base, r)
+            off, partial = self._offsets.get(r, (0, ""))
+            try:
+                with open(path) as f:
+                    f.seek(off)
+                    chunk = f.read()
+                    off = f.tell()
+            except OSError:
+                continue
+            records, partial = split_jsonl(partial + chunk)
+            self._offsets[r] = (off, partial)
+            for rec in records:
+                self.feed(r, rec)
+                fed += 1
+        self._poll_flight_dumps()
+        return fed
+
+    def _poll_flight_dumps(self):
+        """Surface new black-box dumps (MXNET_TPU_FLIGHT_DIR) as
+        timeline events — a rank that dies between supervisor heartbeats
+        still leaves its breadcrumb in step order."""
+        d = os.environ.get("MXNET_TPU_FLIGHT_DIR")
+        if not d:
+            return
+        try:
+            names = sorted(f for f in os.listdir(d)
+                           if f.startswith("flight-")
+                           and f.endswith(".json"))
+        except OSError:
+            return
+        for name in names:
+            if name in self._seen_dumps:
+                continue
+            self._seen_dumps.add(name)
+            self.note_event({"event": "flight_dump",
+                             "path": os.path.join(d, name)})
+
+    def close(self):
+        """Final flush: emit partially-reported steps and the
+        ``run_end`` trailer.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.poll()
+        with self._lock:
+            self._emit_ready(final=True)
+            self._write({"kind": "run_end", "ts": round(time.time(), 6),
+                         "steps": self._steps_written})
+
+
+# --------------------------------------------------- timeline reading
+
+def read_run_timeline(path):
+    """Parse + validate an ``mxtpu-run/1`` timeline (JSONL).  Returns
+    the record list; raises ValueError naming the problem (unreadable
+    file, malformed line, wrong/missing schema header, malformed step
+    records) — ``tools/flight_read.py`` and ``tools/run_top.py`` both
+    route through this."""
+    recs = []
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        raise ValueError("cannot read run timeline %r: %s" % (path, e))
+    lines = raw.split("\n")
+    # a LIVE timeline may end mid-append: a final line with no newline
+    # is an in-progress record, not corruption — ignore it (--follow's
+    # partial-line carry does the same); mid-file garbage still raises
+    tail_partial = lines.pop() if lines and not raw.endswith("\n") \
+        else None
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            raise ValueError("run timeline %r line %d: %s"
+                             % (path, i, e))
+        if not isinstance(rec, dict):
+            raise ValueError("run timeline %r line %d: not a "
+                             "JSON object" % (path, i))
+        recs.append(rec)
+    if tail_partial and tail_partial.strip():
+        try:
+            rec = json.loads(tail_partial)
+            if isinstance(rec, dict):
+                recs.append(rec)
+        except ValueError:
+            pass                # still being written
+    if not recs:
+        raise ValueError("run timeline %r is empty" % path)
+    head = recs[0]
+    if head.get("schema") != RUN_SCHEMA or head.get("kind") != "run_begin":
+        raise ValueError(
+            "run timeline %r: first record must be the %r run_begin "
+            "header (got %r)" % (path, RUN_SCHEMA,
+                                 {k: head.get(k)
+                                  for k in ("schema", "kind")}))
+    for i, rec in enumerate(recs, 1):
+        kind = rec.get("kind")
+        if kind not in ("run_begin", "run_end", "step", "event"):
+            raise ValueError("run timeline %r record %d: unknown kind %r"
+                             % (path, i, kind))
+        if kind == "step":
+            if not isinstance(rec.get("step"), int) or \
+                    not isinstance(rec.get("ranks"), dict):
+                raise ValueError(
+                    "run timeline %r record %d: step records need an "
+                    "int 'step' and a 'ranks' object" % (path, i))
+    return recs
+
+
+def summarize_run(records):
+    """Postmortem roll-up of a timeline: step counts, cross-rank
+    step-time stats, the straggler (most-frequent worst rank), peak
+    skew, per-rank segment totals, and the event list.  Input is
+    :func:`read_run_timeline` output; the result is plain JSON-able —
+    ``tools/run_top.py --summarize`` prints it."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    events = [r for r in records if r.get("kind") == "event"]
+    head = records[0]
+    worst = {}
+    seg_totals = {}
+    rank_times = {}
+    skew_max = 0.0
+    skew_last = None
+    for s in steps:
+        w = s.get("worst_rank")
+        if w is not None:
+            worst[str(w)] = worst.get(str(w), 0) + 1
+        if isinstance(s.get("skew_s"), (int, float)):
+            skew_max = max(skew_max, s["skew_s"])
+            skew_last = s["skew_s"]
+        for r, v in (s.get("ranks") or {}).items():
+            if isinstance(v.get("t_s"), (int, float)):
+                # a run_steps chain reports the per-step AVERAGE with a
+                # count; carry the count so totals match the segment
+                # totals (which are whole-chain wall time)
+                n = v.get("count") if isinstance(v.get("count"), int) \
+                    else 1
+                rank_times.setdefault(r, []).append((v["t_s"], max(1, n)))
+            for name, val in (v.get("segments") or {}).items():
+                if isinstance(val, (int, float)):
+                    st = seg_totals.setdefault(r, {})
+                    st[name] = st.get(name, 0.0) + val
+    per_rank = {}
+    for r, ts in sorted(rank_times.items()):
+        ts = sorted(ts)
+        per_rank[r] = {
+            "steps": sum(n for _t, n in ts),
+            "p50_s": round(ts[(len(ts) - 1) // 2][0], 6),
+            "max_s": round(ts[-1][0], 6),
+            "total_s": round(sum(t * n for t, n in ts), 6),
+        }
+        if r in seg_totals:
+            per_rank[r]["segments_s"] = {
+                k: round(v, 6) for k, v in sorted(seg_totals[r].items())}
+    straggler = max(worst, key=worst.get) if worst else None
+    return {
+        "schema": head.get("schema"),
+        "num_ranks": head.get("num_ranks"),
+        "steps": len(steps),
+        "complete_steps": sum(1 for s in steps
+                              if s.get("n_ranks") == head.get("num_ranks")),
+        "straggler": None if straggler is None else int(straggler),
+        "worst_rank_counts": {k: worst[k] for k in sorted(worst)},
+        "skew_max_s": round(skew_max, 6),
+        "skew_last_s": skew_last,
+        "per_rank": per_rank,
+        "events": [{k: e.get(k) for k in ("ts", "event", "rank", "pid",
+                                          "attempt", "exit_code", "path",
+                                          "telemetry_port")
+                    if e.get(k) is not None} for e in events],
+        "ended": any(r.get("kind") == "run_end" for r in records),
+    }

@@ -26,12 +26,13 @@ from collections import deque
 from .catalog import COUNTER, GAUGE, HISTOGRAM
 from .registry import REGISTRY, counter, gauge, histogram
 from . import compile as compile_mod
+from . import distview as distview_mod
 from . import flight
 from . import memory as memory_mod
 from .spans import drain_step_spans
 
 __all__ = ["step_end", "render_prom", "report", "start_http_server",
-           "jsonl_path", "reset", "reset_steps"]
+           "jsonl_path", "env_port", "reset", "reset_steps"]
 
 # retained step durations for percentiles (bounded: ~12h at 10 steps/s)
 _MAX_DURS = 500_000
@@ -49,6 +50,26 @@ def jsonl_path():
     """Current step-log destination (``MXNET_TPU_TELEMETRY_JSONL``), or
     None when the step-log is off."""
     return os.environ.get("MXNET_TPU_TELEMETRY_JSONL") or None
+
+
+# rank in a launch.py job (MXNET_TPU_PROCESS_ID; 0 outside one) — ONE
+# parser for the JSONL records, the /debug endpoint, and flight dumps
+_proc_rank = distview_mod.rank
+
+
+def env_port():
+    """The metrics port this process should bind
+    (``MXNET_TPU_TELEMETRY_PORT``; 0 = endpoint off).  Co-located
+    ranks must not race to bind one fixed port, so the LOCAL launcher
+    assigns each worker ``port+rank`` in its environment (and records
+    the choice in its supervisor JSONL ``worker_start`` event); the
+    ssh launcher — one rank per host, no collision — passes the
+    configured port through unchanged."""
+    try:
+        port = int(os.environ.get("MXNET_TPU_TELEMETRY_PORT", "0"))
+    except ValueError:
+        return 0
+    return max(0, port)
 
 
 def _jsonl_handle():
@@ -118,6 +139,10 @@ def step_end(samples=None, step_time=None, extra=None, count=1):
           "spans": spans, "counter_deltas": deltas}
     if count > 1:
         ev["count"] = count
+    if extra and extra.get("segments"):
+        # straggler-attribution split (distview): worth a ring slot so
+        # a postmortem black box carries the last steps' segment shape
+        ev["segments"] = extra["segments"]
     flight.record("step_end", **ev)
     with _lock:
         fh = _jsonl_handle()
@@ -126,6 +151,7 @@ def step_end(samples=None, step_time=None, extra=None, count=1):
         rec = {
             "ts": round(time.time(), 6),
             "step": step_no,
+            "rank": _proc_rank(),
             "step_time_s": step_time,
             "samples": samples,
             "spans": spans,
@@ -198,31 +224,66 @@ _server = {"httpd": None, "thread": None}
 
 def start_http_server(port=None):
     """Serve ``render_prom()`` on ``/metrics`` from a daemon thread
-    (stdlib only).  ``port=None`` reads ``MXNET_TPU_TELEMETRY_PORT``;
-    0 binds an ephemeral port.  Returns the server object (its
+    (stdlib only), plus the live-debug surface: ``/debug`` (JSON rank
+    status) and ``POST /debug/capture`` (trigger an on-demand bounded
+    profiler window + flight snapshot — see ``telemetry.distview``;
+    refused with 403 unless ``MXNET_TPU_CAPTURE_DIR`` armed capture).
+    ``port=None`` reads ``MXNET_TPU_TELEMETRY_PORT``
+    (:func:`env_port`); 0 binds an
+    ephemeral port.  Returns the server object (its
     ``server_address[1]`` is the bound port); idempotent per process.
     """
     if _server["httpd"] is not None:
         return _server["httpd"]
     if port is None:
-        try:
-            port = int(os.environ.get("MXNET_TPU_TELEMETRY_PORT", "0"))
-        except ValueError:
-            port = 0
+        port = env_port()
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
-        def do_GET(self):
-            if self.path not in ("/", "/metrics"):
-                self.send_error(404)
-                return
-            body = render_prom().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+        def _send(self, body, ctype, status=200):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ("/", "/metrics"):
+                self._send(render_prom().encode("utf-8"),
+                           "text/plain; version=0.0.4; charset=utf-8")
+                return
+            if self.path.rstrip("/") == "/debug":
+                from . import distview
+                status = {
+                    "rank": _proc_rank(),
+                    "pid": os.getpid(),
+                    "step": int(counter("mxtpu_step_total").get()),
+                    "capture": distview.capture_status(),
+                }
+                self._send(json.dumps(status, default=repr)
+                           .encode("utf-8"), "application/json")
+                return
+            if self.path.rstrip("/") == "/debug/capture":
+                # a state change (profiler overhead + disk writes):
+                # POST only, and only when the operator armed capture
+                self.send_error(405, "POST /debug/capture")
+                return
+            self.send_error(404)
+
+        def do_POST(self):
+            if self.path.rstrip("/") != "/debug/capture":
+                self.send_error(404)
+                return
+            from . import distview
+            if not distview.capture_dir():
+                self._send(json.dumps(
+                    {"started": False,
+                     "reason": "MXNET_TPU_CAPTURE_DIR is not set"})
+                    .encode("utf-8"), "application/json", status=403)
+                return
+            res = distview.capture_now(trigger="http")
+            self._send(json.dumps(res).encode("utf-8"),
+                       "application/json")
 
         def log_message(self, fmt, *args):
             pass   # scrapes must not spam the training log

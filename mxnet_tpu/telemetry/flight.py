@@ -24,6 +24,7 @@ Dump schema (``"schema": "mxtpu-flight/1"``)::
       "schema": "mxtpu-flight/1",
       "reason": "oom" | "error" | "sigterm" | "crash" | <caller string>,
       "ts": <unix seconds>, "pid": ..., "host": ...,
+      "rank": <MXNET_TPU_PROCESS_ID>,
       "restart_count": <MXNET_TPU_RESTART_COUNT>,
       "error": <str or null>,
       "events": [{"seq": n, "ts": ..., "kind": ..., ...fields}, ...],
@@ -156,12 +157,14 @@ class FlightRecorder:
             restart = int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
         except ValueError:
             restart = 0
+        from .distview import rank as _rank
         return {
             "schema": "mxtpu-flight/1",
             "reason": str(reason),
             "ts": round(time.time(), 6),
             "pid": os.getpid(),
             "host": socket.gethostname(),
+            "rank": _rank(),
             "restart_count": restart,
             "error": None if error is None else str(error),
             "events": self.events(),

@@ -1,0 +1,90 @@
+"""Telemetry-only worker for the cross-rank observability tests.
+
+Run under the launcher (the aggregator path needs no cluster and no
+cross-process collectives — each rank only emits its own step-log):
+
+    MXNET_TPU_TELEMETRY_JSONL=/tmp/run.jsonl \
+        python tools/launch.py -n 2 python tests/dist_distview_worker.py
+
+Each rank emits ``DISTVIEW_STEPS`` synthetic training steps through
+``telemetry.step_end`` with straggler-attribution segments
+(telemetry.distview); rank ``DISTVIEW_SLOW_RANK`` sleeps an extra
+``DISTVIEW_SLOW_S`` per step, so the supervisor's merged run timeline
+(``<base>.run``, schema mxtpu-run/1) must name it the worst rank and
+``tools/run_top.py --summarize`` must call it the straggler.  Every rank
+also proves the per-rank surface: the segment metrics are present in its
+Prometheus rendering, and its step-log went to its OWN ``.rank<N>``
+stream (the port/JSONL collision fix).
+
+``DISTVIEW_SKEW_S`` additionally simulates the pre-collective timestamp
+barrier at the worker seam (this jax/CPU backend cannot run real
+cross-process collectives, so the barrier itself is untestable here):
+the FAST ranks sleep the skew as their ``collective_wait`` — exactly
+where a real barrier parks them while the straggler catches up — and
+every rank reports ``skew_s``/``slowest_rank`` in its step record, so
+the aggregated timeline must carry the injected skew and attribute the
+collective wait to the fast ranks, not the straggler.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu import telemetry  # noqa: E402
+from mxnet_tpu.telemetry import distview  # noqa: E402
+
+
+def main():
+    rank = distview.rank()
+    world = distview.world()
+    slow_rank = int(os.environ.get("DISTVIEW_SLOW_RANK", "-1"))
+    steps = int(os.environ.get("DISTVIEW_STEPS", "4"))
+    slow_s = float(os.environ.get("DISTVIEW_SLOW_S", "0.15"))
+    base_s = float(os.environ.get("DISTVIEW_BASE_S", "0.02"))
+    skew_s = float(os.environ.get("DISTVIEW_SKEW_S", "0"))
+
+    # the launcher must have redirected this rank's step-log to its own
+    # stream — co-located ranks interleaving one file is the bug class
+    # this PR fixes
+    jsonl = telemetry.jsonl_path()
+    assert jsonl and jsonl.endswith(".rank%d" % rank), jsonl
+
+    if distview.capture_dir():
+        assert distview.install_capture_handler()
+
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        time.sleep(base_s / 2)                   # "input wait"
+        input_s = time.perf_counter() - t0
+        time.sleep(base_s / 2 +
+                   (slow_s if rank == slow_rank else 0.0))  # "compute"
+        collective_s = 0.0
+        if skew_s and rank != slow_rank:
+            # simulated barrier: the fast ranks pay the straggler's
+            # lead as collective wait (see module docstring)
+            time.sleep(skew_s)
+            collective_s = skew_s
+        total = time.perf_counter() - t0
+        segments = distview.record_step_segments(
+            total, input_s=input_s, collective_s=collective_s)
+        extra = {"segments": segments}
+        if skew_s:
+            extra["skew_s"] = skew_s
+            extra["slowest_rank"] = slow_rank
+        telemetry.step_end(samples=8, step_time=total, extra=extra)
+
+    if os.environ.get("DISTVIEW_HOLD_S"):
+        # keep the rank alive so the parent can SIGUSR1 a RUNNING worker
+        time.sleep(float(os.environ["DISTVIEW_HOLD_S"]))
+
+    prom = telemetry.render_prom()
+    assert "mxtpu_step_segment_seconds" in prom, "segment metrics missing"
+    port = telemetry.env_port()
+    print("distview worker %d/%d OK port=%d" % (rank, world, port))
+
+
+if __name__ == "__main__":
+    main()

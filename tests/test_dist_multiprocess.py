@@ -243,6 +243,153 @@ def test_dist_kvstore_bigkey_sharding_4w2s():
 
 
 @pytest.mark.timeout(600)
+def test_dist_distview_straggler_attribution(tmp_path):
+    """ISSUE 5 acceptance: a 2-process run with an injected slow rank.
+    Each rank runs the telemetry-only distview worker (no collectives
+    needed — rank 1 sleeps DISTVIEW_SLOW_S extra per step, and the
+    simulated barrier charges the skew to the fast rank's
+    collective_wait); the launch.py supervisor's merged run timeline
+    must name rank 1 the straggler, carry the injected skew, attribute
+    collective wait to the FAST rank, and every rank must see the
+    segment metrics in its own Prometheus rendering and write its own
+    .rank<N> step-log stream (the port/JSONL collision fix)."""
+    import json
+
+    base = str(tmp_path / "run.jsonl")
+    env = {"MXNET_TPU_TELEMETRY_JSONL": base,
+           "DISTVIEW_STEPS": "4", "DISTVIEW_SLOW_RANK": "1",
+           "DISTVIEW_SLOW_S": "0.12", "DISTVIEW_SKEW_S": "0.05",
+           "DISTVIEW_BASE_S": "0.01"}
+    res, out = _launch("dist_distview_worker.py", n=2, timeout=280,
+                       extra_env=env,
+                       extra_args=["--heartbeat-interval", "0.1"])
+    assert res.returncode == 0, out
+    for rank in range(2):
+        # the worker itself asserts mxtpu_step_segment_seconds is in
+        # its Prometheus rendering and that its step-log is .rank<N>
+        assert "distview worker %d/2 OK" % rank in out, out
+        assert os.path.exists(base + ".rank%d" % rank), out
+
+    run_path = base + ".run"
+    assert os.path.exists(run_path), out
+    res2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "run_top.py"),
+         run_path, "--summarize", "--json"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert res2.returncode == 0, res2.stdout + res2.stderr
+    summary = json.loads(res2.stdout)
+    assert summary["straggler"] == 1, summary
+    assert summary["steps"] >= 4, summary
+    assert summary["num_ranks"] == 2, summary
+    # mxtpu_rank_step_skew_seconds reflects the injected delay
+    assert summary["skew_max_s"] == pytest.approx(0.05), summary
+    seg0 = summary["per_rank"]["0"]["segments_s"]
+    seg1 = summary["per_rank"]["1"]["segments_s"]
+    # collective wait is attributed to the FAST rank, not the straggler
+    assert seg0["collective_wait"] == pytest.approx(0.2, rel=0.25), \
+        summary
+    assert seg1["collective_wait"] == pytest.approx(0.0), summary
+    # the injected delay shows up as the straggler's compute segment
+    assert seg1["compute"] > seg0["compute"] + 0.3, summary
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_dist_distview_sigusr1_live_capture(tmp_path):
+    """ISSUE 5 acceptance: SIGUSR1 on a live worker produces a bounded
+    profiler trace window plus a flight snapshot WITHOUT interrupting
+    training.  A 2-rank job runs its steps then holds; mid-hold,
+    ``tools/launch.py --capture`` broadcasts SIGUSR1 via the supervisor
+    JSONL's worker pids; the job must still exit 0 with every rank OK,
+    and each rank must leave a flight-*-capture.json (whose ring holds
+    the completed steps) plus an xplane trace under its capture dir."""
+    import json
+    import time
+
+    base = str(tmp_path / "run.jsonl")
+    capdir = str(tmp_path / "capture")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_NUM_PROCESSES", None)
+    env.pop("MXNET_TPU_PROCESS_ID", None)
+    if "PYTHONPATH" in env:
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if "axon" not in p]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            env.pop("PYTHONPATH")
+    env.update({"MXNET_TPU_TELEMETRY_JSONL": base,
+                "MXNET_TPU_CAPTURE_DIR": capdir,
+                "MXNET_TPU_CAPTURE_SECONDS": "1",
+                "DISTVIEW_STEPS": "3", "DISTVIEW_BASE_S": "0.02",
+                "DISTVIEW_SLOW_RANK": "-1",
+                "DISTVIEW_HOLD_S": "60"})
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local",
+           "--heartbeat-interval", "0.2",
+           sys.executable,
+           os.path.join(ROOT, "tests", "dist_distview_worker.py")]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            cwd=ROOT, env=env)
+    try:
+        def steps_done():
+            for r in (0, 1):
+                p = base + ".rank%d" % r
+                try:
+                    with open(p) as f:
+                        if sum(1 for _ in f) < 3:
+                            return False
+                except OSError:
+                    return False
+            return True
+
+        deadline = time.time() + 180
+        while not steps_done() and time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.5)
+        assert proc.poll() is None and steps_done(), \
+            "workers never reached steady state:\n" + \
+            (proc.communicate()[0] if proc.poll() is not None else "")
+
+        res = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+             "--capture", "--jsonl", base],
+            capture_output=True, text=True, timeout=60, cwd=ROOT,
+            env=env)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "signaled" in res.stdout, res.stdout
+
+        out, _ = proc.communicate(timeout=400)
+    except BaseException:
+        proc.kill()
+        raise
+    # training was not interrupted: clean exit, every rank OK
+    assert proc.returncode == 0, out
+    for rank in range(2):
+        assert "distview worker %d/2 OK" % rank in out, out
+        rdir = os.path.join(capdir, "rank%d" % rank)
+        snaps = [f for f in os.listdir(rdir)
+                 if f.startswith("flight-") and
+                 f.endswith("-capture.json")]
+        assert snaps, "no flight snapshot for rank %d:\n%s" % (rank, out)
+        doc = json.load(open(os.path.join(rdir, snaps[0])))
+        assert doc["schema"] == "mxtpu-flight/1", doc
+        assert doc["rank"] == rank, doc
+        kinds = [e.get("kind") for e in doc["events"]]
+        assert "capture" in kinds, kinds
+        # the ring snapshot carries the steps that already ran
+        assert kinds.count("step_end") >= 3, kinds
+        import glob as _glob
+        planes = _glob.glob(os.path.join(rdir, "**", "*.xplane.pb"),
+                            recursive=True)
+        assert planes, "no trace window for rank %d:\n%s" % (rank, out)
+
+
+@pytest.mark.timeout(600)
 def test_dist_train_convergence_identical_replicas():
     """Reference tests/nightly/dist_lenet.py equivalent: 4 processes
     train the MLP to >0.9 accuracy with dist_sync gradient allreduce,

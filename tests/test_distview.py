@@ -1,0 +1,791 @@
+"""Cross-rank run observability (telemetry.distview + tools).
+
+Covers the contracts in docs/api/telemetry.md "Cross-rank
+observability": the step-segment split, the pre-collective timestamp
+barrier's metrics (allgather faked — this jax/CPU backend cannot run
+real cross-process collectives), the per-rank metrics-port offset, the
+RunAggregator's mxtpu-run/1 timeline over synthetic multi-rank JSONL
+fixtures with a seeded slow rank (worst-rank id, skew history, partial
+steps, event passthrough, flight-dump surfacing), the
+read_run_timeline validator, tools/run_top.py's dashboard/--summarize
+renderings, tools/flight_read.py's merged directory view and
+run-timeline mode, and the on-demand capture window.
+"""
+import importlib.util
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import distview, flight
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_TELEMETRY_JSONL", raising=False)
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ------------------------------------------------------- step segments
+
+def test_record_step_segments_split_and_metric():
+    seg = distview.record_step_segments(0.5, input_s=0.1,
+                                        collective_s=0.15)
+    assert seg == {"compute": pytest.approx(0.25, abs=1e-9),
+                   "input_wait": pytest.approx(0.1),
+                   "collective_wait": pytest.approx(0.15)}
+    h = telemetry.histogram("mxtpu_step_segment_seconds")
+    for name in ("compute", "input_wait", "collective_wait"):
+        assert h.labels(segment=name).get()["count"] == 1
+
+
+def test_record_step_segments_compute_floor_and_count():
+    # over-attributed waits floor compute at 0 instead of going negative
+    seg = distview.record_step_segments(0.1, input_s=0.2,
+                                        collective_s=0.2, count=4)
+    assert seg["compute"] == 0.0
+    # count>1 (a run_steps chain) observes the per-step average COUNT
+    # times — mirroring how step_end feeds mxtpu_step_seconds, so the
+    # two histograms' sums/counts stay comparable across chain and
+    # single-step ranks
+    h = telemetry.histogram("mxtpu_step_segment_seconds").labels(
+        segment="input_wait").get()
+    assert h["count"] == 4
+    assert h["sum"] == pytest.approx(0.2)
+
+
+# ----------------------------------------------------- timestamp barrier
+
+def test_pre_collective_barrier_disabled_and_single_process(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SKEW_EVERY", "0")
+    assert distview.pre_collective_barrier("t") is None
+    monkeypatch.setenv("MXNET_TPU_SKEW_EVERY", "1")
+    # real jax, single process: no cross-rank skew to measure
+    assert distview.pre_collective_barrier("t") is None
+
+
+def test_pre_collective_barrier_records_skew(monkeypatch):
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setenv("MXNET_TPU_SKEW_EVERY", "1")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+    def fake_allgather(x):
+        # rank 1 arrives 0.25s after this rank: rank 1 is the straggler
+        return np.asarray([[float(x[0])], [float(x[0]) + 0.25]])
+
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        fake_allgather)
+    distview._skew_state.clear()
+    distview._skew_state["calls"] = 0
+    info = distview.pre_collective_barrier("test.site")
+    assert info is not None
+    assert info["slowest_rank"] == 1
+    assert info["skew_s"] == pytest.approx(0.25)
+    assert info["rank"] == 0
+    assert telemetry.gauge("mxtpu_rank_step_skew_seconds").get() == \
+        pytest.approx(0.25)
+    assert telemetry.histogram(
+        "mxtpu_collective_wait_seconds").get()["count"] == 1
+    skews = [e for e in flight.events() if e.get("kind") == "skew"]
+    assert skews and skews[-1]["slowest_rank"] == 1
+
+
+def test_pre_collective_barrier_interval(monkeypatch):
+    import jax
+
+    monkeypatch.setenv("MXNET_TPU_SKEW_EVERY", "3")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    calls = []
+
+    def fake_allgather(x):
+        calls.append(1)
+        return np.asarray([[float(x[0])], [float(x[0])]])
+
+    from jax.experimental import multihost_utils
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        fake_allgather)
+    distview._skew_state.clear()
+    distview._skew_state["calls"] = 0
+    results = [distview.pre_collective_barrier("t") for _ in range(6)]
+    # barriers 1 and 4 measure; the first also burns one untimed
+    # warm-up allgather so compile time never pollutes the histogram
+    assert len(calls) == 3
+    assert sum(r is not None for r in results) == 2
+
+
+def test_pre_collective_barrier_never_raises(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    from jax.experimental import multihost_utils
+
+    def boom(x):
+        raise RuntimeError("collective backend down")
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", boom)
+    distview._skew_state.clear()
+    distview._skew_state["calls"] = 0
+    assert distview.pre_collective_barrier("t") is None   # degraded, alive
+
+
+# ------------------------------------------------------ per-rank ports
+
+def test_env_port_parsing(monkeypatch):
+    # the LOCAL launcher assigns port+rank per worker env; the worker
+    # side binds exactly what it is given (ssh ranks keep the
+    # configured port — one per host, no collision)
+    monkeypatch.setenv("MXNET_TPU_TELEMETRY_PORT", "9102")
+    assert telemetry.env_port() == 9102
+    monkeypatch.setenv("MXNET_TPU_TELEMETRY_PORT", "0")
+    assert telemetry.env_port() == 0
+    monkeypatch.setenv("MXNET_TPU_TELEMETRY_PORT", "junk")
+    assert telemetry.env_port() == 0
+    monkeypatch.delenv("MXNET_TPU_TELEMETRY_PORT")
+    assert telemetry.env_port() == 0
+
+
+def test_local_launcher_assigns_offset_ports(tmp_path):
+    """The port-collision fix: tools/launch.py's local launcher gives
+    rank N port+N and records the choice in worker_start events."""
+    import subprocess
+
+    base = str(tmp_path / "run.jsonl")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_NUM_PROCESSES", None)
+    env.pop("MXNET_TPU_PROCESS_ID", None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "MXNET_TPU_TELEMETRY_JSONL": base,
+                "MXNET_TPU_TELEMETRY_PORT": "0",
+                "DISTVIEW_STEPS": "1", "DISTVIEW_BASE_S": "0.0",
+                "DISTVIEW_SLOW_RANK": "-1"})
+    # each worker records its env in its OWN file (the shared stdout
+    # pipe interleaves concurrent writes mid-line — a flake, not a
+    # signal; nothing binds, so no port flake either); the supervisor
+    # record must carry the same assignment
+    env["MXNET_TPU_TELEMETRY_PORT"] = "9300"
+    script = tmp_path / "printport.py"
+    script.write_text(
+        "import os\n"
+        "open(os.path.join(%r, 'port.rank%%s'\n"
+        "     %% os.environ['MXNET_TPU_PROCESS_ID']), 'w')\\\n"
+        "    .write(os.environ['MXNET_TPU_TELEMETRY_PORT'])\n"
+        % str(tmp_path))
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local",
+         "--heartbeat-interval", "0.1",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, cwd=ROOT, env=env)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert (tmp_path / "port.rank0").read_text() == "9300"
+    assert (tmp_path / "port.rank1").read_text() == "9301"
+    ports = []
+    with open(base) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("event") == "worker_start":
+                ports.append((rec["rank"], rec["telemetry_port"]))
+    assert sorted(ports) == [(0, 9300), (1, 9301)], ports
+
+
+# -------------------------------------------------------- aggregation
+
+def _feed_synthetic_run(agg, base, num_steps=4, slow_rank=1,
+                        skew_s=0.1):
+    """Append a synthetic 2-rank run to the per-rank streams: rank
+    ``slow_rank`` is ~10x slower per step, every record carries the
+    segment split and the (simulated) measured skew."""
+    t = 1000.0
+    for step in range(1, num_steps + 1):
+        for r in (0, 1):
+            slow = r == slow_rank
+            t_s = 0.11 if slow else 0.01
+            rec = {"step": step, "ts": t + step, "rank": r,
+                   "step_time_s": t_s,
+                   "segments": {"compute": t_s - 0.004,
+                                "input_wait": 0.004,
+                                "collective_wait":
+                                    0.0 if slow else skew_s},
+                   "skew_s": skew_s, "slowest_rank": slow_rank}
+            with open(distview.rank_jsonl_path(base, r), "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+
+def test_aggregator_timeline_and_summary(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    base = str(tmp_path / "run.jsonl")
+    agg = distview.RunAggregator(base, 2)
+    _feed_synthetic_run(agg, base)
+    agg.note_event({"event": "worker_start", "rank": 0, "pid": 11,
+                    "telemetry_port": 9100})
+    assert agg.poll() == 8
+    agg.close()
+
+    recs = distview.read_run_timeline(base + ".run")
+    assert recs[0]["schema"] == "mxtpu-run/1"
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert [s["step"] for s in steps] == [1, 2, 3, 4]
+    for s in steps:
+        assert s["n_ranks"] == 2
+        assert s["worst_rank"] == 1
+        assert s["max_s"] == pytest.approx(0.11)
+        assert s["min_s"] == pytest.approx(0.01)
+        # 2 ranks: p50 must be the lower-middle value, not the max
+        assert s["p50_s"] == pytest.approx(0.01)
+        assert s["skew_s"] == pytest.approx(0.1)
+        assert s["ranks"]["1"]["segments"]["collective_wait"] == 0.0
+    assert recs[-1]["kind"] == "run_end"
+
+    summary = distview.summarize_run(recs)
+    assert summary["straggler"] == 1
+    assert summary["steps"] == 4 and summary["complete_steps"] == 4
+    assert summary["skew_max_s"] == pytest.approx(0.1)
+    # collective wait is paid by the FAST rank, not the straggler
+    assert summary["per_rank"]["0"]["segments_s"]["collective_wait"] \
+        == pytest.approx(0.4)
+    assert summary["per_rank"]["1"]["segments_s"]["collective_wait"] \
+        == pytest.approx(0.0)
+    assert summary["per_rank"]["1"]["p50_s"] == pytest.approx(0.11)
+    assert any(e.get("event") == "worker_start"
+               for e in summary["events"])
+    assert summary["ended"] is True
+
+
+def test_aggregator_emits_partial_steps_on_close(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    base = str(tmp_path / "run.jsonl")
+    agg = distview.RunAggregator(base, 2)
+    # only rank 0 ever reports step 1 (rank 1 died)
+    with open(distview.rank_jsonl_path(base, 0), "a") as f:
+        f.write(json.dumps({"step": 1, "ts": 1.0,
+                            "step_time_s": 0.02}) + "\n")
+    agg.poll()
+    # incomplete and inside the window: not emitted yet
+    assert not [r for r in
+                distview.read_run_timeline(base + ".run")
+                if r["kind"] == "step"]
+    agg.close()
+    steps = [r for r in distview.read_run_timeline(base + ".run")
+             if r["kind"] == "step"]
+    assert len(steps) == 1 and steps[0]["n_ranks"] == 1
+    assert steps[0]["worst_rank"] == 0
+
+
+def test_aggregator_surfaces_flight_dumps(tmp_path, monkeypatch):
+    flight_dir = tmp_path / "flightdir"
+    flight_dir.mkdir()
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(flight_dir))
+    base = str(tmp_path / "run.jsonl")
+    agg = distview.RunAggregator(base, 1)
+    (flight_dir / "flight-7-001-error.json").write_text("{}")
+    agg.poll()
+    agg.close()
+    events = [r for r in distview.read_run_timeline(base + ".run")
+              if r["kind"] == "event"]
+    assert any(e.get("event") == "flight_dump"
+               and e["path"].endswith("flight-7-001-error.json")
+               for e in events)
+
+
+def test_aggregator_extreme_laggard_no_duplicate_steps(tmp_path,
+                                                       monkeypatch):
+    """A rank lagging far beyond the emit window (and beyond the
+    pruned _emitted region) must not re-open steps already flushed
+    partial — each step appears in the timeline exactly once."""
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    base = str(tmp_path / "run.jsonl")
+    agg = distview.RunAggregator(base, 2, window=2)
+    for step in range(1, 41):         # rank 0 races 40 steps ahead
+        agg.feed(0, {"step": step, "ts": float(step),
+                     "step_time_s": 0.01})
+    for step in range(1, 41):         # rank 1 finally reports them all
+        agg.feed(1, {"step": step, "ts": float(step),
+                     "step_time_s": 0.5})
+    agg.close()
+    steps = [r for r in distview.read_run_timeline(base + ".run")
+             if r["kind"] == "step"]
+    seen = [s["step"] for s in steps]
+    assert sorted(set(seen)) == list(range(1, 41))
+    assert len(seen) == len(set(seen)), \
+        "duplicate step records: %s" % seen
+
+
+def test_summarize_run_count_aware_totals(tmp_path, monkeypatch):
+    """A run_steps chain reports the per-step AVERAGE time with a
+    count; the summary's steps/total_s must scale by it so they agree
+    with the (whole-chain) segment totals."""
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    base = str(tmp_path / "run.jsonl")
+    agg = distview.RunAggregator(base, 1)
+    agg.feed(0, {"step": 50, "ts": 1.0, "step_time_s": 0.01,
+                 "count": 50,
+                 "segments": {"compute": 0.45, "input_wait": 0.05,
+                              "collective_wait": 0.0}})
+    agg.close()
+    summary = distview.summarize_run(
+        distview.read_run_timeline(base + ".run"))
+    pr = summary["per_rank"]["0"]
+    assert pr["steps"] == 50
+    assert pr["total_s"] == pytest.approx(0.5)       # 50 x 0.01
+    assert sum(pr["segments_s"].values()) == pytest.approx(0.5)
+    assert pr["p50_s"] == pytest.approx(0.01)        # per-step average
+
+
+def test_aggregator_rerun_ignores_stale_streams(tmp_path, monkeypatch):
+    """Workers append to their streams: a second job over the same
+    base must tail from EOF (not re-ingest the old run, whose repeated
+    step numbers would shadow the new steps) and start a fresh
+    timeline file."""
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    base = str(tmp_path / "run.jsonl")
+    # run 1
+    agg1 = distview.RunAggregator(base, 1)
+    agg1.feed(0, {"step": 1, "ts": 1.0, "step_time_s": 0.5})
+    with open(distview.rank_jsonl_path(base, 0), "a") as f:
+        f.write(json.dumps({"step": 1, "ts": 1.0,
+                            "step_time_s": 0.5}) + "\n")
+    agg1.poll()
+    agg1.close()
+    # run 2 over the SAME base: old stream content must be skipped
+    agg2 = distview.RunAggregator(base, 1)
+    with open(distview.rank_jsonl_path(base, 0), "a") as f:
+        f.write(json.dumps({"step": 1, "ts": 2.0,
+                            "step_time_s": 0.01}) + "\n")
+    agg2.poll()
+    agg2.close()
+    recs = distview.read_run_timeline(base + ".run")   # fresh header
+    assert sum(1 for r in recs if r["kind"] == "run_begin") == 1
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert len(steps) == 1
+    assert steps[0]["ranks"]["0"]["t_s"] == pytest.approx(0.01)
+
+
+def test_read_run_timeline_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.run"
+    p.write_text("")
+    with pytest.raises(ValueError, match="empty"):
+        distview.read_run_timeline(str(p))
+    p.write_text('{"kind": "step"}\n')
+    with pytest.raises(ValueError, match="run_begin"):
+        distview.read_run_timeline(str(p))
+    head = json.dumps({"schema": "mxtpu-run/1", "kind": "run_begin",
+                       "num_ranks": 1})
+    p.write_text(head + "\nnot json\n")
+    with pytest.raises(ValueError, match="line 2"):
+        distview.read_run_timeline(str(p))
+    p.write_text(head + '\n{"kind": "mystery"}\n')
+    with pytest.raises(ValueError, match="unknown kind"):
+        distview.read_run_timeline(str(p))
+    p.write_text(head + '\n{"kind": "step", "step": "one"}\n')
+    with pytest.raises(ValueError, match="int 'step'"):
+        distview.read_run_timeline(str(p))
+
+
+def test_read_run_timeline_tolerates_live_partial_tail(tmp_path):
+    """A LIVE timeline may end mid-append: an unterminated, unparseable
+    final line is an in-progress record, not corruption — one-shot
+    run_top/flight_read on a running job must still render."""
+    p = tmp_path / "x.run"
+    head = json.dumps({"schema": "mxtpu-run/1", "kind": "run_begin",
+                       "num_ranks": 1})
+    step = json.dumps({"kind": "step", "step": 1,
+                       "ranks": {"0": {"t_s": 0.1}}})
+    p.write_text(head + "\n" + step + "\n" + '{"kind": "st')
+    assert len(distview.read_run_timeline(str(p))) == 2
+    # a complete-but-unterminated final record is kept, not dropped
+    p.write_text(head + "\n" + step)
+    assert len(distview.read_run_timeline(str(p))) == 2
+
+
+def test_run_top_follow_recovers_from_truncation(tmp_path, monkeypatch,
+                                                 capsys):
+    """A job restart truncates <base>.run; an attached --follow must
+    reset its offset instead of freezing on the dead run's records."""
+    import threading
+
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    content = open(run_path).read()
+    head = content.splitlines()[0]
+    # dead run: no trailer (so --follow keeps polling) and padded LONGER
+    # than the new run, so the restart genuinely truncates below the
+    # follower's saved offset
+    pad = "".join(json.dumps({"kind": "event", "event": "padding",
+                              "n": i}) + "\n" for i in range(300))
+    open(run_path, "w").write(head + "\n" + pad)
+
+    def rewrite():
+        time.sleep(0.6)
+        open(run_path, "w").write(content)      # truncate + new full run
+
+    t = threading.Thread(target=rewrite)
+    t.start()
+    run_top = _load_tool("run_top")
+    assert run_top.main([run_path, "--follow", "--interval", "0.2"]) == 0
+    t.join()
+    out = capsys.readouterr().out
+    assert "[run ended]" in out                 # saw the NEW run's end
+    assert "straggler: rank 1" in out
+
+
+def test_run_top_follow_recovers_from_regrown_restart(tmp_path,
+                                                      monkeypatch,
+                                                      capsys):
+    """A restart whose NEW timeline regrows past the follower's saved
+    offset between polls never shrinks the file — run_top must detect
+    the new run_begin header (unique ts) and reset, not interleave the
+    dead run's records with a mid-record tail of the new one."""
+    import threading
+
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    content = open(run_path).read()
+    # dead run: a DIFFERENT (older) header, only a couple of records,
+    # and no trailer — strictly shorter than the new run, so size never
+    # shrinks across the restart
+    dead_head = json.dumps({"schema": distview.RUN_SCHEMA,
+                            "kind": "run_begin", "ts": 1.0,
+                            "num_ranks": 9, "base": "dead"})
+    open(run_path, "w").write(dead_head + "\n")
+
+    def rewrite():
+        time.sleep(0.6)
+        open(run_path, "w").write(content)      # restart: longer run
+    t = threading.Thread(target=rewrite)
+    t.start()
+    run_top = _load_tool("run_top")
+    assert run_top.main([run_path, "--follow", "--interval", "0.2"]) == 0
+    t.join()
+    out = capsys.readouterr().out
+    assert "[run ended]" in out                 # saw the NEW run's end
+    assert "straggler: rank 1" in out
+    assert "ranks=2" in out                     # new header, not ranks=9
+
+
+# ------------------------------------------------------------ run_top
+
+def _make_timeline(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_FLIGHT_DIR", raising=False)
+    base = str(tmp_path / "run.jsonl")
+    agg = distview.RunAggregator(base, 2)
+    _feed_synthetic_run(agg, base)
+    agg.poll()
+    agg.close()
+    return base + ".run"
+
+
+def test_run_top_summarize_names_straggler(tmp_path, monkeypatch,
+                                           capsys):
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    run_top = _load_tool("run_top")
+    assert run_top.main([run_path, "--summarize"]) == 0
+    out = capsys.readouterr().out
+    assert "straggler:      rank 1" in out
+    assert "peak skew:      100.000 ms" in out
+    assert "collective_wait=0.400s" in out      # paid by fast rank 0
+    assert "run ended:      True" in out
+
+
+def test_run_top_summarize_json_parses(tmp_path, monkeypatch, capsys):
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    run_top = _load_tool("run_top")
+    assert run_top.main([run_path, "--summarize", "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["straggler"] == 1
+    assert summary["skew_max_s"] == pytest.approx(0.1)
+
+
+def test_run_top_dashboard_renders(tmp_path, monkeypatch, capsys):
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    run_top = _load_tool("run_top")
+    assert run_top.main([run_path]) == 0
+    out = capsys.readouterr().out
+    assert "straggler: rank 1" in out
+    assert "worst" in out and "skew ms" in out
+    assert "[run ended]" in out
+
+
+def test_run_top_rejects_bad_timeline(tmp_path, capsys):
+    p = tmp_path / "bad.run"
+    p.write_text('{"kind": "nope"}\n')
+    run_top = _load_tool("run_top")
+    assert run_top.main([str(p), "--summarize"]) == 1
+
+
+def test_run_top_follow_tails_until_run_end(tmp_path, monkeypatch,
+                                            capsys):
+    """--follow over an already-ended timeline renders once through
+    the incremental tail and exits 0 at the run_end trailer."""
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    run_top = _load_tool("run_top")
+    assert run_top.main([run_path, "--follow",
+                         "--interval", "0.2"]) == 0
+    out = capsys.readouterr().out
+    assert "straggler: rank 1" in out and "[run ended]" in out
+
+
+def test_flight_read_timeline_json_honors_events(tmp_path, monkeypatch,
+                                                 capsys):
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    fr = _load_tool("flight_read")
+    assert fr.main([run_path, "--json", "--events", "2"]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown[0]["kind"] == "run_begin"      # header kept
+    assert len(shown) == 3                      # header + last 2
+    assert shown[-1]["kind"] == "run_end"
+
+
+# -------------------------------------------------------- flight_read
+
+def _fake_dump(rank, pid, ts, kinds):
+    return {"schema": "mxtpu-flight/1", "reason": "error", "ts": ts,
+            "pid": pid, "host": "h", "rank": rank, "restart_count": 0,
+            "error": "boom on rank %d" % rank,
+            "events": [{"seq": i, "ts": ts - 1 + 0.1 * i, "kind": k}
+                       for i, k in enumerate(kinds)],
+            "counters": {}, "gauges": {}, "memory_plans": {},
+            "live_memory": {}}
+
+
+def test_flight_read_directory_merges_ranks(tmp_path, capsys):
+    d = tmp_path / "dumps"
+    (d / "rank1").mkdir(parents=True)
+    with open(d / "flight-11-001-error.json", "w") as f:
+        json.dump(_fake_dump(0, 11, 100.0, ["step_begin", "error"]), f)
+    # nested (a --capture tree nests under rank<N>/) and newer
+    with open(d / "rank1" / "flight-22-001-capture.json", "w") as f:
+        json.dump(_fake_dump(1, 22, 101.0, ["capture"]), f)
+    fr = _load_tool("flight_read")
+    assert fr.main([str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "merged flight view: 2 dump(s)" in out
+    assert "r0/11" in out and "r1/22" in out
+    # one time axis: rank 0's events precede rank 1's newer capture
+    assert out.index("r0/11") < out.index("r1/22")
+
+
+def test_flight_read_directory_skips_malformed(tmp_path, capsys):
+    d = tmp_path / "dumps"
+    d.mkdir()
+    (d / "flight-1-001-error.json").write_text("not json")
+    with open(d / "flight-2-001-error.json", "w") as f:
+        json.dump(_fake_dump(0, 2, 100.0, ["error"]), f)
+    fr = _load_tool("flight_read")
+    assert fr.main([str(d)]) == 0
+    assert "merged flight view: 1 dump(s)" in capsys.readouterr().out
+
+
+def test_flight_read_empty_directory_fails(tmp_path, capsys):
+    d = tmp_path / "empty"
+    d.mkdir()
+    fr = _load_tool("flight_read")
+    assert fr.main([str(d)]) == 1
+
+
+def test_flight_read_validates_run_timeline(tmp_path, monkeypatch,
+                                            capsys):
+    run_path = _make_timeline(tmp_path, monkeypatch)
+    fr = _load_tool("flight_read")
+    assert fr.main([run_path]) == 0
+    out = capsys.readouterr().out
+    assert "valid mxtpu-run/1 timeline" in out
+    assert "straggler=1" in out
+
+
+# -------------------------------------------------- /debug endpoints
+
+def test_debug_endpoints(monkeypatch, tmp_path):
+    import urllib.error
+    import urllib.request
+
+    srv = telemetry.start_http_server(0)
+    port = srv.server_address[1]
+    status = json.load(urllib.request.urlopen(
+        "http://127.0.0.1:%d/debug" % port, timeout=10))
+    assert set(status) >= {"rank", "pid", "step", "capture"}
+    assert status["pid"] == os.getpid()
+    assert status["capture"]["active"] in (True, False)
+
+    calls = []
+
+    def fake_capture(trigger):
+        calls.append(trigger)
+        return {"started": True, "dir": "/nowhere", "seconds": 1}
+
+    monkeypatch.setattr(distview, "capture_now", fake_capture)
+
+    def post(path):
+        return urllib.request.urlopen(urllib.request.Request(
+            "http://127.0.0.1:%d%s" % (port, path), data=b"",
+            method="POST"), timeout=10)
+
+    # a state change needs POST and an armed MXNET_TPU_CAPTURE_DIR
+    monkeypatch.delenv("MXNET_TPU_CAPTURE_DIR", raising=False)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post("/debug/capture")
+    assert ei.value.code == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            "http://127.0.0.1:%d/debug/capture" % port, timeout=10)
+    assert ei.value.code == 405
+    assert calls == []
+
+    monkeypatch.setenv("MXNET_TPU_CAPTURE_DIR", str(tmp_path))
+    res = json.load(post("/debug/capture"))
+    assert res["started"] is True
+    assert calls == ["http"]
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            "http://127.0.0.1:%d/nonsense" % port, timeout=10)
+
+
+# ---------------------------------------------------- on-demand capture
+
+def test_capture_handler_signal_triggers_capture(monkeypatch):
+    calls = []
+    monkeypatch.setattr(distview, "capture_now",
+                        lambda trigger: calls.append(trigger))
+    assert distview.install_capture_handler()
+    assert distview.install_capture_handler()       # idempotent
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.time() + 5
+    while not calls and time.time() < deadline:
+        time.sleep(0.01)
+    assert calls == ["signal"]
+
+
+@pytest.mark.slow
+def test_capture_now_writes_flight_snapshot_and_trace(tmp_path):
+    res = distview.capture_now(trigger="api", seconds=0.3,
+                               directory=str(tmp_path))
+    assert res["started"] is True
+    out_dir = res["dir"]
+    assert out_dir == os.path.join(str(tmp_path), "rank0")
+    deadline = time.time() + 120
+    while distview.capture_status()["active"] and \
+            time.time() < deadline:
+        time.sleep(0.1)
+    last = distview.capture_status()["last"]
+    assert last is not None and last["trigger"] == "api"
+    # the flight snapshot is written even if the profiler cannot trace
+    assert last["flight"] and os.path.exists(last["flight"])
+    doc = json.load(open(last["flight"]))
+    assert doc["schema"] == "mxtpu-flight/1"
+    assert doc["reason"] == "capture"
+    assert telemetry.counter("mxtpu_capture_total").labels(
+        trigger="api").get() >= 1
+    # a concurrent second capture while one is active is dropped
+    # (cannot be raced reliably here; the lock path is exercised above)
+
+
+@pytest.mark.slow
+def test_xprof_top_trace_mode_reads_foreign_capture(tmp_path, capsys):
+    """tools/xprof_top.py --trace consumes a capture it did not take
+    (the SIGUSR1 window shape): per-op attribution with no model
+    build, via the version-tolerant xplane loader."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: jnp.tanh(x @ x))
+    x = jnp.ones((256, 256), jnp.float32)
+    f(x).block_until_ready()
+    jax.profiler.start_trace(str(tmp_path))
+    for _ in range(10):
+        f(x).block_until_ready()
+    jax.profiler.stop_trace()
+
+    xt = _load_tool("xprof_top")
+    planes = xt.find_planes(str(tmp_path))
+    assert planes and planes[-1].endswith(".xplane.pb")
+    assert xt.summarize_planes(planes, total_steps=10) is True
+    out = capsys.readouterr().out
+    assert "--- top ops" in out
+    assert "dot" in out      # the matmul is attributed by op name
+
+
+def test_capture_status_shape():
+    st = distview.capture_status()
+    assert set(st) == {"active", "last"}
+    assert isinstance(st["active"], bool)
+
+
+def test_capture_now_nonblocking_under_held_lock():
+    """A SIGUSR1 handler runs capture_now on the MAIN thread, possibly
+    while that same thread already holds the capture lock — the entry
+    check must drop the trigger, never block (deadlock)."""
+    assert distview._capture_lock.acquire(blocking=False)
+    try:
+        res = distview.capture_now(trigger="api")
+    finally:
+        distview._capture_lock.release()
+    assert res["started"] is False
+    assert "busy" in res["reason"]
+
+
+def _capture_jsonl(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_capture_job_signals_live_workers(tmp_path, monkeypatch):
+    launch = _load_tool("launch")
+    base = str(tmp_path / "sup.jsonl")
+    me = os.getpid()
+    _capture_jsonl(base, [
+        {"event": "job_start", "pid": me},
+        {"event": "worker_start", "rank": 0, "pid": me},
+    ])
+    sent = []
+    real_kill = os.kill
+
+    def fake_kill(pid, sig):
+        if sig == 0:
+            return real_kill(pid, sig)     # the liveness probe
+        sent.append(("kill", pid, sig))
+
+    monkeypatch.setattr(os, "kill", fake_kill)
+    monkeypatch.setattr(os, "killpg",
+                        lambda pgid, sig: sent.append(("killpg", pgid,
+                                                       sig)))
+    assert launch.capture_job(base) == 0
+    assert sent and all(s[2] == signal.SIGUSR1 for s in sent)
+
+
+def test_capture_job_ignores_finished_job(tmp_path, monkeypatch):
+    """After the job_end marker every recorded pid is stale: --capture
+    must refuse to signal (a reused pid has no SIGUSR1 handler and
+    would be terminated by the default disposition)."""
+    launch = _load_tool("launch")
+    base = str(tmp_path / "sup.jsonl")
+    me = os.getpid()
+    _capture_jsonl(base, [
+        {"event": "job_start", "pid": me},
+        {"event": "worker_start", "rank": 0, "pid": me},
+        {"event": "job_end", "pid": me},
+    ])
+    sent = []
+    monkeypatch.setattr(os, "killpg",
+                        lambda pgid, sig: sent.append((pgid, sig)))
+    assert launch.capture_job(base) == 1
+    assert sent == []

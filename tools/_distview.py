@@ -1,0 +1,24 @@
+"""Shared by-path loader for ``mxnet_tpu/telemetry/distview.py``.
+
+The reader tools (``run_top.py``, ``flight_read.py``) are stdlib-only
+and must not import the framework — a package import would drag jax
+into a supervisor-side process that only reads text streams — so they
+load distview's aggregation half by file path through this one helper.
+``launch.py`` keeps its own variant on purpose: the supervisor must
+degrade to its old no-timeline behavior when the module is broken,
+where the readers should fail loudly.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+
+def load_distview():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "telemetry",
+                        "distview.py")
+    spec = importlib.util.spec_from_file_location("mxtpu_distview", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Five stages, all of which must be clean:
+Six stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -22,6 +22,11 @@ Five stages, all of which must be clean:
    must produce a well-formed black-box dump in
    ``MXNET_TPU_FLIGHT_DIR`` that ``tools/flight_read.py`` parses and
    formats.
+6. **distview smoke** — a 2-process telemetry dry-run under the
+   ``tools/launch.py`` run aggregator (one rank seeded slow) must
+   leave an ``mxtpu-run/1`` timeline that ``tools/run_top.py
+   --summarize --json`` parses, naming the slow rank the straggler
+   with per-rank segment totals.
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -57,7 +62,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/5] mxlint: %d finding(s) over %s"
+        say("ci_check[1/6] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -66,7 +71,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/5] registry selfcheck: %d problem(s)"
+        say("ci_check[2/6] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -80,14 +85,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/5] verify model %-22s %s" % (name, status))
+            say("ci_check[3/6] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/5] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/6] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -95,9 +100,18 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/5] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/6] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
+            say("  " + p)
+
+        # stage 6: distview smoke (2-process aggregator -> run timeline
+        # -> run_top summary)
+        problems = distview_smoke(repo_root)
+        say("ci_check[6/6] distview smoke: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("distview: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -212,6 +226,102 @@ def flight_smoke(repo_root=_ROOT):
                 os.environ[k] = v
         resilience.clear_faults()
         import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def distview_smoke(repo_root=_ROOT):
+    """End-to-end cross-rank observability check: a 2-process
+    telemetry-only dry-run (``tests/dist_distview_worker.py``, no
+    cluster, no collectives) under the ``tools/launch.py`` supervisor,
+    rank 1 seeded slow.  The supervisor's run aggregator must leave an
+    ``mxtpu-run/1`` timeline that ``tools/run_top.py --summarize
+    --json`` parses, naming rank 1 the straggler with per-rank segment
+    totals.  Returns a list of problem strings (empty = clean)."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_distview_smoke_")
+    base = os.path.join(tmpdir, "run.jsonl")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "MXNET_TPU_TELEMETRY_JSONL": base,
+                "DISTVIEW_STEPS": "3",
+                "DISTVIEW_SLOW_RANK": "1",
+                "DISTVIEW_SLOW_S": "0.1",
+                "DISTVIEW_BASE_S": "0.01"})
+    # one CPU device per worker; ranks never join a jax.distributed job
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_NUM_PROCESSES", None)
+    env.pop("MXNET_TPU_PROCESS_ID", None)
+    # TPU-tunnel site plugins (axon) break CPU multi-process
+    # coordination — scrub them, as every other multi-process launch
+    # in the repo does (tests/test_dist_multiprocess.py)
+    if "PYTHONPATH" in env:
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if "axon" not in p]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            env.pop("PYTHONPATH")
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "launch.py"),
+             "-n", "2", "--launcher", "local",
+             "--heartbeat-interval", "0.1",
+             sys.executable,
+             os.path.join(repo_root, "tests",
+                          "dist_distview_worker.py")],
+            capture_output=True, text=True, timeout=240,
+            cwd=repo_root, env=env)
+        if res.returncode != 0:
+            problems.append("2-process dry-run failed (%d): %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-800:]))
+            return problems
+        run_path = base + ".run"
+        if not os.path.exists(run_path):
+            problems.append("supervisor wrote no run timeline at %r"
+                            % run_path)
+            return problems
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "run_top.py"),
+             run_path, "--summarize", "--json"],
+            capture_output=True, text=True, timeout=60, cwd=repo_root)
+        if res.returncode != 0:
+            problems.append("run_top --summarize failed (%d): %s"
+                            % (res.returncode, res.stderr[-400:]))
+            return problems
+        try:
+            summary = json.loads(res.stdout)
+        except ValueError as e:
+            problems.append("run_top --summarize --json is not "
+                            "parseable: %s" % e)
+            return problems
+        if summary.get("schema") != "mxtpu-run/1":
+            problems.append("summary schema %r != 'mxtpu-run/1'"
+                            % summary.get("schema"))
+        if summary.get("steps", 0) < 3:
+            problems.append("expected >= 3 aggregated steps, got %r"
+                            % summary.get("steps"))
+        if summary.get("straggler") != 1:
+            problems.append("seeded slow rank 1 not named the "
+                            "straggler (got %r)"
+                            % summary.get("straggler"))
+        for r in ("0", "1"):
+            seg = (summary.get("per_rank", {}).get(r, {})
+                   .get("segments_s"))
+            if not seg or "compute" not in seg:
+                problems.append("rank %s summary lacks segment totals "
+                                "(got %r)" % (r, seg))
+    except subprocess.TimeoutExpired:
+        problems.append("2-process dry-run timed out")
+    finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 

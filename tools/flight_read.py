@@ -1,26 +1,43 @@
 #!/usr/bin/env python
-"""flight_read — pretty-print a flight-recorder black-box dump.
+"""flight_read — pretty-print flight-recorder black boxes and run
+timelines.
 
-The reader half of ``mxnet_tpu.telemetry.flight``: loads a
-``mxtpu-flight/1`` JSON dump (validating the schema), and prints a
-postmortem-ordered report — header, the event timeline (relative
-timestamps, condensed fields), memory plans, live memory, and the
-non-zero counters.  Stdlib-only, so it runs on a supervisor host with
-no jax installed.
+The reader half of ``mxnet_tpu.telemetry.flight`` (plus the
+``mxtpu-run/1`` validation of ``telemetry.distview``).  Three inputs:
+
+* a single ``mxtpu-flight/1`` JSON dump — the postmortem-ordered
+  report: header, event timeline (relative timestamps, condensed
+  fields), memory plans, live memory, non-zero counters;
+* a DIRECTORY of dumps (``MXNET_TPU_FLIGHT_DIR``, or a
+  ``--capture`` output tree) — every ``flight-*.json`` under it is
+  loaded and merged into ONE time-sorted multi-rank event view, each
+  line tagged ``r<rank>/<pid>``: the fleet postmortem, with per-dump
+  headers up front;
+* an ``mxtpu-run/1`` run timeline (the launch.py supervisor's
+  ``<base>.run``) — validated and summarized (full rendering lives in
+  ``tools/run_top.py``).
+
+Stdlib-only, so it runs on a supervisor host with no jax installed.
 
 Usage::
 
     python tools/flight_read.py DUMP.json [--events N] [--json]
+    python tools/flight_read.py /path/to/flight_dir [--events N]
+    python tools/flight_read.py BASE.run
 
-``--json`` re-emits the parsed document (schema-validated passthrough
-for piping into jq); ``--events N`` limits the timeline to the last N
-events (default: all).  Exits 1 on a malformed dump.
+``--json`` re-emits the parsed document(s) (schema-validated
+passthrough for piping into jq); ``--events N`` limits timelines to
+the last N events (default: all).  Exits 1 on malformed input.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _distview import load_distview as _load_distview  # noqa: E402
 
 SCHEMA = "mxtpu-flight/1"
 
@@ -130,15 +147,123 @@ def format_dump(doc, max_events=None):
     return "\n".join(lines)
 
 
+def load_dir(path):
+    """Load every ``flight-*.json`` under ``path`` (recursively — a
+    --capture tree nests dumps in ``rank<N>/`` subdirs).  Returns a
+    list of (dump path, doc) sorted by dump timestamp; raises
+    ValueError when the directory holds no valid dump (individually
+    malformed files are reported on stderr and skipped)."""
+    found = []
+    for root, _dirs, files in os.walk(path):
+        for name in sorted(files):
+            if name.startswith("flight-") and name.endswith(".json"):
+                found.append(os.path.join(root, name))
+    if not found:
+        raise ValueError("no flight-*.json dumps under %r" % path)
+    docs = []
+    for p in sorted(found):
+        try:
+            docs.append((p, load(p)))
+        except ValueError as e:
+            print("flight_read: skipping %s" % e, file=sys.stderr)
+    if not docs:
+        raise ValueError("no valid flight dump under %r" % path)
+    docs.sort(key=lambda pd: pd[1].get("ts", 0))
+    return docs
+
+
+def format_multi(docs, max_events=None):
+    """Merged multi-rank postmortem: per-dump headers, then every
+    ranks' events interleaved on ONE time axis (absolute ordering,
+    relative to the newest dump's timestamp), each line tagged with
+    its origin ``r<rank>/<pid>``."""
+    lines = []
+    t_end = max(d.get("ts", 0) for _p, d in docs)
+    lines.append("merged flight view: %d dump(s); t=0 is the newest "
+                 "dump" % len(docs))
+    for p, d in docs:
+        lines.append(
+            "  %+9.3fs  r%-3s pid=%-7s reason=%-8s %s"
+            % (d.get("ts", t_end) - t_end, d.get("rank", "?"),
+               d.get("pid", "?"), d.get("reason", "?"),
+               os.path.basename(p)))
+        if d.get("error"):
+            lines.append("             error: %s"
+                         % str(d["error"]).split("\n")[0][:160])
+    merged = []
+    for _p, d in docs:
+        tag = "r%s/%s" % (d.get("rank", "?"), d.get("pid", "?"))
+        for ev in d["events"]:
+            merged.append((ev.get("ts", d.get("ts", 0)), tag, ev))
+    merged.sort(key=lambda x: x[0])
+    if max_events is not None:
+        merged = merged[-max_events:]
+    lines.append("")
+    lines.append("events (%d shown; all ranks on one time axis):"
+                 % len(merged))
+    for ts, tag, ev in merged:
+        lines.append("  %+9.3fs  %-12s %-14s %s"
+                     % (ts - t_end, tag, ev.get("kind", "?"),
+                        _fmt_fields(ev)))
+    return "\n".join(lines)
+
+
+def _sniff_run_timeline(path):
+    """True when ``path`` looks like an ``mxtpu-run/1`` JSONL timeline
+    (first line is its run_begin header) rather than a flight dump."""
+    try:
+        with open(path) as f:
+            first = f.readline()
+        return json.loads(first).get("schema") == "mxtpu-run/1"
+    except (OSError, ValueError, AttributeError):
+        return False
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="flight_read")
-    ap.add_argument("dump", help="flight-recorder JSON dump to read")
+    ap.add_argument("dump",
+                    help="a flight-recorder JSON dump, a DIRECTORY of "
+                         "dumps (merged multi-rank view), or an "
+                         "mxtpu-run/1 run timeline")
     ap.add_argument("--events", type=int, default=None, metavar="N",
                     help="show only the last N events")
     ap.add_argument("--json", action="store_true",
                     help="re-emit the validated document as JSON")
     args = ap.parse_args(argv)
     try:
+        if os.path.isdir(args.dump):
+            docs = load_dir(args.dump)
+            if args.json:
+                json.dump([d for _p, d in docs], sys.stdout, indent=1,
+                          sort_keys=True)
+                print()
+            else:
+                print(format_multi(docs, max_events=args.events))
+            return 0
+        if _sniff_run_timeline(args.dump):
+            dv = _load_distview()
+            records = dv.read_run_timeline(args.dump)
+            if args.json:
+                shown = records
+                if args.events is not None and len(records) > 1:
+                    # keep the run_begin header so the slice is still a
+                    # valid timeline, then the last N records
+                    shown = records[:1] + records[1:][-args.events:]
+                json.dump(shown, sys.stdout, indent=1, sort_keys=True)
+                print()
+            else:
+                summary = dv.summarize_run(records)
+                print("valid %s timeline: %d record(s)"
+                      % (records[0]["schema"], len(records)))
+                print("steps=%s ranks=%s straggler=%s skew_max=%.3fms "
+                      "ended=%s"
+                      % (summary["steps"], summary["num_ranks"],
+                         summary["straggler"],
+                         1e3 * summary["skew_max_s"],
+                         summary["ended"]))
+                print("(render with: python tools/run_top.py %s "
+                      "[--summarize])" % args.dump)
+            return 0
         doc = load(args.dump)
     except ValueError as e:
         print("flight_read: %s" % e, file=sys.stderr)

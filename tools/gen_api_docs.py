@@ -83,18 +83,25 @@ SEE_ALSO = {
                  "verification against tp_rules",
                  "[telemetry](telemetry.md) — trainer/pipeline spans, "
                  "kvstore traffic counters, the trainer step's memory "
-                 "plan + HBM budget check, and the flight-recorder "
-                 "black box dumped on step failures"],
+                 "plan + HBM budget check, the flight-recorder black "
+                 "box dumped on step failures, and the cross-rank view "
+                 "(`telemetry.distview`): per-step compute/input/"
+                 "collective segments, the pre-collective timestamp "
+                 "barrier measuring rank skew, and the launch.py "
+                 "run timeline rendered by `tools/run_top.py`"],
     "symbol": ["[analysis](analysis.md) — `Symbol.verify()`, "
                "`bind(strict=True)`, the MXG0xx diagnostic catalog"],
     "kvstore": ["[telemetry](telemetry.md) — push/pull byte counters "
                 "and the dist_async in-flight gauge"],
     "profiler": ["[telemetry](telemetry.md) — spans feed these Chrome "
                  "traces; metrics/exporters live there, as do the "
-                 "memory-plan gauges (`telemetry.memory`) and the "
+                 "memory-plan gauges (`telemetry.memory`), the "
                  "flight-recorder black box (`telemetry.flight`, "
                  "MXNET_TPU_FLIGHT_DIR) for after-the-fact profiling "
-                 "of a dead run"],
+                 "of a dead run, and on-demand live capture "
+                 "(`telemetry.distview`): SIGUSR1 / `/debug/capture` "
+                 "writes a bounded profiler window on a running rank — "
+                 "analyze it with `tools/xprof_top.py --trace`"],
 }
 
 

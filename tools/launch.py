@@ -11,13 +11,38 @@ parameter servers — every host runs the SAME script and joins one
 ``ShardedTrainer`` workers and ``mx.kv.create("dist_*")``) and forks local
 workers (``--launcher local``, the reference's single-host test mode for
 multi-node semantics) or SSHes to hosts (``--launcher ssh``).
+
+Run observability (local launcher, ``MXNET_TPU_TELEMETRY_JSONL`` set):
+
+* each worker gets its OWN step-log stream ``<base>.rank<N>`` and — when
+  ``MXNET_TPU_TELEMETRY_PORT`` is set — its own metrics port
+  ``port+N`` (recorded in the supervisor ``worker_start`` event), so
+  co-located ranks no longer race to bind one port or interleave one
+  file;
+* the supervisor tails every rank's stream and merges them into ONE
+  run-level timeline ``<base>.run`` (schema ``mxtpu-run/1``: per-step
+  p50/max across ranks, worst-rank id, skew history, restart/fault
+  events) — render it with ``tools/run_top.py`` (live ``--follow`` or
+  postmortem ``--summarize``);
+* SIGUSR1 sent to the supervisor is relayed to every worker, whose
+  telemetry handler captures a bounded profiler window + flight
+  snapshot WITHOUT restarting (``MXNET_TPU_CAPTURE_DIR``);
+  ``tools/launch.py --capture`` broadcasts it to a running job found
+  via the supervisor JSONL.
+
+The supervisor stays framework-free: the aggregation half of
+``mxnet_tpu/telemetry/distview.py`` is loaded by file path (stdlib
+only), never imported as a package (which would drag jax in).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import signal
 import subprocess
 import sys
+import time
 
 
 def dmlc_opts(opts):
@@ -28,7 +53,74 @@ def dmlc_opts(opts):
     return env
 
 
-def _run_workers_once(opts, command, attempt):
+def _load_distview():
+    """Load the aggregation half of telemetry/distview.py by file path
+    (stdlib-only module-level imports) — the supervisor must never
+    import the framework.  Returns None when unavailable; the launcher
+    then runs exactly as before, without the run timeline."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "telemetry",
+                        "distview.py")
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "mxtpu_distview", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception as e:  # mxlint: allow-broad-except(the run-timeline aggregator is optional observability; a broken/missing module must degrade the supervisor to its old behavior, not kill the job it babysits)
+        sys.stderr.write("launch.py: run-timeline aggregator "
+                         "unavailable (%s)\n" % e)
+        return None
+
+
+def _supervisor_jsonl():
+    """The supervisor's own event stream (the base
+    MXNET_TPU_TELEMETRY_JSONL path; workers write ``<base>.rank<N>``)."""
+    return os.environ.get("MXNET_TPU_TELEMETRY_JSONL")
+
+
+def _sup_event(record, agg=None):
+    """Append one supervisor event to the base JSONL stream (and, when
+    the aggregator runs, pass it through into the run timeline)."""
+    rec = {"ts": round(time.time(), 6)}
+    rec.update(record)
+    if agg is not None:
+        try:
+            agg.note_event(rec)
+        except Exception as e:  # mxlint: allow-broad-except(a timeline write failure must not take the supervisor down)
+            sys.stderr.write("launch.py: run-timeline event failed: "
+                             "%s\n" % e)
+    path = _supervisor_jsonl()
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        sys.stderr.write("launch.py: cannot append telemetry event to "
+                         "%s: %s\n" % (path, e))
+
+
+def _make_aggregator(opts):
+    """RunAggregator over the per-rank streams (None when the step-log
+    is off or distview cannot load).  The timeline lands beside the
+    supervisor JSONL as ``<base>.run``."""
+    base = _supervisor_jsonl()
+    if not base or opts.launcher != "local":
+        return None
+    dv = _load_distview()
+    if dv is None:
+        return None
+    try:
+        return dv.RunAggregator(base, opts.num_workers)
+    except Exception as e:  # mxlint: allow-broad-except(optional observability — see _load_distview)
+        sys.stderr.write("launch.py: cannot start run aggregator: "
+                         "%s\n" % e)
+        return None
+
+
+def _run_workers_once(opts, command, attempt, agg=None):
     """Fork N workers and watchdog them until the job ends.
 
     The watchdog polls worker liveness every ``--heartbeat-interval``
@@ -39,22 +131,59 @@ def _run_workers_once(opts, command, attempt):
     rank), and the attempt exits nonzero with a clear message.
     ``MXNET_TPU_RESTART_COUNT`` tells workers which restart attempt
     they are (0 = first launch) so resume-aware scripts reload their
-    latest checkpoint."""
-    import signal
-    import time
+    latest checkpoint.
 
+    Observability: per-rank step-log/port env (see the module
+    docstring), a ``worker_start`` supervisor event per rank (pid +
+    chosen telemetry port — the postmortem's rank→process map), the
+    run-timeline aggregator polled on every heartbeat, and a SIGUSR1
+    relay so one signal to the supervisor captures the whole fleet."""
     hb = max(0.05, float(opts.heartbeat_interval))
     procs = []
     base_env = dmlc_opts(opts)
     base_env["MXNET_TPU_RESTART_COUNT"] = str(attempt)
+    base_jsonl = _supervisor_jsonl()
+    try:
+        base_port = int(base_env.get("MXNET_TPU_TELEMETRY_PORT", "0"))
+    except ValueError:
+        base_port = 0
+    if agg is not None:
+        agg.begin_attempt(attempt)
     flight_before = _flight_dump_names()
     for rank in range(opts.num_workers):
         env = dict(base_env)
         env["MXNET_TPU_PROCESS_ID"] = str(rank)
-        # each worker gets its own process group so teardown reaches the
-        # python under the shell=True sh wrapper, not just the wrapper
-        procs.append(subprocess.Popen(command, shell=True, env=env,
-                                      preexec_fn=os.setsid))
+        port = 0
+        if base_port > 0:
+            # one fixed port cannot serve N co-located ranks: assign
+            # rank N port+N (ssh workers — one per host — keep the
+            # configured port) and record the choice below
+            port = base_port + (rank if opts.num_workers > 1 else 0)
+            env["MXNET_TPU_TELEMETRY_PORT"] = str(port)
+        if base_jsonl:
+            # each rank appends its OWN stream; the supervisor keeps the
+            # base file and merges the ranks into <base>.run
+            env["MXNET_TPU_TELEMETRY_JSONL"] = \
+                "%s.rank%d" % (base_jsonl, rank)
+        def _child_setup():
+            # own process group so teardown reaches the python under
+            # the shell=True sh wrapper, not just the wrapper
+            os.setsid()
+            # SIG_IGN survives exec: a wrapper sh that lingers must
+            # ignore the fleet-wide capture signal instead of dying of
+            # it (which the watchdog would read as a dead rank); the
+            # worker's telemetry re-arms its own SIGUSR1 handler when
+            # MXNET_TPU_CAPTURE_DIR is set
+            signal.signal(signal.SIGUSR1, signal.SIG_IGN)
+
+        p = subprocess.Popen(command, shell=True, env=env,
+                             preexec_fn=_child_setup)
+        procs.append(p)
+        _sup_event({"event": "worker_start", "attempt": attempt,
+                    "rank": rank, "pid": p.pid,
+                    "telemetry_port": port or None,
+                    "jsonl": env.get("MXNET_TPU_TELEMETRY_JSONL")},
+                   agg)
 
     def signal_group(p, sig):
         try:
@@ -64,39 +193,59 @@ def _run_workers_once(opts, command, attempt):
 
     code, failed_rank = 0, None
     live = dict(enumerate(procs))
-    while live:
-        for rank in list(live):
-            rc = live[rank].poll()
-            if rc is None:
-                continue
-            del live[rank]
-            if rc != 0 and failed_rank is None:
-                failed_rank, code = rank, rc
-                sys.stderr.write(
-                    "launch.py: worker %d exited with code %d "
-                    "(signal %s); aborting job — surviving workers "
-                    "would block on the dead rank's collectives. "
-                    "Resume from the last checkpoint.\n"
-                    % (rank, rc, -rc if rc < 0 else "none"))
-                sys.stderr.flush()
-                for other in live.values():
-                    signal_group(other, signal.SIGTERM)
-                grace = time.time() + 10
-                for other in live.values():
-                    try:
-                        other.wait(max(0.1, grace - time.time()))
-                    except subprocess.TimeoutExpired:
-                        signal_group(other, signal.SIGKILL)
-            elif rc != 0:
-                code = code or rc
-        if live:
-            time.sleep(hb)
+
+    def relay_usr1(signum, frame):
+        # fleet-wide on-demand capture: one signal to the supervisor
+        # reaches every live worker (tools/launch.py --capture)
+        for p in live.values():
+            signal_group(p, signal.SIGUSR1)
+
+    try:
+        prev_usr1 = signal.signal(signal.SIGUSR1, relay_usr1)
+    except (ValueError, OSError):       # non-main thread embedding
+        prev_usr1 = None
+    try:
+        while live:
+            for rank in list(live):
+                rc = live[rank].poll()
+                if rc is None:
+                    continue
+                del live[rank]
+                if rc != 0 and failed_rank is None:
+                    failed_rank, code = rank, rc
+                    sys.stderr.write(
+                        "launch.py: worker %d exited with code %d "
+                        "(signal %s); aborting job — surviving workers "
+                        "would block on the dead rank's collectives. "
+                        "Resume from the last checkpoint.\n"
+                        % (rank, rc, -rc if rc < 0 else "none"))
+                    sys.stderr.flush()
+                    for other in live.values():
+                        signal_group(other, signal.SIGTERM)
+                    grace = time.time() + 10
+                    for other in live.values():
+                        try:
+                            other.wait(max(0.1, grace - time.time()))
+                        except subprocess.TimeoutExpired:
+                            signal_group(other, signal.SIGKILL)
+                elif rc != 0:
+                    code = code or rc
+            if agg is not None:
+                agg.poll()
+            if live:
+                time.sleep(hb)
+    finally:
+        if prev_usr1 is not None:
+            signal.signal(signal.SIGUSR1, prev_usr1)
+    if agg is not None:
+        agg.poll()
     if failed_rank is not None:
         # postmortem breadcrumb: any black box the dead worker (or its
         # torn-down peers) left behind — collected AFTER the grace
         # teardown so SIGTERM'd survivors' dumps are included too
         _note_worker_death(attempt, failed_rank, code,
-                           sorted(_flight_dump_names() - flight_before))
+                           sorted(_flight_dump_names() - flight_before),
+                           agg)
     return code
 
 
@@ -114,30 +263,17 @@ def _flight_dump_names():
         return set()
 
 
-def _note_worker_death(attempt, rank, code, flight_dumps):
-    """Append a worker-death event (with any collected flight dumps) to
-    the supervisor JSONL stream — the machine-readable twin of the
-    stderr dead-rank message."""
-    path = os.environ.get("MXNET_TPU_TELEMETRY_JSONL")
+def _note_worker_death(attempt, rank, code, flight_dumps, agg=None):
+    """Record a worker-death event (with any collected flight dumps) in
+    the supervisor JSONL stream and the run timeline — the
+    machine-readable twin of the stderr dead-rank message."""
     if flight_dumps:
         sys.stderr.write("launch.py: collected %d flight dump(s) from "
                          "the dead attempt: %s\n"
                          % (len(flight_dumps), ", ".join(flight_dumps)))
-    if not path:
-        return
-    import json
-    import time
-    try:
-        with open(path, "a") as f:
-            f.write(json.dumps({"ts": round(time.time(), 6),
-                                "event": "worker_death",
-                                "attempt": attempt,
-                                "rank": rank,
-                                "exit_code": code,
-                                "flight_dumps": flight_dumps}) + "\n")
-    except OSError as e:
-        sys.stderr.write("launch.py: cannot append telemetry event to "
-                         "%s: %s\n" % (path, e))
+    _sup_event({"event": "worker_death", "attempt": attempt,
+                "rank": rank, "exit_code": code,
+                "flight_dumps": flight_dumps}, agg)
 
 
 def launch_local(opts, command):
@@ -152,51 +288,54 @@ def launch_local(opts, command):
     MXNET_TPU_RESTART_COUNT) continue training where the dead attempt
     left off.  Budget 0 (default) keeps the previous fail-fast
     behavior."""
-    attempt = 0
-    while True:
-        code = _run_workers_once(opts, command, attempt)
-        if code == 0:
-            if attempt:
-                sys.stderr.write(
-                    "launch.py: job recovered after %d restart(s)\n"
-                    % attempt)
-            return 0
-        if attempt >= opts.restart_budget:
-            if opts.restart_budget:
-                sys.stderr.write(
-                    "launch.py: restart budget (%d) exhausted; giving "
-                    "up with exit code %d\n" % (opts.restart_budget,
-                                                code))
-            return code
-        attempt += 1
-        sys.stderr.write(
-            "launch.py: restarting job (attempt %d/%d) from the last "
-            "complete checkpoint\n" % (attempt, opts.restart_budget))
-        sys.stderr.flush()
-        _note_restart(attempt)
-
-
-def _note_restart(attempt):
-    """Surface a watchdog restart in the telemetry stream.
-
-    The launcher stays stdlib-only (importing the framework here would
-    drag jax into the supervisor), so it appends a supervisor event to
-    the JSONL step-log directly; the relaunched workers additionally
-    expose the attempt as the ``mxtpu_watchdog_restarts`` gauge via
-    MXNET_TPU_RESTART_COUNT (read at telemetry init)."""
-    path = os.environ.get("MXNET_TPU_TELEMETRY_JSONL")
-    if not path:
-        return
-    import json
-    import time
+    agg = _make_aggregator(opts)
+    _sup_event({"event": "job_start", "pid": os.getpid(),
+                "num_workers": opts.num_workers,
+                "run_timeline": agg.out_path if agg else None}, agg)
+    # SIGUSR1 must never kill the supervisor: between watchdog attempts
+    # (no relay installed) a --capture fallback signal would otherwise
+    # hit SIG_DFL and abort the job being babysat
     try:
-        with open(path, "a") as f:
-            f.write(json.dumps({"ts": round(time.time(), 6),
-                                "event": "watchdog_restart",
-                                "attempt": attempt}) + "\n")
-    except OSError as e:
-        sys.stderr.write("launch.py: cannot append telemetry event to "
-                         "%s: %s\n" % (path, e))
+        prev_usr1 = signal.signal(signal.SIGUSR1, signal.SIG_IGN)
+    except (ValueError, OSError):       # non-main thread embedding
+        prev_usr1 = None
+    try:
+        attempt = 0
+        while True:
+            code = _run_workers_once(opts, command, attempt, agg)
+            if code == 0:
+                if attempt:
+                    sys.stderr.write(
+                        "launch.py: job recovered after %d restart(s)\n"
+                        % attempt)
+                return 0
+            if attempt >= opts.restart_budget:
+                if opts.restart_budget:
+                    sys.stderr.write(
+                        "launch.py: restart budget (%d) exhausted; "
+                        "giving up with exit code %d\n"
+                        % (opts.restart_budget, code))
+                return code
+            attempt += 1
+            sys.stderr.write(
+                "launch.py: restarting job (attempt %d/%d) from the "
+                "last complete checkpoint\n"
+                % (attempt, opts.restart_budget))
+            sys.stderr.flush()
+            # the relaunched workers additionally expose the attempt as
+            # the mxtpu_watchdog_restarts gauge via
+            # MXNET_TPU_RESTART_COUNT (read at telemetry init)
+            _sup_event({"event": "watchdog_restart",
+                        "attempt": attempt}, agg)
+    finally:
+        if prev_usr1 is not None:
+            signal.signal(signal.SIGUSR1, prev_usr1)
+        # the end marker --capture needs: without it a later capture of
+        # this (finished) job would replay stale worker pids, and a
+        # reused pid would receive a SIGUSR1 it has no handler for
+        _sup_event({"event": "job_end", "pid": os.getpid()}, agg)
+        if agg is not None:
+            agg.close()
 
 
 def launch_ssh(opts, command):
@@ -231,7 +370,108 @@ def launch_ssh(opts, command):
     return code
 
 
+def capture_job(jsonl=None):
+    """Broadcast the on-demand capture signal (SIGUSR1) to every live
+    worker of a RUNNING launch.py job — ``tools/launch.py --capture``.
+
+    The job is found through its supervisor JSONL stream
+    (``--jsonl PATH`` or MXNET_TPU_TELEMETRY_JSONL): the latest
+    ``worker_start`` events name each rank's pid/process group.  Every
+    signaled worker whose telemetry has ``MXNET_TPU_CAPTURE_DIR`` set
+    writes a bounded ``jax.profiler`` trace window plus a flight
+    snapshot under ``<dir>/rank<N>/`` without restarting — feed the
+    result to ``tools/xprof_top.py --trace`` / ``tools/flight_read.py``.
+    Falls back to signaling the supervisor (which relays fleet-wide)
+    when no worker pid is alive.  Returns a shell exit code."""
+    path = jsonl or os.environ.get("MXNET_TPU_TELEMETRY_JSONL")
+    if not path:
+        sys.stderr.write("launch.py --capture: no supervisor JSONL "
+                         "(--jsonl PATH or MXNET_TPU_TELEMETRY_JSONL)\n")
+        return 2
+    workers = {}        # rank -> pid, latest worker_start wins
+    supervisor = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("event") == "job_start" and rec.get("pid"):
+                    supervisor = int(rec["pid"])
+                    workers = {}     # a fresh job supersedes old pids
+                elif rec.get("event") == "worker_start" \
+                        and rec.get("pid") is not None:
+                    workers[rec.get("rank", len(workers))] = \
+                        int(rec["pid"])
+                elif rec.get("event") == "worker_death":
+                    workers.pop(rec.get("rank"), None)
+                elif rec.get("event") == "job_end":
+                    # the job finished: its pids are stale, and a pid
+                    # the OS reused would get a SIGUSR1 it has no
+                    # handler for (default disposition: termination)
+                    supervisor = None
+                    workers = {}
+    except OSError as e:
+        sys.stderr.write("launch.py --capture: cannot read %s: %s\n"
+                         % (path, e))
+        return 2
+
+    def alive(pid):
+        try:
+            os.kill(pid, 0)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+    signaled = []
+    for rank in sorted(workers):
+        pid = workers[rank]
+        if not alive(pid):
+            continue
+        try:
+            # the whole process group: workers run under a shell=True
+            # wrapper in their own group (os.setsid at spawn)
+            os.killpg(os.getpgid(pid), signal.SIGUSR1)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, signal.SIGUSR1)
+            except OSError:
+                continue
+        signaled.append((rank, pid))
+    if not signaled and supervisor is not None and alive(supervisor):
+        # let the supervisor's SIGUSR1 relay reach workers we cannot see
+        os.kill(supervisor, signal.SIGUSR1)
+        print("launch.py --capture: signaled supervisor pid %d (relay)"
+              % supervisor)
+        return 0
+    if not signaled:
+        sys.stderr.write("launch.py --capture: no live workers found "
+                         "in %s\n" % path)
+        return 1
+    print("launch.py --capture: signaled %d worker(s): %s"
+          % (len(signaled), ", ".join("rank %d (pid %d)" % w
+                                      for w in signaled)))
+    return 0
+
+
 def main():
+    # capture mode is selected by a LEADING --capture only: the worker
+    # command after -n may legitimately contain a --capture of its own
+    if sys.argv[1:2] == ["--capture"]:
+        cap = argparse.ArgumentParser(
+            prog="launch.py --capture",
+            description="broadcast SIGUSR1 to a running job: every "
+                        "worker captures a bounded profiler window + "
+                        "flight snapshot (MXNET_TPU_CAPTURE_DIR)")
+        cap.add_argument("--capture", action="store_true")
+        cap.add_argument("--jsonl", default=None,
+                         help="supervisor JSONL of the running job "
+                              "(default: MXNET_TPU_TELEMETRY_JSONL)")
+        args = cap.parse_args()
+        sys.exit(capture_job(args.jsonl))
     parser = argparse.ArgumentParser(
         description="Launch a distributed job (reference tools/launch.py)")
     parser.add_argument("-n", "--num-workers", required=True, type=int,

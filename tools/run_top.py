@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""run_top — render a cross-rank run timeline (schema ``mxtpu-run/1``).
+
+The reader half of the launch.py supervisor's fleet aggregator
+(``mxnet_tpu/telemetry/distview.py``): the aggregator tails every
+rank's JSONL step-log and writes ONE merged timeline beside the
+supervisor stream (``<base>.run``); this tool renders it —
+
+* **dashboard** (default): the run header, the last N step rows
+  (p50/max across ranks, the worst rank, measured skew), each rank's
+  cumulative segment split (compute / input-wait / collective-wait),
+  and recent supervisor events;
+* **live** (``--follow``): redraw the dashboard every ``--interval``
+  seconds while the job runs, top(1)-style, until the ``run_end``
+  trailer lands (plain-text ANSI repaint — works over ssh | tee where
+  curses does not);
+* **postmortem** (``--summarize``): the roll-up — total/complete
+  steps, per-rank p50/max/segment totals, the straggler (most-frequent
+  worst rank), peak skew, and the event list; ``--json`` emits the
+  same dict as JSON for scripts (tools/ci_check.py stage 6 parses it).
+
+Stdlib-only (distview's aggregation half is loaded by file path), so it
+runs on a supervisor host with no jax installed.
+
+Usage::
+
+    python tools/run_top.py BASE.run                 # dashboard once
+    python tools/run_top.py BASE.run --follow        # live
+    python tools/run_top.py BASE.run --summarize     # postmortem
+    python tools/run_top.py BASE.run --summarize --json | jq .straggler
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+from _distview import load_distview as _load_distview  # noqa: E402
+
+
+#: --follow retains the run_begin header + this many recent records;
+#: summaries shown live cover that window (postmortem --summarize is
+#: exact over the whole file)
+_FOLLOW_WINDOW = 5000
+
+
+def _bar(parts, width=30):
+    """One-line segment bar: '#' compute, 'i' input wait, 'c'
+    collective wait, scaled to width."""
+    total = sum(parts.values()) or 1.0
+    chars = {"compute": "#", "input_wait": "i", "collective_wait": "c"}
+    out = ""
+    for name in ("compute", "input_wait", "collective_wait"):
+        n = int(round(width * parts.get(name, 0.0) / total))
+        out += chars[name] * n
+    return (out + " " * width)[:width]
+
+
+def format_dashboard(records, summary, steps_shown=12):
+    """The dashboard as one string (shared by one-shot and --follow)."""
+    lines = []
+    head = records[0]
+    steps = [r for r in records if r.get("kind") == "step"]
+    events = [r for r in records if r.get("kind") == "event"]
+    ended = summary.get("ended")
+    lines.append(
+        "run_top: %s  ranks=%s  steps=%d%s" %
+        (head.get("base", "?"), summary.get("num_ranks", "?"),
+         summary.get("steps", 0),
+         "  [run ended]" if ended else "  [live]"))
+    if summary.get("straggler") is not None:
+        lines.append(
+            "straggler: rank %d (worst in %d/%d steps)  peak skew %.1f ms"
+            % (summary["straggler"],
+               summary["worst_rank_counts"].get(
+                   str(summary["straggler"]), 0),
+               summary.get("steps", 0),
+               1e3 * summary.get("skew_max_s", 0.0)))
+    lines.append("")
+    lines.append("  step  p50 ms   max ms  worst  skew ms  ranks")
+    for s in steps[-steps_shown:]:
+        lines.append(
+            "%6d %7.1f %8.1f %6s %8s %6s"
+            % (s.get("step", -1),
+               1e3 * (s.get("p50_s") or 0.0),
+               1e3 * (s.get("max_s") or 0.0),
+               str(s.get("worst_rank", "-")),
+               ("%.1f" % (1e3 * s["skew_s"]))
+               if isinstance(s.get("skew_s"), (int, float)) else "-",
+               s.get("n_ranks", "?")))
+    per_rank = summary.get("per_rank") or {}
+    if per_rank:
+        lines.append("")
+        lines.append("  rank   p50 ms  total s  segments "
+                     "(#=compute i=input c=collective)")
+        for r in sorted(per_rank, key=lambda x: int(x)):
+            pr = per_rank[r]
+            seg = pr.get("segments_s") or {}
+            lines.append("  %4s %8.1f %8.2f  [%s]"
+                         % (r, 1e3 * pr.get("p50_s", 0.0),
+                            pr.get("total_s", 0.0), _bar(seg)))
+    if events:
+        lines.append("")
+        lines.append("events:")
+        for e in events[-6:]:
+            fields = " ".join(
+                "%s=%s" % (k, e[k]) for k in ("rank", "pid", "attempt",
+                                              "exit_code",
+                                              "telemetry_port", "path")
+                if e.get(k) is not None)
+            lines.append("  %-18s %s" % (e.get("event", "?"), fields))
+    return "\n".join(lines)
+
+
+def format_summary(summary):
+    """The --summarize postmortem as one string."""
+    lines = []
+    lines.append("run summary (%s)" % summary.get("schema"))
+    lines.append("  ranks:          %s" % summary.get("num_ranks"))
+    lines.append("  steps:          %d (%d complete across all ranks)"
+                 % (summary.get("steps", 0),
+                    summary.get("complete_steps", 0)))
+    if summary.get("straggler") is not None:
+        lines.append("  straggler:      rank %d (worst rank in %s step(s))"
+                     % (summary["straggler"],
+                        summary["worst_rank_counts"].get(
+                            str(summary["straggler"]), 0)))
+    else:
+        lines.append("  straggler:      none identified")
+    lines.append("  peak skew:      %.3f ms"
+                 % (1e3 * summary.get("skew_max_s", 0.0)))
+    for r in sorted(summary.get("per_rank") or {}, key=int):
+        pr = summary["per_rank"][r]
+        seg = pr.get("segments_s") or {}
+        seg_txt = "  ".join("%s=%.3fs" % (k, seg[k])
+                            for k in ("compute", "input_wait",
+                                      "collective_wait") if k in seg)
+        lines.append("  rank %-3s p50=%.1fms max=%.1fms total=%.2fs  %s"
+                     % (r, 1e3 * pr.get("p50_s", 0.0),
+                        1e3 * pr.get("max_s", 0.0),
+                        pr.get("total_s", 0.0), seg_txt))
+    ev = summary.get("events") or []
+    lines.append("  events:         %d" % len(ev))
+    for e in ev:
+        fields = " ".join("%s=%s" % (k, v) for k, v in e.items()
+                          if k not in ("ts", "event"))
+        lines.append("    %-18s %s" % (e.get("event", "?"), fields))
+    lines.append("  run ended:      %s" % bool(summary.get("ended")))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="run_top")
+    ap.add_argument("timeline",
+                    help="run timeline written by the launch.py "
+                         "supervisor (<MXNET_TPU_TELEMETRY_JSONL>.run)")
+    ap.add_argument("--summarize", action="store_true",
+                    help="postmortem roll-up instead of the dashboard")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the --summarize dict as JSON")
+    ap.add_argument("--follow", action="store_true",
+                    help="live dashboard: repaint until run_end")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="repaint period for --follow (seconds)")
+    ap.add_argument("--steps", type=int, default=12, metavar="N",
+                    help="step rows shown in the dashboard")
+    args = ap.parse_args(argv)
+    dv = _load_distview()
+
+    def render(records):
+        summary = dv.summarize_run(records)
+        if args.summarize:
+            if args.json:
+                print(json.dumps(summary, indent=1, sort_keys=True))
+            else:
+                print(format_summary(summary))
+        else:
+            print(format_dashboard(records, summary,
+                                   steps_shown=args.steps))
+        return summary
+
+    # --follow tails the timeline incrementally (offset + partial-line
+    # carry, the aggregator's own pattern): a multi-day run must not be
+    # re-read and re-parsed from byte 0 on every repaint
+    tail = {"off": 0, "partial": "", "records": [], "head": None}
+
+    def poll_records():
+        with open(args.timeline) as f:
+            # a job restart truncates <base>.run (the aggregator opens
+            # it 'w') and writes a NEW run_begin header: following the
+            # old offset would freeze the dashboard on the dead run —
+            # or, worse, silently interleave both runs once the new
+            # timeline regrows past it.  Two complementary detectors:
+            # a shrunken file (cheap, catches the common case within
+            # one poll) and a changed header line (its ts is unique per
+            # run, catching a regrown timeline size alone cannot).
+            head = f.readline()
+            f.seek(0, os.SEEK_END)
+            changed = (tail["head"] is not None and head != tail["head"]
+                       and head.endswith("\n"))
+            if changed or f.tell() < tail["off"]:
+                tail.update(off=0, partial="", records=[], head=None)
+            if tail["head"] is None and head.endswith("\n"):
+                tail["head"] = head
+            f.seek(tail["off"])
+            chunk = f.read()
+            tail["off"] = f.tell()
+        records, tail["partial"] = dv.split_jsonl(tail["partial"] + chunk)
+        tail["records"].extend(records)
+        # bound the live view: a multi-day run would otherwise grow
+        # this list (and the per-repaint summarize_run pass over it)
+        # without limit.  --follow is the LIVE dashboard — it keeps the
+        # header plus a recent window; exact whole-run statistics are
+        # the postmortem's job (--summarize re-reads the full file).
+        if len(tail["records"]) > _FOLLOW_WINDOW + 1:
+            tail["records"][1:-_FOLLOW_WINDOW] = []
+        recs = tail["records"]
+        if recs and (recs[0].get("schema") != dv.RUN_SCHEMA
+                     or recs[0].get("kind") != "run_begin"):
+            raise ValueError(
+                "%r is not an %s timeline (first record %r)"
+                % (args.timeline, dv.RUN_SCHEMA,
+                   {k: recs[0].get(k) for k in ("schema", "kind")}))
+        return recs
+
+    try:
+        if not args.follow:
+            render(dv.read_run_timeline(args.timeline))
+            return 0
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")     # clear + home
+            summary = {}
+            try:
+                records = poll_records()
+                if records:
+                    summary = render(records)
+                else:
+                    print("run_top: waiting for %s ..." % args.timeline)
+            except OSError as e:
+                # transient while live: the supervisor may not have
+                # created the timeline yet — keep following
+                print("run_top: waiting for timeline (%s)" % e)
+            sys.stdout.flush()
+            if summary.get("ended"):
+                return 0
+            time.sleep(max(0.2, args.interval))
+    except ValueError as e:
+        print("run_top: %s" % e, file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

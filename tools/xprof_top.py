@@ -5,6 +5,15 @@ from the device-side XPlane (name, total ms, %% of captured device time).
 VERDICT r1 weak #2 asked for exactly this breakdown.
 
 Usage: python tools/xprof_top.py [--batch 128] [--steps 5] [--top 25]
+
+``--trace PATH`` analyzes an EXISTING capture instead of building and
+profiling a model: PATH is an ``.xplane.pb`` file or any directory
+containing one — e.g. the bounded window a live worker wrote on
+SIGUSR1 / ``tools/launch.py --capture`` under
+``MXNET_TPU_CAPTURE_DIR/rank<N>/`` (telemetry.distview), so on-demand
+captures from a RUNNING fleet feed the same per-op attribution flow.
+Without the builder there is no HLO to classify fusions against, so
+categories degrade to op-name prefixes.
 """
 from __future__ import annotations
 
@@ -19,8 +28,148 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def find_planes(path):
+    """The ``.xplane.pb`` files under ``path`` (a file or a directory),
+    oldest-to-newest."""
+    if os.path.isfile(path):
+        return [path]
+    return sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                            recursive=True), key=os.path.getmtime)
+
+
+def load_planes(path):
+    """One ``.xplane.pb`` as a normalized plane list
+    ``[{"name", "lines": [{"name", "events": [(name, dur_ns)]}]}]``.
+
+    Version-tolerant the same way telemetry.memory's accessors are:
+    ``jax.profiler.ProfileData`` where this jax has it, else the raw
+    ``XSpace`` proto via whichever profiler package ships it (tsl /
+    tensorboard plugin / xprof)."""
+    import importlib
+
+    import jax
+
+    pd = getattr(jax.profiler, "ProfileData", None)
+    if pd is not None:
+        data = pd.from_file(path)
+        return [{"name": p.name,
+                 "lines": [{"name": l.name,
+                            "events": [(e.name, e.duration_ns)
+                                       for e in l.events]}
+                           for l in p.lines]}
+                for p in data.planes]
+    xplane_pb2 = None
+    for mod in ("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                "tsl.profiler.protobuf.xplane_pb2",
+                "tensorboard_plugin_profile.protobuf.xplane_pb2",
+                "xprof.protobuf.xplane_pb2"):
+        try:
+            xplane_pb2 = importlib.import_module(mod)
+            break
+        except ImportError:
+            continue
+    if xplane_pb2 is None:
+        raise RuntimeError(
+            "cannot read %r: this jax has no jax.profiler.ProfileData "
+            "and no xplane_pb2 proto module is importable" % path)
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    planes = []
+    for p in xs.planes:
+        md = p.event_metadata
+        planes.append(
+            {"name": p.name,
+             "lines": [{"name": l.name,
+                        "events": [(md[e.metadata_id].name,
+                                    e.duration_ps / 1e3)
+                                   for e in l.events]}
+                       for l in p.lines]})
+    return planes
+
+
+def _op_events(planes):
+    """(name, duration_ns) pairs of the XLA op events: the first
+    device plane's ``XLA Ops`` line when the capture has one (ONE core
+    only — an SPMD program runs on every core, and summing them would
+    multiply every ms/step figure by the core count), else the host
+    XLA executor lines (``tf_XLA*`` — CPU backends have no device
+    plane; live SIGUSR1 captures from a CPU dry-run land here)."""
+    device = sorted((p for p in planes
+                     if p["name"].startswith("/device:")),
+                    key=lambda p: p["name"])
+    if device:
+        lines = [l for l in device[0]["lines"] if l["name"] == "XLA Ops"]
+    else:
+        lines = [l for p in planes for l in p["lines"]
+                 if l["name"].startswith("tf_XLA")]
+    for line in lines:
+        for name, dur in line["events"]:
+            yield name, dur
+
+
+def summarize_planes(planes, total_steps=1, top=25, comp_kind=None,
+                     fusion_calls=None):
+    """Aggregate the XLA op events of the newest plane into per-op and
+    per-category totals and print the tables.  With
+    ``comp_kind``/``fusion_calls`` (the HLO fusion→computation map the
+    capture path builds), fusions are classified by what they contain;
+    without them (``--trace`` on a foreign capture) by name prefix.
+    Returns True when op events were found."""
+    comp_kind = comp_kind or {}
+    fusion_calls = fusion_calls or {}
+    if not planes:
+        print("no xplane produced (profiling unsupported on this "
+              "backend?)")
+        return False
+    per_op, cat = collections.Counter(), collections.Counter()
+    for ev_name, dur in _op_events(load_planes(planes[-1])):
+        nm = ev_name.split(" = ")[0].lstrip("%")
+        per_op[ev_name[:140]] += dur
+        if nm.startswith("fusion"):
+            kinds = comp_kind.get(fusion_calls.get(nm, ""), set())
+            if "convolution" in kinds or "dot" in kinds:
+                cat["conv/matmul fusion"] += dur
+            elif "reduce" in kinds:
+                cat["reduce fusion (BN stats etc)"] += dur
+            else:
+                cat["elementwise/other fusion"] += dur
+        elif nm.startswith("convolution"):
+            cat["conv (bare)"] += dur
+        elif "reduce" in nm:
+            cat["reduce (bare/named)"] += dur
+        elif nm.startswith(("copy", "slice", "bitcast", "all-")):
+            cat["copies/slices"] += dur
+        elif nm.startswith("select_and_scatter"):
+            cat["maxpool bwd"] += dur
+        elif nm.startswith("custom-call"):
+            cat["custom-call (pallas etc)"] += dur
+        else:
+            cat[nm.split(".")[0][:28]] += dur
+    total = sum(cat.values())
+    if not total:
+        print("no XLA op events in %r" % planes[-1])
+        return False
+    print("op time: %.2f ms/step over %d steps"
+          % (total / 1e6 / total_steps, total_steps))
+    print("--- by category")
+    for k, v in cat.most_common(12):
+        print("%-34s %8.3f ms/step %5.1f%%"
+              % (k, v / 1e6 / total_steps, 100.0 * v / total))
+    print("--- top ops")
+    for name, ns in per_op.most_common(top):
+        print("%7.3f ms %4.1f%%  %s"
+              % (ns / 1e6 / total_steps, 100.0 * ns / total, name[:120]))
+    return True
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="analyze an existing capture (.xplane.pb file "
+                         "or a directory containing one, e.g. a "
+                         "MXNET_TPU_CAPTURE_DIR/rank<N> window) instead "
+                         "of capturing here")
     ap.add_argument("--model", default="resnet",
                     choices=["resnet", "transformer"])
     ap.add_argument("--batch", type=int, default=None)
@@ -40,6 +189,13 @@ def main():
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--outdir", default=".profiles")
     args = ap.parse_args()
+
+    if args.trace:
+        # a capture from somewhere else (live SIGUSR1 window, another
+        # host): per-op attribution only, no model build
+        ok = summarize_planes(find_planes(args.trace), total_steps=1,
+                              top=args.top)
+        sys.exit(0 if ok else 1)
 
     import jax
     from mxnet_tpu import models
@@ -129,59 +285,9 @@ def main():
         (m.group(1), m.group(2)) for m in
         re.finditer(r"%(fusion[.\w]*) = [^\n]*calls=%?([\w.\-]+)", hlo))
 
-    planes = sorted(glob.glob(os.path.join(
-        args.outdir, "**", "*.xplane.pb"), recursive=True),
-        key=os.path.getmtime)
-    if not planes:
-        print("no xplane produced (profiling unsupported on this backend?)")
-        return
-    data = jax.profiler.ProfileData.from_file(planes[-1])
-    per_op, cat = collections.Counter(), collections.Counter()
-    for plane in data.planes:
-        if plane.name != "/device:TPU:0":
-            continue
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            for ev in line.events:
-                nm = ev.name.split(" = ")[0].lstrip("%")
-                dur = ev.duration_ns
-                per_op[ev.name[:140]] += dur
-                if nm.startswith("fusion"):
-                    kinds = comp_kind.get(fusion_calls.get(nm, ""), set())
-                    if "convolution" in kinds or "dot" in kinds:
-                        cat["conv/matmul fusion"] += dur
-                    elif "reduce" in kinds:
-                        cat["reduce fusion (BN stats etc)"] += dur
-                    else:
-                        cat["elementwise/other fusion"] += dur
-                elif nm.startswith("convolution"):
-                    cat["conv (bare)"] += dur
-                elif "reduce" in nm:
-                    cat["reduce (bare/named)"] += dur
-                elif nm.startswith(("copy", "slice", "bitcast", "all-")):
-                    cat["copies/slices"] += dur
-                elif nm.startswith("select_and_scatter"):
-                    cat["maxpool bwd"] += dur
-                elif nm.startswith("custom-call"):
-                    cat["custom-call (pallas etc)"] += dur
-                else:
-                    cat[nm.split(".")[0][:28]] += dur
-    total = sum(cat.values())
-    if not total:
-        print("no TPU XLA Ops events; planes:",
-              [p.name for p in data.planes])
-        return
-    print("device time: %.2f ms/step over %d steps"
-          % (total / 1e6 / total_steps, total_steps))
-    print("--- by category")
-    for k, v in cat.most_common(12):
-        print("%-34s %8.3f ms/step %5.1f%%"
-              % (k, v / 1e6 / total_steps, 100.0 * v / total))
-    print("--- top ops")
-    for name, ns in per_op.most_common(args.top):
-        print("%7.3f ms %4.1f%%  %s"
-              % (ns / 1e6 / total_steps, 100.0 * ns / total, name[:120]))
+    summarize_planes(find_planes(args.outdir), total_steps=total_steps,
+                     top=args.top, comp_kind=comp_kind,
+                     fusion_calls=fusion_calls)
 
 
 if __name__ == "__main__":
