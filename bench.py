@@ -15,6 +15,13 @@ the BENCH trajectory tracks across rounds.
 ``--dry-run`` (or BENCH_DRYRUN=1) swaps in a tiny MLP and a handful of
 steps so the full pipeline — trainer, telemetry, report — is exercised
 in seconds on any backend.
+
+``BENCH_FUSE_BLOCKS`` (default on) routes the trainer through the
+block-granularity fusion pass (docs/api/fusion.md); the BENCH JSON
+carries the plan summary (blocks fused, relayouts eliminated) in a
+``fusion`` block, and ``--dry-run`` additionally times an unfused A/B
+leg with per-leg step-program sizes (top-level jaxpr equations — each
+fused block collapses its chain into ONE custom-vjp call).
 """
 from __future__ import annotations
 
@@ -67,22 +74,48 @@ def main():
     n_dev = len(devices)
     platform = devices[0].platform
 
+    fuse_blocks = os.environ.get("BENCH_FUSE_BLOCKS", "1") == "1"
+
     if dry_run:
         # tiny MLP, a handful of real optimizer steps: exercises the
         # trainer + telemetry + report pipeline end-to-end in seconds
         batch = 8 * n_dev
-        net = models.get_model("mlp", num_classes=10)
         mesh = build_mesh(tp=1)
-        trainer = ShardedTrainer(
-            net, mesh,
-            data_shapes={"data": (batch, 64)},
-            label_shapes={"softmax_label": (batch,)},
-            optimizer="sgd", learning_rate=0.1, dtype="float32")
         rng = np.random.RandomState(0)
-        batch_dict = trainer.put_batch({
+        host_batch = {
             "data": rng.uniform(-1, 1, (batch, 64)).astype(np.float32),
             "softmax_label":
-                rng.randint(0, 10, batch).astype(np.float32)})
+                rng.randint(0, 10, batch).astype(np.float32)}
+
+        def _mk(fuse):
+            return ShardedTrainer(
+                models.get_model("mlp", num_classes=10), mesh,
+                data_shapes={"data": (batch, 64)},
+                label_shapes={"softmax_label": (batch,)},
+                optimizer="sgd", learning_rate=0.1, dtype="float32",
+                fuse_blocks=fuse)
+
+        steps = 5
+        fusion_info = {"enabled": fuse_blocks}
+        if fuse_blocks:
+            # unfused A/B leg FIRST so the primary leg below owns the
+            # telemetry step window (reset_steps) and the plan snapshot
+            t_b = _mk(False)
+            b_dict = t_b.put_batch(host_batch)
+            float(t_b.step(b_dict))  # compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = t_b.step(b_dict)
+            assert np.isfinite(float(loss))
+            dt_b = time.perf_counter() - t0
+            fusion_info["ab_unfused"] = {
+                "samples_per_sec_per_chip":
+                    round(steps * batch / dt_b / n_dev, 2),
+                "step_program_eqns": _step_program_eqns(t_b, b_dict),
+            }
+
+        trainer = _mk(fuse_blocks)
+        batch_dict = trainer.put_batch(host_batch)
         float(trainer.step(batch_dict))  # compile
         # drop the warmup/compile step from the step window so the
         # reported percentiles/throughput cover only the timed loop
@@ -90,17 +123,19 @@ def main():
         from mxnet_tpu import telemetry
         telemetry.reset_steps()
         t0 = time.perf_counter()
-        steps = 5
         for _ in range(steps):
             loss = trainer.step(batch_dict)
         assert np.isfinite(float(loss))
         dt = time.perf_counter() - t0
+        fusion_info["summary"] = trainer.fusion_summary()
+        fusion_info["step_program_eqns"] = _step_program_eqns(
+            trainer, batch_dict)
         _emit({
             "metric": "dryrun_mlp_train_samples_per_sec_per_chip",
             "value": round(steps * batch / dt / n_dev, 2),
             "unit": "samples/s/chip",
             "vs_baseline": 0,
-        })
+        }, fusion=fusion_info)
         return
 
     # batch 128/chip: the reference benchmarks batch 32 on 12GB GPUs; the
@@ -135,7 +170,10 @@ def main():
         # measured-off (docs/perf.md): phase-decomposed stride-2 backward
         strided_bwd_phase=os.environ.get("BENCH_PHASE_BWD", "0") == "1",
         # pointwise convs lowered as fusible dots (ops/fused.py)
-        conv1x1_as_dot=os.environ.get("BENCH_CONV1X1_DOT", "0") == "1")
+        conv1x1_as_dot=os.environ.get("BENCH_CONV1X1_DOT", "0") == "1",
+        # block-granularity fusion + layout planning (analysis.fusion,
+        # docs/api/fusion.md); BENCH_FUSE_BLOCKS=0 for the unfused A/B
+        fuse_blocks=fuse_blocks)
 
     rng = np.random.RandomState(0)
     x = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)
@@ -181,16 +219,36 @@ def main():
         "value": round(img_per_sec_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_S, 3),
-    })
+    }, fusion={"enabled": fuse_blocks,
+               "summary": trainer.fusion_summary()})
 
 
-def _emit(result):
+def _step_program_eqns(trainer, batch_dict):
+    """Top-level jaxpr equation count of the trainer's step program:
+    the A/B graph-size evidence — every fused block collapses its
+    conv/BN/act (or FC/act) chain into ONE custom-vjp call equation.
+    None when the step cannot be retraced host-side."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        jaxpr = jax.make_jaxpr(trainer._py_step)(
+            trainer.params, trainer.opt_state, trainer.aux, batch_dict,
+            jax.random.PRNGKey(0), jnp.float32(0.1), jnp.float32(1.0))
+        return len(jaxpr.jaxpr.eqns)
+    except Exception:  # pragma: no cover - evidence is best-effort
+        return None
+
+
+def _emit(result, fusion=None):
     """Attach the standardized telemetry report (step-time percentiles,
     throughput, compile count, and the HBM block: static memory plans
     per compiled program + peak live memory_stats — the BENCH
-    trajectory fields) and print the one-line JSON artifact."""
+    trajectory fields) plus the block-fusion evidence, and print the
+    one-line JSON artifact."""
     from mxnet_tpu import telemetry
     rep = telemetry.report()
+    if fusion is not None:
+        result["fusion"] = fusion
     result["telemetry"] = {
         "steps": rep["steps"],
         "step_time_s": rep["step_time_s"],

@@ -72,6 +72,12 @@ def add_fit_args(parser):
     train.add_argument("--dtype", type=str, default="float32",
                        help="compute dtype for the fused path (bfloat16 "
                             "recommended on TPU; master weights stay f32)")
+    train.add_argument("--fuse-blocks", type=int, default=-1,
+                       help="1: block-granularity fusion on the fused "
+                            "trainer path (conv+BN+ReLU / FC+activation "
+                            "chains as single regions with layout "
+                            "planning, docs/api/fusion.md); 0: off; -1: "
+                            "auto (on for the fused path)")
     train.add_argument("--device-queue", type=int, default=-1,
                        help="1: double-buffer real-data batches onto the "
                             "chip with DevicePrefetchIter (decode + "
@@ -147,6 +153,10 @@ def _fit_fused(args, sym, train, val, kv):
         optimizer=args.optimizer, optimizer_params=optimizer_params,
         learning_rate=lr, momentum=args.mom, weight_decay=args.wd,
         dtype=args.dtype, auto_layouts=True,
+        # block-granularity fusion (analysis.fusion): on by default for
+        # the fused path — conv+BN+ReLU blocks become single regions
+        # with a pinned layout per boundary (docs/api/fusion.md)
+        fuse_blocks=getattr(args, "fuse_blocks", -1) != 0,
         initializer=mx.initializer.Xavier(
             rnd_type="gaussian", factor_type="in", magnitude=2))
     try:
@@ -180,6 +190,13 @@ def _fit_fused(args, sym, train, val, kv):
     dq = getattr(args, "device_queue", -1)
     use_queue = staged is None and (
         bool(dq) if dq != -1 else not mx.io.tunnel_limited_backend())
+    if staged is not None and dq == 1:
+        # ADVICE r5: an explicit request must not vanish silently
+        logging.info(
+            "--device-queue 1 is overridden by --benchmark staging: "
+            "synthetic batches are staged once and reused on device, so "
+            "there is no per-batch host->device transfer for the queue "
+            "to overlap")
 
     def _host_dict(batch):
         return {data_name: batch.data[0].asnumpy(),
@@ -206,6 +223,14 @@ def _fit_fused(args, sym, train, val, kv):
                     staged[nbatch] = dev
             loss = trainer.step(dev)
             nbatch += 1
+            if nbatch == 1 and epoch == begin_epoch:
+                fs = trainer.fusion_summary()
+                if fs:
+                    logging.info(
+                        "fusion plan: %d block(s) %s, %d relayout(s) "
+                        "eliminated, fallbacks=%s", fs["blocks"],
+                        fs["kinds"], fs["relayouts_eliminated"],
+                        fs["fallbacks"] or "none")
             if args.disp_batches and nbatch % args.disp_batches == 0:
                 # float(loss) syncs the async chain — the only per-batch
                 # device round trip, paid once per disp window

@@ -22,9 +22,12 @@ import os
 from ..base import MXNetError
 from .verifier import (Diagnostic, Report, verify_symbol, verify_json,
                        verify_model)
+from . import fusion
+from .fusion import plan_block_fusion, last_plan_summary
 
 __all__ = ["Diagnostic", "Report", "verify_symbol", "verify_json",
-           "verify_model", "load_mxlint", "registry_selfcheck"]
+           "verify_model", "load_mxlint", "registry_selfcheck",
+           "fusion", "plan_block_fusion", "last_plan_summary"]
 
 
 def registry_selfcheck():

@@ -1,0 +1,347 @@
+"""Block-granularity fusion + layout planning (ROADMAP item 1).
+
+The Glow-style lowering pass (PAPERS.md: arXiv:1805.00907) that turns
+the instrumentation of PRs 3-5 into throughput: conv->BN->ReLU and
+matmul->bias->activation chains — the blocks that dominate ResNet-style
+graphs — are pattern-matched over the Symbol DAG in topo order and each
+match is emitted as ONE fused region (`mxnet_tpu.ops.fused`
+``fused_block_*``: a Pallas matmul-with-stats kernel where eligible, a
+single custom-vjp XLA region otherwise).  Because every region carries
+a hand-written backward, training keeps one fused dispatch per block in
+BOTH directions; the plan runs wherever :func:`mxnet_tpu.symbol.
+eval_graph` traces — forward, the executor's vjp backward, and the
+trainer's fused step.
+
+**Layout planning.**  Each region boundary is pinned to an explicit
+activation layout (the trace-time ``image_layout``, NHWC on the TPU
+path).  Interior edges of a fused block — conv->BN, BN->act — no longer
+cross a region boundary, so the materialization/relayout XLA would
+schedule there disappears; when two fused blocks are adjacent (one's
+terminal feeds the other's input) the plan additionally pins both sides
+of the shared boundary to the same layout, eliminating the relayout
+between them.  Both counts are reported as
+``mxtpu_fusion_relayouts_eliminated_total``.
+
+**Chains matched** (see docs/api/fusion.md for the full rule catalog):
+
+=============  =====================================================
+kind           pattern (every interior node single-consumer)
+=============  =====================================================
+conv_bn_act    Convolution(2-d) -> BatchNorm -> Activation(relu)
+conv_bn        Convolution(2-d) -> BatchNorm (no relu consumer)
+bn_act         BatchNorm -> Activation(relu), producer not a fusable
+               conv (pre-activation ResNet is full of these)
+fc_act         FullyConnected -> Activation(relu|sigmoid|tanh)
+=============  =====================================================
+
+BatchNorm nodes must use the reference channel axis (``axis=1``) and
+not request ``output_mean_var``; ineligible candidates are recorded as
+fallbacks (``mxtpu_fusion_fallback_total{reason=...}``) and evaluated
+unfused — the pass degrades, never refuses a graph.
+
+Enabled per-trace by ``ops.fused.block_fusion`` (the
+``MXNET_FUSE_BLOCKS`` env default), wired through
+``Executor`` (bind-time capture) and ``ShardedTrainer(fuse_blocks=...)``.
+When the older conv1x1-only pass (``MXNET_FUSE_CONV_BN``) is also
+active it keeps its claims; this pass fuses everything else.
+"""
+from __future__ import annotations
+
+__all__ = ["FusedBlock", "FusionPlan", "plan_block_fusion",
+           "apply_block", "last_plan_summary", "FC_FUSABLE_ACTS"]
+
+FC_FUSABLE_ACTS = ("relu", "sigmoid", "tanh")
+
+# summary of the most recent recorded plan (bench.py / fit.py surface
+# it; plans are computed at trace time inside jit, so a module-level
+# snapshot is the only host-side handle)
+_LAST_SUMMARY = None
+
+
+class FusedBlock:
+    """One matched chain: the member nodes and how to emit them."""
+    __slots__ = ("kind", "terminal", "conv", "bn", "fc", "act", "pallas",
+                 "layout")
+
+    def __init__(self, kind, terminal, conv=None, bn=None, fc=None,
+                 act=None, pallas=False, layout="NCHW"):
+        self.kind = kind
+        self.terminal = terminal      # the node whose value the region yields
+        self.conv = conv
+        self.bn = bn
+        self.fc = fc
+        self.act = act                # act_type string or None
+        self.pallas = bool(pallas)
+        self.layout = layout
+
+    @property
+    def name(self):
+        return self.terminal.name
+
+    def interior(self):
+        """Member nodes other than the terminal (skipped at eval)."""
+        members = [n for n in (self.conv, self.bn, self.fc)
+                   if n is not None and n is not self.terminal]
+        return members
+
+
+class FusionPlan:
+    """The pass output: blocks keyed by terminal node id, the interior
+    node-id skip set, fallback records, and the layout plan."""
+
+    def __init__(self, layout, is_train):
+        self.layout = layout
+        self.is_train = bool(is_train)
+        self.blocks = {}          # id(terminal) -> FusedBlock
+        self.skip = set()         # interior node ids
+        self.fallbacks = []       # (node_name, reason)
+        self.interior_edges = 0   # relayout boundaries removed in-block
+        self.adjacent_edges = 0   # same-layout block-to-block boundaries
+
+    @property
+    def relayouts_eliminated(self):
+        return self.interior_edges + self.adjacent_edges
+
+    def add(self, block):
+        self.blocks[id(block.terminal)] = block
+        interior = block.interior()
+        for n in interior:
+            self.skip.add(id(n))
+        self.interior_edges += len(interior)
+
+    def fallback(self, node, reason):
+        self.fallbacks.append((node.name, reason))
+
+    def summary(self):
+        kinds = {}
+        for blk in self.blocks.values():
+            kinds[blk.kind] = kinds.get(blk.kind, 0) + 1
+        reasons = {}
+        for _name, reason in self.fallbacks:
+            reasons[reason] = reasons.get(reason, 0) + 1
+        return {
+            "layout": self.layout,
+            "is_train": self.is_train,
+            "blocks": len(self.blocks),
+            "kinds": kinds,
+            "pallas_blocks": sum(1 for b in self.blocks.values()
+                                 if b.pallas),
+            "relayouts_eliminated": self.relayouts_eliminated,
+            "fallbacks": reasons,
+        }
+
+
+def _consumers(topo, entries):
+    """id(node) -> list of (consumer node, input slot); graph heads
+    count as consumers (a head output must stay visible)."""
+    out = {}
+    for node in topo:
+        for slot, (src, _idx) in enumerate(node.inputs):
+            out.setdefault(id(src), []).append((node, slot))
+    for (node, _i) in entries:
+        out.setdefault(id(node), []).append((None, -1))
+    return out
+
+
+def _single_consumer(consumers, node):
+    """The unique (consumer, slot) of ``node``, or None."""
+    cs = consumers.get(id(node), ())
+    if len(cs) != 1 or cs[0][0] is None:
+        return None
+    return cs[0]
+
+
+def _is_op(node, name):
+    return (not node.is_variable and node.op is not None
+            and node.op.name == name)
+
+
+def _bn_fusable(bn, plan):
+    """BatchNorm eligibility shared by every BN-bearing chain."""
+    if bn.attrs.get("output_mean_var"):
+        plan.fallback(bn, "bn_output_mean_var")
+        return False
+    if int(bn.attrs.get("axis", 1)) != 1:
+        plan.fallback(bn, "bn_axis")
+        return False
+    return True
+
+
+def _conv_fusable(conv, layout, plan, claimed):
+    """Convolution eligibility as the head of a conv_bn* chain."""
+    if id(conv) in claimed:
+        plan.fallback(conv, "claimed_by_other_pass")
+        return False
+    if len(tuple(conv.attrs.get("kernel") or ())) != 2:
+        plan.fallback(conv, "conv_ndim")
+        return False
+    if conv.attrs.get("layout") and conv.attrs["layout"] != layout:
+        plan.fallback(conv, "conv_layout_pinned")
+        return False
+    return True
+
+
+def plan_block_fusion(topo, entries, layout="NCHW", is_train=True,
+                      exclude=(), record=True):
+    """Match fusable chains over ``topo`` and return a
+    :class:`FusionPlan`.  ``exclude``: node ids already claimed by
+    another trace-time pass (conv1x1+BN, stem s2d, dX elision) — chains
+    touching them fall back.  ``record`` emits the ``mxtpu_fusion_*``
+    metrics and a ``fusion_plan`` flight event (one per trace)."""
+    plan = FusionPlan(layout, is_train)
+    consumers = _consumers(topo, entries)
+    claimed = set(exclude)
+
+    from ..ops import fused as _fused
+
+    def conv_chain(bn, act_node, act_type):
+        """Try conv->bn(->act); returns the block or None."""
+        src, idx = bn.inputs[0]
+        if not _is_op(src, "Convolution") or idx != 0:
+            return None
+        nxt = _single_consumer(consumers, src)
+        if nxt is None or nxt[0] is not bn:
+            plan.fallback(src, "conv_multi_consumer")
+            return None
+        if not _conv_fusable(src, layout, plan, claimed):
+            return None
+        pallas = (_fused._conv_eligible(src) and layout == "NHWC"
+                  and is_train and not bn.attrs.get("use_global_stats"))
+        return FusedBlock("conv_bn_act" if act_node is not None
+                          else "conv_bn",
+                          terminal=act_node if act_node is not None
+                          else bn,
+                          conv=src, bn=bn, act=act_type, pallas=pallas,
+                          layout=layout)
+
+    for node in topo:
+        if node.is_variable or node.op is None or id(node) in claimed:
+            continue
+        blk = None
+        if _is_op(node, "Activation"):
+            act_type = node.attrs.get("act_type", "relu")
+            src, idx = node.inputs[0]
+            if src.is_variable or src.op is None or idx != 0 \
+                    or id(src) in claimed or id(src) in plan.skip \
+                    or id(src) in plan.blocks:
+                continue
+            one = _single_consumer(consumers, src)
+            if one is None or one[0] is not node:
+                continue
+            if _is_op(src, "BatchNorm") and act_type == "relu":
+                if not _bn_fusable(src, plan):
+                    continue
+                blk = conv_chain(src, node, act_type)
+                if blk is None:
+                    blk = FusedBlock("bn_act", terminal=node, bn=src,
+                                     act=act_type, layout=layout)
+            elif _is_op(src, "FullyConnected") \
+                    and act_type in FC_FUSABLE_ACTS:
+                blk = FusedBlock("fc_act", terminal=node, fc=src,
+                                 act=act_type, layout=layout)
+            elif _is_op(src, "BatchNorm"):
+                plan.fallback(node, "act_type")
+        elif _is_op(node, "BatchNorm"):
+            if id(node) in plan.skip or id(node) in plan.blocks:
+                continue
+            # BN whose single consumer is a fusable relu is deferred to
+            # the Activation visit above (the longer chain wins)
+            one = _single_consumer(consumers, node)
+            if one is not None and _is_op(one[0], "Activation") \
+                    and one[0].attrs.get("act_type") == "relu" \
+                    and one[1] == 0:
+                continue
+            if not _bn_fusable(node, plan):
+                continue
+            blk = conv_chain(node, None, None)
+        if blk is not None:
+            # a block's members must not collide with earlier claims
+            members = blk.interior() + [blk.terminal]
+            if any(id(m) in plan.skip or id(m) in plan.blocks
+                   for m in members):
+                continue
+            plan.add(blk)
+
+    # layout plan: adjacent fused regions sharing a boundary keep one
+    # pinned layout — no relayout between them
+    terminal_layout = {tid: b.layout for tid, b in plan.blocks.items()}
+    for blk in plan.blocks.values():
+        first = blk.conv or blk.fc or blk.bn
+        src, _idx = first.inputs[0]
+        if terminal_layout.get(id(src)) == blk.layout:
+            plan.adjacent_edges += 1
+
+    if record:
+        _record(plan)
+    return plan
+
+
+def _record(plan):
+    """Emit the plan's metrics + flight event and snapshot the summary
+    (runs at trace time — host-side python, once per compile)."""
+    global _LAST_SUMMARY
+    s = plan.summary()
+    _LAST_SUMMARY = s
+    try:
+        from .. import telemetry
+        from ..telemetry import flight
+        telemetry.counter("mxtpu_fusion_plans_total").inc()
+        for kind, n in s["kinds"].items():
+            telemetry.counter("mxtpu_fusion_blocks_total").labels(
+                kind=kind).inc(n)
+        if s["relayouts_eliminated"]:
+            telemetry.counter(
+                "mxtpu_fusion_relayouts_eliminated_total").inc(
+                s["relayouts_eliminated"])
+        for reason, n in s["fallbacks"].items():
+            telemetry.counter("mxtpu_fusion_fallback_total").labels(
+                reason=reason).inc(n)
+        flight.record("fusion_plan", **s)
+    except MemoryError:  # pragma: no cover - observability must not kill a trace
+        raise
+    except Exception:  # mxlint: allow-broad-except(metric emission is observability; a telemetry failure must not fail the trace that is being fused)
+        pass
+
+
+def last_plan_summary():
+    """Summary dict of the most recent recorded plan in this process
+    (None before any fused trace).  See :meth:`FusionPlan.summary`."""
+    return _LAST_SUMMARY
+
+
+def apply_block(blk, vals, is_train):
+    """Evaluate one planned block from the eval_graph value map.
+    Returns (out, bn_node_or_None, [new_mm, new_mv] or None); the
+    caller threads the BN aux updates exactly as the unfused op would.
+    """
+    from ..ops import fused as _fused
+
+    def val(node, slot):
+        src, idx = node.inputs[slot]
+        return vals[id(src)][idx]
+
+    if blk.kind in ("conv_bn_act", "conv_bn"):
+        conv, bn = blk.conv, blk.bn
+        x, w = val(conv, 0), val(conv, 1)
+        b = None if conv.attrs.get("no_bias") else val(conv, 2)
+        gamma, beta = val(bn, 1), val(bn, 2)
+        mm, mv = val(bn, 3), val(bn, 4)
+        out, new_mm, new_mv = _fused.fused_block_conv_bn_act(
+            conv.attrs, bn.attrs, blk.layout, is_train, blk.act,
+            blk.pallas, x, w, b, gamma, beta, mm, mv)
+        return out, bn, [new_mm, new_mv]
+    if blk.kind == "bn_act":
+        bn = blk.bn
+        x = val(bn, 0)
+        ch = 3 if (blk.layout == "NHWC" and x.ndim == 4) else 1
+        out, new_mm, new_mv = _fused.fused_block_bn_act(
+            bn.attrs, ch, is_train, blk.act, x, val(bn, 1), val(bn, 2),
+            val(bn, 3), val(bn, 4))
+        return out, bn, [new_mm, new_mv]
+    if blk.kind == "fc_act":
+        fc = blk.fc
+        x, w = val(fc, 0), val(fc, 1)
+        b = None if fc.attrs.get("no_bias") else val(fc, 2)
+        out = _fused.fused_block_fc_act(fc.attrs, blk.act, x, w, b)
+        return out, None, None
+    raise ValueError("unknown fused block kind %r" % (blk.kind,))

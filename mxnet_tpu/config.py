@@ -44,6 +44,11 @@ _CATALOG = {
     "MXNET_FUSE_CONV_BN": ("0", "honored",
         "Pallas conv1x1+BN stats fusion in ShardedTrainer (docs/perf.md: "
         "measured slower on v5e; off by default)"),
+    "MXNET_FUSE_BLOCKS": ("0", "honored",
+        "block-granularity fusion pass (analysis.fusion): conv+BN+ReLU "
+        "and FC+activation chains lowered as single fused regions with "
+        "an explicit layout plan per boundary (docs/api/fusion.md); "
+        "default for Executor binds and ShardedTrainer(fuse_blocks=None)"),
     "MXNET_STEM_S2D": ("0", "honored",
         "space-to-depth rewrite of 7x7/s2 stem convs in ShardedTrainer"),
     "MXNET_PHASE_BWD": ("0", "honored",

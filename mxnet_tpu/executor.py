@@ -118,6 +118,15 @@ class Executor:
             verify_symbol(symbol, shapes=shapes,
                           types=types).raise_if_errors("bind strict=True")
 
+        # block-granularity fusion (analysis.fusion): the enable flag is
+        # captured at bind time (trace flags are read when jit traces,
+        # which happens lazily at first call — long after any caller's
+        # context manager exited), and re-activated around every
+        # eval_graph trace below so forward, backward, and the fused
+        # train path all lower through the same plan.
+        from .ops import fused as _fused_mod
+        self._block_fusion = _fused_mod.block_fusion_enabled()
+
         self._outputs = None
         self._last_key = None
         self._last_train = False
@@ -173,13 +182,16 @@ class Executor:
         topo, entries = self._topo, self._symbol._entries
         var_ids = self._var_ids()
 
+        from .ops.fused import block_fusion
+
         def raw(vals, key):
             var_values = dict(zip(var_ids, vals))
             bsz = vals[0].shape[0] if vals and vals[0].ndim else None
-            heads, aux_updates = eval_graph(topo, entries, var_values,
-                                            is_train=is_train, key=key,
-                                            batch_size=bsz,
-                                            device_map=self._device_map)
+            with block_fusion(self._block_fusion):
+                heads, aux_updates = eval_graph(
+                    topo, entries, var_values, is_train=is_train,
+                    key=key, batch_size=bsz,
+                    device_map=self._device_map)
             n_args = len(self._arg_nodes)
             aux_out = [aux_updates.get(id(n), vals[n_args + i])
                        for i, n in enumerate(self._aux_nodes)]
@@ -235,6 +247,8 @@ class Executor:
                          if self._grad_req[n] != "null")
         head_is_loss = self._head_is_loss
 
+        from .ops.fused import block_fusion
+
         def raw(vals, key, out_grads):
             diff_vals = tuple(vals[i] for i in diff_idx)
 
@@ -244,10 +258,11 @@ class Executor:
                     full[i] = diff[j]
                 var_values = dict(zip(var_ids, full))
                 bsz = full[0].shape[0] if full and full[0].ndim else None
-                heads, _aux = eval_graph(topo, entries, var_values,
-                                         is_train=True, key=key,
-                                         batch_size=bsz,
-                                         device_map=self._device_map)
+                with block_fusion(self._block_fusion):
+                    heads, _aux = eval_graph(topo, entries, var_values,
+                                             is_train=True, key=key,
+                                             batch_size=bsz,
+                                             device_map=self._device_map)
                 return heads
 
             heads, vjp = jax.vjp(self._maybe_mirror(f), diff_vals)
@@ -280,6 +295,8 @@ class Executor:
         head_is_loss = self._head_is_loss
         n_args = len(self._arg_nodes)
 
+        from .ops.fused import block_fusion
+
         def raw(vals, key):
             diff_vals = tuple(vals[i] for i in diff_idx)
 
@@ -289,10 +306,11 @@ class Executor:
                     full[i] = diff[j]
                 var_values = dict(zip(var_ids, full))
                 bsz = full[0].shape[0] if full and full[0].ndim else None
-                heads, aux_upd = eval_graph(topo, entries, var_values,
-                                            is_train=True, key=key,
-                                            batch_size=bsz,
-                                            device_map=self._device_map)
+                with block_fusion(self._block_fusion):
+                    heads, aux_upd = eval_graph(
+                        topo, entries, var_values, is_train=True,
+                        key=key, batch_size=bsz,
+                        device_map=self._device_map)
                 return heads, aux_upd
 
             heads, vjp, aux_upd = jax.vjp(self._maybe_mirror(f), diff_vals,

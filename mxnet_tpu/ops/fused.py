@@ -31,6 +31,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import MXNetError
+
+
 def _trace_flag(env_var, doc):
     """(context_manager_class, enabled_fn) for a tri-state trace flag:
     None -> the env var decides, True/False -> forced by the context."""
@@ -61,6 +64,16 @@ def _trace_flag(env_var, doc):
 conv_bn_fusion, fusion_enabled = _trace_flag(
     "MXNET_FUSE_CONV_BN",
     "Context manager enabling/disabling the conv1x1+BN fusion during a "
+    "trace.")
+
+# Block-granularity fusion (ISSUE 6): the graph-level pass lives in
+# :mod:`mxnet_tpu.analysis.fusion`; the fused-region math it lowers to
+# lives below (`fused_block_*`).  When enabled it supersedes the
+# conv1x1-only pass above for every chain the old pass does not claim.
+block_fusion, block_fusion_enabled = _trace_flag(
+    "MXNET_FUSE_BLOCKS",
+    "Context manager enabling the block-granularity fusion pass "
+    "(conv+BN+ReLU / FC+activation regions, analysis.fusion) during a "
     "trace.")
 
 
@@ -148,9 +161,12 @@ def matmul_stats(x2d, w2d, c):
 
 # --------------------------------------------- fused conv1x1+BN (train)
 @functools.lru_cache(maxsize=None)
-def _fused_conv_bn(eps, momentum):
+def _fused_conv_bn(eps, momentum, relu=False):
     """custom_vjp: NHWC x (N,H,W,K) + OIHW w (N_out,K,1,1) + BN params
-    -> (out, mean, var, new_mm, new_mv), _bn_core numerics."""
+    -> (out, mean, var, new_mm, new_mv), _bn_core numerics.  With
+    ``relu`` the activation folds into the same region (forward epilogue
+    + mask in the hand-written backward) — the conv+BN+ReLU block stays
+    one fused dispatch each way (analysis.fusion)."""
 
     def fwd_math(x, w, gamma, beta, mm, mv):
         nb, h, wd, k = x.shape
@@ -169,9 +185,11 @@ def _fused_conv_bn(eps, momentum):
         scale = gamma.astype(jnp.float32) * inv
         shift = beta.astype(jnp.float32) - mean * scale
         out2d = y2d.astype(jnp.float32) * scale + shift
+        if relu:
+            out2d = jnp.maximum(out2d, 0.0)
         out = out2d.astype(x.dtype).reshape(nb, h, wd, nout)
         return ((out, mean, var, new_mm, new_mv),
-                (x, w, y2d, gamma, mean, inv, c))
+                (x, w, y2d, gamma, beta, mean, inv, c))
 
     @jax.custom_vjp
     def f(x, w, gamma, beta, mm, mv):
@@ -181,7 +199,7 @@ def _fused_conv_bn(eps, momentum):
         return fwd_math(x, w, gamma, beta, mm, mv)
 
     def f_bwd(res, cots):
-        x, w, y2d, gamma, mean, inv, c = res
+        x, w, y2d, gamma, beta, mean, inv, c = res
         dout, dmean_o, dvar_o, dmm_o, dmv_o = cots
         nb, h, wd, k = x.shape
         nout = w.shape[0]
@@ -189,6 +207,13 @@ def _fused_conv_bn(eps, momentum):
         x2d = x.reshape(m, k)
         w2d = jnp.transpose(w.reshape(nout, k)).astype(x.dtype)
         dyf = dout.reshape(m, nout).astype(jnp.float32)
+        if relu:
+            # mask from the recomputed pre-activation (saving it would
+            # cost an extra (M, Nout) residual; scale/shift are vectors)
+            scale = gamma.astype(jnp.float32) * inv
+            shift = beta.astype(jnp.float32) - mean * scale
+            pre = y2d.astype(jnp.float32) * scale + shift
+            dyf = jnp.where(pre > 0, dyf, 0.0)
         ys = y2d.astype(jnp.float32) - c
         meanc = mean - c
         dbeta = jnp.sum(dyf, axis=0)
@@ -234,6 +259,365 @@ def fused_conv_bn_apply(conv_attrs, bn_attrs, is_train, x, w, gamma,
     if bn_attrs.get("output_mean_var"):
         return out, mean, var, new_mm, new_mv
     return out, new_mm, new_mv
+
+
+# ------------------------------------------- block-granularity regions
+# The fused-region math the analysis.fusion pass lowers each matched
+# chain to.  Every region is a jax.custom_vjp whose backward is
+# hand-written, so training keeps ONE fused dispatch per block in each
+# direction: XLA sees a single region boundary instead of a
+# conv->materialize->stats->materialize->relu chain, and the layout at
+# that boundary is pinned by the plan (no relayout between fused
+# blocks).  All statics (layout, attrs) are baked into the lru-cache
+# key: the custom-vjp backward is traced OUTSIDE the image_layout
+# context (jax pulls it when the caller's vjp runs), so nothing in a
+# backward may read trace-time globals.
+
+
+def _conv_key(conv_attrs):
+    """Hashable statics of a 2-d Convolution node (region cache key)."""
+    kernel = tuple(conv_attrs["kernel"])
+    nd = len(kernel)
+    return (kernel,
+            tuple(conv_attrs["stride"]) or (1,) * nd,
+            tuple(conv_attrs["dilate"]) or (1,) * nd,
+            tuple(conv_attrs["pad"]) or (0,) * nd,
+            int(conv_attrs.get("num_group", 1)))
+
+
+def _conv2d_fn(conv_key, layout):
+    """(x, w_oihw) -> y for one conv static config, layout baked in
+    (mirrors ops/nn.py `convolution` for the respective layout)."""
+    kernel, stride, dilate, pad, groups = conv_key
+
+    def conv(x, w):
+        if layout == "NHWC":
+            dn = lax.conv_dimension_numbers(
+                x.shape, w.shape[2:] + w.shape[1:2] + w.shape[:1],
+                ("NHWC", "HWIO", "NHWC"))
+            w_ = jnp.transpose(w, (2, 3, 1, 0))
+        else:
+            dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+            w_ = w
+        return lax.conv_general_dilated(
+            x, w_, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=groups)
+
+    return conv
+
+
+def _bn_epilogue_fwd(yf, gamma, beta, mm, mv, red, bshape, eps,
+                     momentum, train_stats, act):
+    """Shared BN(+act) forward epilogue over a pre-computed f32 tensor.
+    Returns (out_f32, new_mm, new_mv, mean, inv)."""
+    if train_stats:
+        n = 1
+        for i in red:
+            n *= yf.shape[i]
+        # shifted single-pass stats, same formulation as ops/nn._bn_core
+        c = lax.stop_gradient(mm.astype(jnp.float32))
+        ys = yf - c.reshape(bshape)
+        s1 = jnp.sum(ys, axis=red)
+        s2 = jnp.sum(jnp.square(ys), axis=red)
+        meanc = s1 / n
+        var = jnp.maximum(s2 / n - jnp.square(meanc), 0.0)
+        mean = meanc + c
+        new_mm = mm * momentum + mean * (1 - momentum)
+        new_mv = mv * momentum + var * (1 - momentum)
+    else:
+        mean = mm.astype(jnp.float32)
+        var = mv.astype(jnp.float32)
+        new_mm, new_mv = mm, mv
+    inv = lax.rsqrt(var + eps)
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean * scale
+    out = yf * scale.reshape(bshape) + shift.reshape(bshape)
+    if act == "relu":
+        out = jnp.maximum(out, 0.0)
+    return out, new_mm, new_mv, mean, inv
+
+
+def _bn_epilogue_bwd(dout, yf, gamma, beta, mean, inv, mm, red, bshape,
+                     momentum, train_stats, act, dmm_o, dmv_o):
+    """Shared BN(+act) backward: cotangent of the epilogue's input
+    tensor plus the BN parameter/aux gradients.  Returns
+    (dY_f32, dgamma, dbeta, dmm, dmv)."""
+    dyf = dout.astype(jnp.float32)
+    a = gamma.astype(jnp.float32) * inv
+    if act == "relu":
+        # mask from the recomputed pre-activation (vector scale/shift;
+        # saving the mask would cost a full-tensor residual)
+        scale = a
+        shift = beta.astype(jnp.float32) - mean * scale
+        pre = yf * scale.reshape(bshape) + shift.reshape(bshape)
+        dyf = jnp.where(pre > 0, dyf, 0.0)
+    # shifted by c = the moving mean snapshot (== mean in eval mode)
+    c = lax.stop_gradient(mm.astype(jnp.float32))
+    ys = yf - c.reshape(bshape)
+    meanc = mean - c
+    dbeta = jnp.sum(dyf, axis=red)
+    sdyxs = jnp.sum(dyf * ys, axis=red)
+    dgamma = (sdyxs - meanc * dbeta) * inv
+    if train_stats:
+        n = 1
+        for i in red:
+            n *= yf.shape[i]
+        dmean = (1 - momentum) * dmm_o
+        dvar = (1 - momentum) * dmv_o
+        k = (-a * inv * dgamma + 2.0 * dvar) * (1.0 / n)
+        d = -k * meanc - a * dbeta * (1.0 / n) + dmean * (1.0 / n)
+        dY = (dyf * a.reshape(bshape) + ys * k.reshape(bshape)
+              + d.reshape(bshape))
+        dmm = momentum * dmm_o
+        dmv = momentum * dmv_o
+    else:
+        dY = dyf * a.reshape(bshape)
+        dmm, dmv = dmm_o, dmv_o
+    return dY, dgamma, dbeta, dmm, dmv
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_conv_bn_act_xla(conv_key, layout, eps, momentum, train_stats,
+                           act, has_bias):
+    """General conv->BN(->act) region (any 2-d conv, NCHW or NHWC):
+    f(x, w[, b], gamma, beta, mm, mv) -> (out, new_mm, new_mv).
+    Backward: BN/act math hand-written (one reduce pass + one dY pass),
+    conv dX/dW via jax.vjp of the conv closure — still one region."""
+    conv = _conv2d_fn(conv_key, layout)
+    ch = 3 if layout == "NHWC" else 1
+    red = tuple(i for i in range(4) if i != ch)
+
+    def bias_shape(nout):
+        return (1, nout, 1, 1) if ch == 1 else (nout,)
+
+    def fwd_math(x, w, b, gamma, beta, mm, mv):
+        from .nn import _mxu_out
+        y = _mxu_out(conv(x, w).astype(x.dtype))
+        if b is not None:
+            y = y + b.reshape(bias_shape(b.shape[0])).astype(x.dtype)
+        bshape = tuple(1 if i != ch else y.shape[ch] for i in range(4))
+        yf = y.astype(jnp.float32)
+        out, new_mm, new_mv, mean, inv = _bn_epilogue_fwd(
+            yf, gamma, beta, mm, mv, red, bshape, eps, momentum,
+            train_stats, act)
+        res = (x, w, y, gamma, beta, mean, inv, mm)
+        return (out.astype(x.dtype), new_mm, new_mv), res
+
+    def bwd_math(res, cots):
+        x, w, y, gamma, beta, mean, inv, mm = res
+        dout, dmm_o, dmv_o = cots
+        bshape = tuple(1 if i != ch else y.shape[ch] for i in range(4))
+        dY, dgamma, dbeta, dmm, dmv = _bn_epilogue_bwd(
+            dout, y.astype(jnp.float32), gamma, beta, mean, inv, mm,
+            red, bshape, momentum, train_stats, act, dmm_o, dmv_o)
+        dYc = dY.astype(x.dtype)
+        _, cvjp = jax.vjp(lambda xx, ww: conv(xx, ww).astype(x.dtype),
+                          x, w)
+        dx, dw = cvjp(dYc)
+        db = jnp.sum(dY, axis=red)
+        return (dx, dw, db, dgamma.astype(gamma.dtype),
+                dbeta.astype(beta.dtype), dmm, dmv)
+
+    if has_bias:
+        @jax.custom_vjp
+        def f(x, w, b, gamma, beta, mm, mv):
+            return fwd_math(x, w, b, gamma, beta, mm, mv)[0]
+
+        def f_fwd(x, w, b, gamma, beta, mm, mv):
+            out, res = fwd_math(x, w, b, gamma, beta, mm, mv)
+            return out, res + (b,)
+
+        def f_bwd(res, cots):
+            b = res[-1]
+            dx, dw, db, dgamma, dbeta, dmm, dmv = bwd_math(res[:-1],
+                                                           cots)
+            # db accumulates in f32; the cotangent aval must match the
+            # primal bias (bf16 under the trainer's compute view)
+            return dx, dw, db.astype(b.dtype), dgamma, dbeta, dmm, dmv
+
+        f.defvjp(f_fwd, f_bwd)
+        return f
+
+    @jax.custom_vjp
+    def f(x, w, gamma, beta, mm, mv):
+        return fwd_math(x, w, None, gamma, beta, mm, mv)[0]
+
+    def f_fwd(x, w, gamma, beta, mm, mv):
+        return fwd_math(x, w, None, gamma, beta, mm, mv)
+
+    def f_bwd(res, cots):
+        dx, dw, _db, dgamma, dbeta, dmm, dmv = bwd_math(res, cots)
+        return dx, dw, dgamma, dbeta, dmm, dmv
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_bn_act_xla(eps, momentum, train_stats, ch, ndim, act):
+    """BN(->act) region for chains whose producer is not a fusable
+    conv (pre-activation nets are full of BN->ReLU pairs):
+    f(x, gamma, beta, mm, mv) -> (out, new_mm, new_mv)."""
+    red = tuple(i for i in range(ndim) if i != ch)
+
+    def fwd_math(x, gamma, beta, mm, mv):
+        bshape = tuple(1 if i != ch else x.shape[ch] for i in range(ndim))
+        xf = x.astype(jnp.float32)
+        out, new_mm, new_mv, mean, inv = _bn_epilogue_fwd(
+            xf, gamma, beta, mm, mv, red, bshape, eps, momentum,
+            train_stats, act)
+        return ((out.astype(x.dtype), new_mm, new_mv),
+                (x, gamma, beta, mean, inv, mm))
+
+    @jax.custom_vjp
+    def f(x, gamma, beta, mm, mv):
+        return fwd_math(x, gamma, beta, mm, mv)[0]
+
+    def f_fwd(x, gamma, beta, mm, mv):
+        return fwd_math(x, gamma, beta, mm, mv)
+
+    def f_bwd(res, cots):
+        x, gamma, beta, mean, inv, mm = res
+        dout, dmm_o, dmv_o = cots
+        bshape = tuple(1 if i != ch else x.shape[ch] for i in range(ndim))
+        dY, dgamma, dbeta, dmm, dmv = _bn_epilogue_bwd(
+            dout, x.astype(jnp.float32), gamma, beta, mean, inv, mm,
+            red, bshape, momentum, train_stats, act, dmm_o, dmv_o)
+        return (dY.astype(x.dtype), dgamma.astype(gamma.dtype),
+                dbeta.astype(beta.dtype), dmm, dmv)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_fc_act_xla(act, flatten, has_bias):
+    """FullyConnected(->act) region: f(x, w[, b]) -> out with the
+    activation derivative folded into the hand-written backward, so the
+    matmul->bias->act block is one fused dispatch each way."""
+
+    def act_fwd(y):
+        if act == "relu":
+            return jnp.maximum(y, 0)
+        if act == "sigmoid":
+            return jax.nn.sigmoid(y)
+        if act == "tanh":
+            return jnp.tanh(y)
+        raise MXNetError("unfusable activation %r" % (act,))
+
+    def act_grad(out, g):
+        if act == "relu":
+            return jnp.where(out > 0, g, jnp.zeros_like(g))
+        if act == "sigmoid":
+            return g * out * (1 - out)
+        if act == "tanh":
+            return g * (1 - jnp.square(out))
+        raise MXNetError("unfusable activation %r" % (act,))
+
+    def fwd_math(x, w, b):
+        from .nn import _mxu_out
+        x2 = x.reshape((x.shape[0], -1)) if flatten and x.ndim > 2 else x
+        y = jnp.dot(x2, w.T)
+        if b is not None:
+            y = y + b
+        out = act_fwd(_mxu_out(y.astype(x.dtype)))
+        return out, (x, w, out)
+
+    def bwd_math(res, g):
+        x, w, out = res
+        x2 = x.reshape((x.shape[0], -1)) if flatten and x.ndim > 2 else x
+        gy = act_grad(out, g).astype(x.dtype)
+        # flatten=False keeps leading batch dims (y = x @ w.T on rank-n
+        # x, ops/nn.py): contract ALL of them, not just axis 0
+        red = tuple(range(gy.ndim - 1))
+        dx2 = jnp.dot(gy, w)
+        dw = jnp.tensordot(gy, x2, axes=(red, red))
+        db = jnp.sum(gy.astype(jnp.float32), axis=red)
+        return dx2.reshape(x.shape).astype(x.dtype), \
+            dw.astype(w.dtype), db
+
+    if has_bias:
+        @jax.custom_vjp
+        def f(x, w, b):
+            return fwd_math(x, w, b)[0]
+
+        def f_fwd(x, w, b):
+            out, res = fwd_math(x, w, b)
+            return out, res + (b,)
+
+        def f_bwd(res, g):
+            dx, dw, db = bwd_math(res[:-1], g)
+            # the cotangent aval must match the primal bias, which may
+            # not share the weight's dtype (caller-bound executor args)
+            return dx, dw, db.astype(res[-1].dtype)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f
+
+    @jax.custom_vjp
+    def f(x, w):
+        return fwd_math(x, w, None)[0]
+
+    def f_fwd(x, w):
+        return fwd_math(x, w, None)
+
+    def f_bwd(res, g):
+        dx, dw, _db = bwd_math(res, g)
+        return dx, dw
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def fused_block_conv_bn_act(conv_attrs, bn_attrs, layout, is_train, act,
+                            pallas, x, w, b, gamma, beta, mm, mv):
+    """Evaluate a planned conv->BN(->act) block; returns
+    (out, new_mm, new_mv).  ``pallas`` routes the eligible 1x1 case
+    through the matmul-with-stats-epilogue kernel (`matmul_stats`);
+    everything else runs the general single-region custom_vjp."""
+    eps = float(bn_attrs["eps"])
+    momentum = float(bn_attrs["momentum"])
+    train_stats = bool(is_train and not bn_attrs.get("use_global_stats"))
+    if bn_attrs.get("fix_gamma"):
+        gamma = lax.stop_gradient(jnp.ones_like(gamma))
+    mm32 = mm.astype(jnp.float32)
+    mv32 = mv.astype(jnp.float32)
+    if pallas and train_stats and b is None and layout == "NHWC":
+        f = _fused_conv_bn(eps, momentum, relu=(act == "relu"))
+        out, _mean, _var, new_mm, new_mv = f(x, w, gamma, beta, mm32,
+                                             mv32)
+    else:
+        f = _fused_conv_bn_act_xla(_conv_key(conv_attrs), layout, eps,
+                                   momentum, train_stats, act,
+                                   b is not None)
+        args = (x, w) + ((b,) if b is not None else ()) + \
+            (gamma, beta, mm32, mv32)
+        out, new_mm, new_mv = f(*args)
+    return out, new_mm.astype(mm.dtype), new_mv.astype(mv.dtype)
+
+
+def fused_block_bn_act(bn_attrs, ch, is_train, act, x, gamma, beta, mm,
+                       mv):
+    """Evaluate a planned BN(->act) block; returns
+    (out, new_mm, new_mv)."""
+    eps = float(bn_attrs["eps"])
+    momentum = float(bn_attrs["momentum"])
+    train_stats = bool(is_train and not bn_attrs.get("use_global_stats"))
+    if bn_attrs.get("fix_gamma"):
+        gamma = lax.stop_gradient(jnp.ones_like(gamma))
+    f = _fused_bn_act_xla(eps, momentum, train_stats, ch, x.ndim, act)
+    out, new_mm, new_mv = f(x, gamma, beta, mm.astype(jnp.float32),
+                            mv.astype(jnp.float32))
+    return out, new_mm.astype(mm.dtype), new_mv.astype(mv.dtype)
+
+
+def fused_block_fc_act(fc_attrs, act, x, w, b):
+    """Evaluate a planned FullyConnected(->act) block."""
+    f = _fused_fc_act_xla(act, bool(fc_attrs.get("flatten", True)),
+                          b is not None)
+    return f(x, w, b) if b is not None else f(x, w)
 
 
 # ---------------------------------------------------------- graph pass

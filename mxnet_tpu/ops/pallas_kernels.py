@@ -176,7 +176,16 @@ def _blocks(t):
     # panel only streams once T outgrows the VMEM budget
     block_k = min(_BLOCK_K, t)
     if t % block_k:
+        # ADVICE r5 perf cliff: t not a _BLOCK_K multiple used to
+        # collapse straight to block_q, streaming t/128 tiny K blocks
+        # (t=3200 -> 25).  Take the largest block_q-multiple divisor of
+        # t that still fits the VMEM budget instead (3200 -> 5x640).
         block_k = block_q                  # t is a block_q multiple here
+        m = 2 * block_q
+        while m <= min(_BLOCK_K, t):
+            if t % m == 0:
+                block_k = m
+            m += block_q
     return block_q, block_k
 
 

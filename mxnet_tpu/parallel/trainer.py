@@ -142,7 +142,7 @@ class ShardedTrainer:
                  optimizer="sgd", optimizer_params=None, learning_rate=0.05,
                  momentum=0.9, weight_decay=0.0, initializer=None,
                  dtype="float32", tp_rules=None, seed=0, layout=None,
-                 auto_layouts=False, fuse_conv_bn=None,
+                 auto_layouts=False, fuse_conv_bn=None, fuse_blocks=None,
                  stem_space_to_depth=None, elide_input_bn_grad=True,
                  strided_bwd_phase=None, pipeline_stages=1,
                  pipeline_microbatches=None, sequence_parallel=False,
@@ -211,6 +211,15 @@ class ShardedTrainer:
             from ..ops import fused as _fused_mod
             fuse_conv_bn = _fused_mod.fusion_enabled()
         self._fuse_conv_bn = bool(fuse_conv_bn) and self._layout == "NHWC"
+        # fuse_blocks: block-granularity fusion pass (analysis.fusion) —
+        # conv+BN+ReLU / FC+activation chains emitted as single
+        # custom-vjp regions with a pinned layout per boundary, on both
+        # the train step's forward AND its backward.  Works in either
+        # layout; None -> the MXNET_FUSE_BLOCKS env default.
+        if fuse_blocks is None:
+            from ..ops import fused as _fused_mod
+            fuse_blocks = _fused_mod.block_fusion_enabled()
+        self._fuse_blocks = bool(fuse_blocks)
         # stem_space_to_depth: equivalent 4x4/s1 rewrite of the 7x7/s2
         # C=3 stem conv (ops/fused.py stem_s2d_conv)
         if stem_space_to_depth is None:
@@ -1010,11 +1019,12 @@ class ShardedTrainer:
                 # weights arrive HWIO and grads flow back HWIO
                 from ..ops.fused import (conv_bn_fusion, stem_s2d,
                                          elide_input_grads, phase_bwd,
-                                         conv1x1_dot)
+                                         conv1x1_dot, block_fusion)
                 from .sequence import sequence_parallel as seq_ctx
                 p = self._compute_view(p32, compute_dtype)
                 with image_layout(layout), \
                         conv_bn_fusion(self._fuse_conv_bn), \
+                        block_fusion(self._fuse_blocks), \
                         stem_s2d(self._stem_s2d), \
                         phase_bwd(self._phase_bwd), \
                         conv1x1_dot(self._conv1x1_dot), \
@@ -1558,6 +1568,7 @@ class ShardedTrainer:
             compute_dtype = jnp.dtype(self.dtype)
 
             def fwd(params, aux, batch):
+                from ..ops.fused import block_fusion
                 from .sequence import sequence_parallel as seq_ctx
                 p = self._compute_view(params, compute_dtype)
                 bsz = next(iter(batch.values())).shape[0]
@@ -1568,7 +1579,10 @@ class ShardedTrainer:
                     if n not in full:
                         full[n] = jnp.zeros((bsz,) + tuple(s[1:]),
                                             jnp.float32)
+                # the fused blocks keep eval-mode BN semantics inside
+                # the region, so inference lowers through the same plan
                 with image_layout(layout), \
+                        block_fusion(self._fuse_blocks), \
                         seq_ctx(self.mesh if self._seq_parallel
                                 else None):
                     var_values = self._node_value_map(p, full, aux)
@@ -1588,6 +1602,14 @@ class ShardedTrainer:
             dev_batch = self.put_batch(batch)
         return self._fwd_fn(self.params, self.aux, dev_batch)
 
+
+    def fusion_summary(self):
+        """Summary of the most recent block-fusion plan traced in this
+        process (blocks fused by kind, relayouts eliminated, fallback
+        reasons) — None before the first fused compile or when
+        ``fuse_blocks`` is off.  See docs/api/fusion.md."""
+        from ..analysis import fusion as _fusion
+        return _fusion.last_plan_summary() if self._fuse_blocks else None
 
     # ------------------------------------------------------- checkpoints
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):

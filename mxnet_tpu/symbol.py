@@ -148,6 +148,27 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
                 elide_plan = _fused.plan_input_bn_elide(
                     topo, entries, _fused.elide_names())
 
+    # block-granularity fusion pass (analysis.fusion): conv+BN+ReLU /
+    # FC+act chains lowered to single custom-vjp regions with a pinned
+    # layout per boundary.  Runs in train AND eval traces (eval keeps
+    # the global-stats BN semantics inside the region); the per-node
+    # monitor path stays unfused so callbacks see every output, and
+    # seeded partial graphs (pipeline stages) never fuse — a chain can
+    # straddle the stage boundary, whose members are outside this topo.
+    block_plan = None
+    if monitor is None and not device_map and seed_vals is None:
+        from .ops import fused as _fused
+        if _fused.block_fusion_enabled():
+            from .ops.nn import current_image_layout
+            from .analysis import fusion as _fusion
+            block_plan = _fusion.plan_block_fusion(
+                topo, entries, layout=current_image_layout(),
+                is_train=is_train,
+                exclude=(set(fuse_skip) | set(fuse_plan) | stem_plan
+                         | elide_plan))
+            if not block_plan.blocks:
+                block_plan = None
+
     for i, node in enumerate(topo):
         if node.is_variable:
             try:
@@ -196,6 +217,23 @@ def eval_graph(topo, entries, var_values, is_train=False, key=None,
             vals[id(node)] = (_fused.elided_conv_apply(
                 node.attrs, e_ins[0], e_ins[1]),)
             continue
+        if block_plan is not None:
+            if id(node) in block_plan.skip:
+                # interior of a fused block: evaluated at its terminal
+                vals[id(node)] = (None,) * node.num_outputs()
+                continue
+            blk = block_plan.blocks.get(id(node))
+            if blk is not None:
+                from .analysis import fusion as _fusion
+                out, bn_node, bn_aux = _fusion.apply_block(blk, vals,
+                                                           is_train)
+                vals[id(node)] = (out,)
+                if bn_node is not None:
+                    for (src, _), upd in zip(
+                            bn_node.inputs[bn_node.num_args:], bn_aux):
+                        if src.is_variable:
+                            aux_updates[id(src)] = upd
+                continue
         ins = [vals[id(src)][idx] for (src, idx) in node.inputs]
         dev = device_map.get(id(node))
         if dev is not None:

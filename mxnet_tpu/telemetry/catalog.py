@@ -112,6 +112,23 @@ CATALOG = {
     "mxtpu_flight_dumps_total": (COUNTER, ("reason",),
                                  "flight-recorder black-box dumps "
                                  "written (MXNET_TPU_FLIGHT_DIR)"),
+    # ------------------------------- block fusion (analysis.fusion)
+    "mxtpu_fusion_plans_total": (COUNTER, (),
+                                 "block-fusion plans computed (one per "
+                                 "trace with the pass enabled)"),
+    "mxtpu_fusion_blocks_total": (COUNTER, ("kind",),
+                                  "fused blocks emitted by the "
+                                  "block-granularity fusion plan "
+                                  "(kind=conv_bn_act|conv_bn|bn_act|"
+                                  "fc_act)"),
+    "mxtpu_fusion_relayouts_eliminated_total": (
+        COUNTER, (),
+        "region-boundary relayouts eliminated by the fusion layout "
+        "plan (in-block interior edges + same-layout block "
+        "adjacencies)"),
+    "mxtpu_fusion_fallback_total": (COUNTER, ("reason",),
+                                    "candidate chains the fusion pass "
+                                    "left unfused, by reason"),
     # ------------------------------------ cross-rank view (distview)
     "mxtpu_step_segment_seconds": (HISTOGRAM, ("segment",),
                                    "per-step host wall time split into "

@@ -83,3 +83,33 @@ def test_flash_attention_streaming_path(causal):
                                        rtol=2e-4, atol=3e-5)
     finally:
         pk._BLOCK_K = old_bk
+
+
+def test_block_choice_cliff_shapes():
+    """ADVICE r5 perf cliff: a seq length that is not a _BLOCK_K
+    multiple used to collapse straight to 128-wide K blocks (3200 ->
+    25 tiny streams).  _blocks must now pick the largest block_q-
+    multiple divisor of t that still fits the VMEM budget."""
+    from mxnet_tpu.ops.pallas_kernels import _BLOCK_K, _BLOCK_Q, _blocks
+
+    # multiples of _BLOCK_K stream the full panel
+    assert _blocks(2048) == (128, 2048)
+    assert _blocks(4096) == (128, 2048)
+    # short sequences keep the single-panel fast path
+    assert _blocks(512) == (128, 512)
+    # the cliff shapes: largest 128-multiple divisor <= _BLOCK_K
+    assert _blocks(3200) == (128, 640)    # 5 K blocks (was 25)
+    assert _blocks(2304) == (128, 1152)   # 2 K blocks (was 18)
+    assert _blocks(6144) == (128, 2048)   # 3 K blocks (was 48)
+    # 2176 = 128 * 17: no larger divisor exists, 128 is genuinely best
+    assert _blocks(2176) == (128, 128)
+
+    # invariants across every Q-tileable length: the K block always
+    # divides t (the grid is exact), is a block_q multiple (MXU
+    # tileable), and never exceeds the VMEM budget
+    for t in range(128, 8193, 128):
+        bq, bk = _blocks(t)
+        assert bq == min(_BLOCK_Q, t)
+        assert t % bk == 0, t
+        assert bk % bq == 0, t
+        assert bk <= max(_BLOCK_K, bq), t

@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Six stages, all of which must be clean:
+Seven stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -27,6 +27,13 @@ Six stages, all of which must be clean:
    leave an ``mxtpu-run/1`` timeline that ``tools/run_top.py
    --summarize --json`` parses, naming the slow rank the straggler
    with per-rank segment totals.
+7. **fusion gate** — the block-granularity fusion pass
+   (``mxnet_tpu.analysis.fusion``, docs/api/fusion.md) must plan at
+   least one fused block on every zoo net with a fusable pattern
+   (BatchNorm chains or FC+activation tails) with ZERO fallbacks on
+   the reference corpus, and a fused-vs-unfused executor
+   forward+backward on a conv+BN+ReLU micro-net must agree
+   numerically (train and eval BN semantics).
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -62,7 +69,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/6] mxlint: %d finding(s) over %s"
+        say("ci_check[1/7] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -71,7 +78,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/6] registry selfcheck: %d problem(s)"
+        say("ci_check[2/7] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -85,14 +92,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/6] verify model %-22s %s" % (name, status))
+            say("ci_check[3/7] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/6] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/7] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -100,7 +107,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/6] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/7] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -108,10 +115,17 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/6] distview smoke: %d problem(s)"
+        say("ci_check[6/7] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
+            say("  " + p)
+
+        # stage 7: block-fusion gate (zoo plans + numerical parity)
+        problems = fusion_check(say=say)
+        say("ci_check[7/7] fusion gate: %d problem(s)" % len(problems))
+        for p in problems:
+            failures.append("fusion: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -323,6 +337,111 @@ def distview_smoke(repo_root=_ROOT):
         problems.append("2-process dry-run timed out")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def fusion_check(say=None):
+    """Block-fusion gate (docs/api/fusion.md).  Two checks:
+
+    1. the ``analysis.fusion`` pass plans >= 1 fused block with ZERO
+       fallbacks on every zoo net carrying a fusable pattern (a
+       BatchNorm, or an FC feeding a fusable activation) — the zoo is
+       the reference corpus, it must fuse spotlessly;
+    2. a conv+BN+ReLU(+FC+ReLU) micro-net run fused vs unfused through
+       the Executor (forward + backward, then an eval-mode forward)
+       agrees numerically — 0 parity failures.
+
+    Returns a list of problem strings (empty = clean)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.analysis import fusion
+    from mxnet_tpu.ops.fused import block_fusion
+
+    say = say or (lambda s: None)
+    problems = []
+
+    def _has_fusable_pattern(topo):
+        for node in topo:
+            if node.is_variable or node.op is None:
+                continue
+            if node.op.name == "BatchNorm":
+                return True
+            if node.op.name == "Activation" and \
+                    node.attrs.get("act_type", "relu") in \
+                    fusion.FC_FUSABLE_ACTS:
+                src, _idx = node.inputs[0]
+                if not src.is_variable and src.op is not None and \
+                        src.op.name == "FullyConnected":
+                    return True
+        return False
+
+    for name in models._MODELS:
+        net = models.get_model(name, num_classes=10)
+        topo = net._topo()
+        s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
+                                     record=False).summary()
+        say("ci_check[7/7] fusion plan %-22s %d block(s), %d relayout(s)"
+            % (name, s["blocks"], s["relayouts_eliminated"]))
+        if _has_fusable_pattern(topo) and s["blocks"] < 1:
+            problems.append("model %s has fusable chains but the pass "
+                            "planned 0 blocks" % name)
+        if s["fallbacks"]:
+            problems.append("model %s: fusion fallbacks on the "
+                            "reference corpus: %s" % (name,
+                                                      s["fallbacks"]))
+
+    # parity micro-check: fused vs unfused executor, train fwd+bwd + eval
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                             num_filter=4, no_bias=True, name="c0")
+    net = mx.sym.BatchNorm(net, name="bn0", fix_gamma=False)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=8,
+                                name="fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def leg(fuse):
+        with block_fusion(fuse):
+            ex = sym.simple_bind(mx.cpu(), data=(4, 3, 8, 8),
+                                 softmax_label=(4,))
+        rng = np.random.RandomState(5)
+        for n, arr in sorted(ex.arg_dict.items()):
+            if n == "softmax_label":
+                arr[:] = rng.randint(0, 10, arr.shape).astype(np.float32)
+            else:
+                arr[:] = rng.uniform(-0.5, 0.5,
+                                     arr.shape).astype(np.float32)
+        arng = np.random.RandomState(6)
+        for n, arr in sorted(ex.aux_dict.items()):
+            arr[:] = arng.uniform(0.1, 1.0, arr.shape).astype(np.float32)
+        ex.forward(is_train=True)
+        out = np.asarray(ex.outputs[0].asnumpy())
+        ex.backward()
+        grads = {k: v.asnumpy() for k, v in sorted(ex.grad_dict.items())
+                 if v is not None}
+        ex.forward(is_train=False)
+        ev = np.asarray(ex.outputs[0].asnumpy())
+        return out, grads, ev
+
+    o_ref, g_ref, e_ref = leg(False)
+    o_fused, g_fused, e_fused = leg(True)
+    if not np.allclose(o_ref, o_fused, rtol=2e-5, atol=2e-6):
+        problems.append("parity: fused train forward diverges from "
+                        "unfused (max abs %.3g)"
+                        % np.max(np.abs(o_ref - o_fused)))
+    if not np.allclose(e_ref, e_fused, rtol=2e-5, atol=2e-6):
+        problems.append("parity: fused eval forward diverges from "
+                        "unfused (max abs %.3g)"
+                        % np.max(np.abs(e_ref - e_fused)))
+    for k in g_ref:
+        if not np.allclose(g_ref[k], g_fused[k], rtol=2e-4, atol=2e-5):
+            problems.append("parity: gradient %r diverges fused vs "
+                            "unfused (max abs %.3g)"
+                            % (k, np.max(np.abs(g_ref[k] - g_fused[k]))))
     return problems
 
 

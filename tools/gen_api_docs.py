@@ -27,6 +27,7 @@ OUT = os.path.join(os.path.dirname(__file__), "..", "docs", "api")
 MODULES = [
     ("ndarray", "mxnet_tpu.ndarray"),
     ("symbol", "mxnet_tpu.symbol"),
+    ("executor", "mxnet_tpu.executor"),
     ("module", "mxnet_tpu.module"),
     ("model", "mxnet_tpu.model"),
     ("io", "mxnet_tpu.io"),
@@ -55,12 +56,23 @@ HAND_WRITTEN = [
     ("resilience", "resilience.md"),
     ("analysis (static verifier + mxlint)", "analysis.md"),
     ("telemetry (metrics, spans, run reports)", "telemetry.md"),
+    ("fusion (block-granularity fusion + layout planning)", "fusion.md"),
 ]
 
 # cross-links appended to generated pages (page key = module filename
 # stem): the generator owns these files, so hand-edits would be lost —
 # declare the links here instead
 SEE_ALSO = {
+    "executor": ["[fusion](fusion.md) — block-granularity fusion + "
+                 "layout planning: the `block_fusion` flag captured at "
+                 "bind time lowers conv+BN+ReLU / FC+activation chains "
+                 "as single fused regions on forward AND the custom-VJP "
+                 "backward",
+                 "[analysis](analysis.md) — `bind(..., strict=True)` "
+                 "graph verification before any compile",
+                 "[telemetry](telemetry.md) — executor fwd/bwd/fused "
+                 "spans, the per-program memory plan, flight-recorder "
+                 "dumps on dispatch failures"],
     "io": ["[resilience](resilience.md) — bad-record quotas, the "
            "io.prefetch/recordio.read fault seams, retry/backoff",
            "[telemetry](telemetry.md) — prefetch depth/stall gauges, "
@@ -88,9 +100,14 @@ SEE_ALSO = {
                  "(`telemetry.distview`): per-step compute/input/"
                  "collective segments, the pre-collective timestamp "
                  "barrier measuring rank skew, and the launch.py "
-                 "run timeline rendered by `tools/run_top.py`"],
+                 "run timeline rendered by `tools/run_top.py`",
+                 "[fusion](fusion.md) — `ShardedTrainer(fuse_blocks=...)`"
+                 ": block-granularity fusion + layout planning on the "
+                 "fused train step"],
     "symbol": ["[analysis](analysis.md) — `Symbol.verify()`, "
-               "`bind(strict=True)`, the MXG0xx diagnostic catalog"],
+               "`bind(strict=True)`, the MXG0xx diagnostic catalog",
+               "[fusion](fusion.md) — the block-granularity fusion "
+               "pass `eval_graph` lowers matched chains through"],
     "kvstore": ["[telemetry](telemetry.md) — push/pull byte counters "
                 "and the dist_async in-flight gauge"],
     "profiler": ["[telemetry](telemetry.md) — spans feed these Chrome "
