@@ -22,6 +22,13 @@ carries the plan summary (blocks fused, relayouts eliminated) in a
 ``fusion`` block, and ``--dry-run`` additionally times an unfused A/B
 leg with per-leg step-program sizes (top-level jaxpr equations — each
 fused block collapses its chain into ONE custom-vjp call).
+
+The JSON also carries a ``costdb`` roll-up (telemetry.costdb: measured
+per-program wall/MFU + the worst-MFU fused blocks with their roofline
+bound; set ``MXNET_TPU_COSTDB`` to persist the full record set) and a
+``valid`` flag — ``false`` on the tunnel-down watchdog artifact, so
+``tools/bench_diff.py`` and the trajectory plots skip dead runs
+instead of reading their 0 as a 100% regression.
 """
 from __future__ import annotations
 
@@ -56,9 +63,13 @@ def main():
 
     def _watchdog():
         if not init_done.wait(init_timeout):
+            # "valid": false — tools/bench_diff.py and the trajectory
+            # plots must EXCLUDE this run, not read value 0 as a 100%
+            # regression
             print(json.dumps({
                 "metric": metric_name,
                 "value": 0, "unit": "img/s/chip", "vs_baseline": 0,
+                "valid": False,
                 "error": "accelerator backend unreachable after %.0fs "
                          "(tunnel down?)" % init_timeout}), flush=True)
             os._exit(1)
@@ -243,12 +254,21 @@ def _emit(result, fusion=None):
     """Attach the standardized telemetry report (step-time percentiles,
     throughput, compile count, and the HBM block: static memory plans
     per compiled program + peak live memory_stats — the BENCH
-    trajectory fields) plus the block-fusion evidence, and print the
-    one-line JSON artifact."""
+    trajectory fields) plus the block-fusion evidence and the cost-
+    database roll-up (worst-MFU blocks + per-program roofline;
+    MXNET_TPU_COSTDB additionally persists the full record set), and
+    print the one-line JSON artifact."""
     from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import costdb
     rep = telemetry.report()
+    # a completed measurement is a valid trajectory point (the tunnel-
+    # down watchdog path marks its artifact "valid": false instead)
+    result["valid"] = True
     if fusion is not None:
         result["fusion"] = fusion
+    cost = costdb.summary()
+    cost["flushed_to"] = costdb.flush()
+    result["costdb"] = cost
     result["telemetry"] = {
         "steps": rep["steps"],
         "step_time_s": rep["step_time_s"],

@@ -109,7 +109,16 @@ int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
 int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
                   NDArrayHandle *vals, int priority);
 /* per-push update rule, C side in charge (reference contract):
- * mutate `local` in place via MXNDArraySyncCopyFromCPU */
+ * mutate `local` in place via MXNDArraySyncCopyFromCPU.
+ *
+ * Symbol-visibility contract: the python-side trampoline receives the
+ * addresses of this library's MXTPUWrapNDArray / MXNDArrayFree from
+ * MXKVStoreSetUpdater itself, so installing an updater does NOT
+ * require the library's symbols to be globally visible — a host
+ * application may dlopen(libmxtpu.so) with the default RTLD_LOCAL.
+ * Embedders that drive mxnet_tpu.c_api directly (without this entry
+ * point) must either load the library with RTLD_GLOBAL or announce
+ * its path once via mxnet_tpu.c_api.register_library(path). */
 typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
                                 NDArrayHandle local, void *handle);
 int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,

@@ -329,6 +329,7 @@ def apply_block(blk, vals, is_train):
         out, new_mm, new_mv = _fused.fused_block_conv_bn_act(
             conv.attrs, bn.attrs, blk.layout, is_train, blk.act,
             blk.pallas, x, w, b, gamma, beta, mm, mv)
+        _note_block_cost(blk, out, x, w)
         return out, bn, [new_mm, new_mv]
     if blk.kind == "bn_act":
         bn = blk.bn
@@ -337,11 +338,59 @@ def apply_block(blk, vals, is_train):
         out, new_mm, new_mv = _fused.fused_block_bn_act(
             bn.attrs, ch, is_train, blk.act, x, val(bn, 1), val(bn, 2),
             val(bn, 3), val(bn, 4))
+        _note_block_cost(blk, out, x, None)
         return out, bn, [new_mm, new_mv]
     if blk.kind == "fc_act":
         fc = blk.fc
         x, w = val(fc, 0), val(fc, 1)
         b = None if fc.attrs.get("no_bias") else val(fc, 2)
         out = _fused.fused_block_fc_act(fc.attrs, blk.act, x, w, b)
+        _note_block_cost(blk, out, x, w)
         return out, None, None
     raise ValueError("unknown fused block kind %r" % (blk.kind,))
+
+
+def _note_block_cost(blk, out, x, w):
+    """Register the applied block as a pending cost-database signature
+    (telemetry.costdb) with analytic flops/bytes estimates from the
+    trace-time shapes — runs host-side inside the trace, once per
+    compile.  The dispatch that owns this compile binds the signature
+    and attributes measured wall time to it.  Observability: any
+    failure is swallowed, the trace must never pay for it."""
+    try:
+        from ..telemetry import costdb
+        import numpy as _np
+
+        def _nbytes(a):
+            return int(a.size) * _np.dtype(a.dtype).itemsize
+
+        shapes = [tuple(x.shape)] + ([tuple(w.shape)]
+                                     if w is not None else [])
+        dtypes = [str(x.dtype)] + ([str(w.dtype)]
+                                   if w is not None else [])
+        if w is not None:
+            # conv and FC share one formula: every output element costs
+            # (w.size / n_out) MACs — C*R*S for a conv, the input width
+            # for an FC — plus the ~10 flops/element BN/act epilogue.
+            # n_out comes from the op attrs (num_filter / num_hidden),
+            # not from a weight axis, so a native HWIO weight layout
+            # cannot skew the estimate.
+            node = blk.conv if blk.conv is not None else blk.fc
+            n_out = int(node.attrs.get("num_filter")
+                        or node.attrs.get("num_hidden")
+                        or w.shape[0])
+            flops = 2.0 * int(out.size) * int(w.size) / n_out \
+                + 10.0 * int(out.size)
+            bytes_ = _nbytes(x) + _nbytes(w) + _nbytes(out)
+        else:
+            # bn_act: pure elementwise normalize/scale/shift/act
+            flops = 10.0 * int(out.size)
+            bytes_ = _nbytes(x) + _nbytes(out)
+        costdb.note_block(
+            blk.name, blk.kind, shapes, dtypes, flops=flops,
+            bytes_accessed=bytes_, layout=blk.layout,
+            pallas=blk.pallas)
+    except MemoryError:  # pragma: no cover - never mask resource exhaustion
+        raise
+    except Exception:  # mxlint: allow-broad-except(cost-signature capture is observability inside a jit trace; any failure must not fail the compile)
+        pass

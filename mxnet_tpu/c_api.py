@@ -257,7 +257,36 @@ def recordio_close(rec):
     rec.close()
 
 
-def kv_set_updater(kv, fnptr, user_handle):
+# library registered for trampoline symbol resolution when the C side
+# predates the address-passing MXKVStoreSetUpdater protocol (see
+# register_library / kv_set_updater)
+_REGISTERED_LIB = {"path": None}
+
+
+def register_library(path):
+    """Register the path of the loaded ``libmxtpu.so`` so python-side
+    trampolines (``kv_set_updater``) can resolve its symbols via an
+    explicit ``ctypes.PyDLL(path)`` handle instead of the process
+    GLOBAL symbol table — which does not contain the library when the
+    host application dlopen()ed it with the default ``RTLD_LOCAL``.
+    Embedders that cannot pass trampoline addresses should call this
+    once at init (see include/mxnet_tpu/c_api.h)."""
+    _REGISTERED_LIB["path"] = path
+
+
+def _trampoline_lib():
+    """The ctypes handle to resolve MXTPUWrapNDArray/MXNDArrayFree
+    from: the registered library path when one was announced, else the
+    global symbol table (works only under RTLD_GLOBAL / static link —
+    the legacy behavior, kept as the last resort)."""
+    import ctypes
+    path = _REGISTERED_LIB["path"]
+    # PyDLL in both cases: these helpers manipulate Python refcounts,
+    # so the GIL must stay held across the call
+    return ctypes.PyDLL(path) if path else ctypes.PyDLL(None)
+
+
+def kv_set_updater(kv, fnptr, user_handle, wrap_addr=0, free_addr=0):
     """Install a C callback updater (reference MXKVStoreSetUpdater).
 
     ``fnptr`` is the address of a ``void (int key, NDArrayHandle recv,
@@ -265,23 +294,39 @@ def kv_set_updater(kv, fnptr, user_handle):
     with freshly wrapped handles onto the REAL stored arrays, so the
     callback's in-place writes (SyncCopyFromCPU) update the store —
     the reference worker-protocol seam, C side in charge of the rule.
+
+    ``wrap_addr``/``free_addr`` are the addresses of the library's own
+    ``MXTPUWrapNDArray`` / ``MXNDArrayFree`` trampolines, passed by
+    ``src/c_api.cc`` so resolution never depends on global symbol
+    visibility (a host app's plain ``dlopen`` defaults to
+    ``RTLD_LOCAL``, under which ``ctypes.PyDLL(None)`` cannot see this
+    library).  When absent (older C side / direct embedding) the
+    symbols are resolved from the library registered via
+    :func:`register_library`, else from the global table as a last
+    resort — the contract is documented in include/mxnet_tpu/c_api.h.
     """
     import ctypes
 
     UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
                                ctypes.c_void_p, ctypes.c_void_p)
     cb = UPDATER(int(fnptr))
-    # PyDLL: these helpers manipulate Python refcounts, so the GIL must
-    # stay held across the call (the user callback itself goes through
-    # CFUNCTYPE, which releases the GIL; its re-entries into MXNDArray*
-    # entry points re-ensure it)
-    lib = ctypes.PyDLL(None)
-    wrap = lib.MXTPUWrapNDArray
-    wrap.restype = ctypes.c_void_p
-    wrap.argtypes = [ctypes.py_object]
-    free = lib.MXNDArrayFree
-    free.restype = ctypes.c_int
-    free.argtypes = [ctypes.c_void_p]
+    if wrap_addr and free_addr:
+        # PYFUNCTYPE: the GIL stays held across the trampoline (they
+        # manipulate Python refcounts); the user callback itself goes
+        # through CFUNCTYPE above, which releases the GIL, and its
+        # re-entries into MXNDArray* entry points re-ensure it
+        wrap = ctypes.PYFUNCTYPE(ctypes.c_void_p,
+                                 ctypes.py_object)(int(wrap_addr))
+        free = ctypes.PYFUNCTYPE(ctypes.c_int,
+                                 ctypes.c_void_p)(int(free_addr))
+    else:
+        lib = _trampoline_lib()
+        wrap = lib.MXTPUWrapNDArray
+        wrap.restype = ctypes.c_void_p
+        wrap.argtypes = [ctypes.py_object]
+        free = lib.MXNDArrayFree
+        free.restype = ctypes.c_int
+        free.argtypes = [ctypes.c_void_p]
     user = ctypes.c_void_p(int(user_handle))
 
     def _updater(key, recv, local):

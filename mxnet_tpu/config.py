@@ -159,6 +159,25 @@ _CATALOG = {
                                   "device-capacity override for the "
                                   "memory budget check on backends "
                                   "without memory_stats (CPU tests)"),
+    "MXNET_TPU_COSTDB": ("", "honored",
+                         "persist the op/block cost database "
+                         "(telemetry.costdb, schema mxtpu-costdb/1) "
+                         "as JSONL under this directory; "
+                         "tools/perf_top.py ranks it"),
+    "MXNET_TPU_COSTDB_SAMPLE": ("16", "honored",
+                                "measure every Nth post-compile "
+                                "dispatch per program into the cost "
+                                "database (each sample synchronizes "
+                                "the dispatch; 0 disables "
+                                "measurement)"),
+    "MXNET_TPU_PEAK_FLOPS": ("", "honored",
+                             "per-chip peak FLOPs/s override for "
+                             "costdb MFU/roofline derivation "
+                             "(default: built-in per-backend table)"),
+    "MXNET_TPU_PEAK_BW": ("", "honored",
+                          "per-chip peak memory bytes/s override for "
+                          "costdb roofline derivation (default: "
+                          "built-in per-backend table)"),
 }
 
 

@@ -136,6 +136,10 @@ class Executor:
         # comes from the same compile that runs the graph (see
         # telemetry.memory.planned_executable)
         self._aot_exes = {}
+        # costdb dispatch scope: process-unique per executor, so id(fn)
+        # reuse after another instance's GC cannot alias its counters
+        from .telemetry import costdb as _costdb
+        self._costdb_scope = _costdb.next_scope()
         # is_loss flag per head (loss heads seed ones, others zeros, when
         # backward() is called without explicit head gradients)
         self._head_is_loss = tuple(
@@ -331,11 +335,26 @@ class Executor:
         registering/budget-checking its memory plan on first use and
         annotating a backend RESOURCE_EXHAUSTED with the plan + live
         HBM forensics (telemetry.memory.dispatch_planned semantics:
-        aval drift downgrades to the jit wrapper permanently)."""
-        from .telemetry import memory as _tmem
-        with _tmem.annotate_oom(program):
-            return _tmem.dispatch_planned(self._aot_exes, program, fn,
-                                          args)
+        aval drift downgrades to the jit wrapper permanently).
+
+        Cost-database seam (telemetry.costdb): fused blocks traced by
+        the compile bind to this program, and sampled dispatches
+        (MXNET_TPU_COSTDB_SAMPLE) measure a synchronized wall time
+        that lands — with the program's cost_analysis flops/bytes —
+        as persistent MFU/roofline records.  Off the hot path: the
+        unsampled cost is one counter bump."""
+        from .telemetry import costdb as _costdb, memory as _tmem
+        obs = _costdb.begin_dispatch(
+            program, key=(self._costdb_scope, id(fn)))
+        try:
+            with _tmem.annotate_oom(program):
+                out = _tmem.dispatch_planned(self._aot_exes, program,
+                                             fn, args)
+        except BaseException:  # mxlint: allow-broad-except(re-raised unchanged — the handler only closes the costdb observation bind-only, so the compile's traced signatures cannot dangle and attach to the next program dispatched)
+            _costdb.end_dispatch(obs, failed=True)
+            raise
+        _costdb.end_dispatch(obs, out=out, args=args)
+        return out
 
     def forward_backward(self, **kwargs):
         """Fused training step: outputs + gradients in one XLA program.

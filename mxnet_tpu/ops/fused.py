@@ -119,6 +119,20 @@ def matmul_stats(x2d, w2d, c):
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
+        # label the chosen M block in the cost database so the block
+        # choice is queryable by problem shape (telemetry.costdb;
+        # note_kernel never raises into the trace)
+        from ..telemetry import costdb
+        costdb.note_kernel(
+            "matmul_stats", [(m, k), (k, n)],
+            [str(x2d.dtype), str(w2d.dtype)],
+            flops=2.0 * m * n * k,
+            bytes_accessed=float(
+                m * k * x2d.dtype.itemsize
+                + k * n * w2d.dtype.itemsize
+                + m * n * x2d.dtype.itemsize),
+            block_config={"bm": int(bm), "grid_m": int(m // bm)})
+
         y, s1, s2 = pl.pallas_call(
             _stats_kernel,
             grid=(m // bm,),

@@ -189,6 +189,35 @@ def _blocks(t):
     return block_q, block_k
 
 
+def _note_kernel_cost(op, q, block_q, block_k, causal, n_matmuls,
+                      n_tensors):
+    """Label this kernel instantiation's chosen block shapes in the
+    cost database (telemetry.costdb) so block-size cliffs — e.g. the
+    2176-length 17-tiny-K-blocks fallback ADVICE flagged — become
+    queryable by (op, shape).  ``n_tensors``: how many (B, T, H, D)
+    sized tensors the kernel moves (HBM traffic estimate — the
+    backward touches twice the forward's).  Host-side, once per
+    compile; swallowed on failure (observability must not fail the
+    trace)."""
+    try:
+        from ..telemetry import costdb
+        b, t, h, d = q.shape
+        flops = float(n_matmuls) * b * h * t * t * d
+        itemsize = jnp.dtype(q.dtype).itemsize
+        bytes_ = float(n_tensors) * b * t * h * d * itemsize
+        costdb.note_kernel(
+            op, [tuple(q.shape)], [str(q.dtype)], flops=flops,
+            bytes_accessed=bytes_,
+            block_config={"block_q": int(block_q),
+                          "block_k": int(block_k),
+                          "n_k": int(t // block_k),
+                          "causal": bool(causal)})
+    except MemoryError:  # pragma: no cover - never mask resource exhaustion
+        raise
+    except Exception:  # mxlint: allow-broad-except(kernel labeling is observability inside a jit trace; any failure must not fail the compile)
+        pass
+
+
 def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
     """q/k/v: (B, T, H, D) -> (o (B, T, H, D), lse (BH, T, 1) f32)."""
     from jax.experimental import pallas as pl
@@ -198,6 +227,10 @@ def _flash_attention_fwd_pallas(q, k, v, causal, interpret):
     scale = 1.0 / math.sqrt(d)
     block_q, block_k = _blocks(t)
     assert t % block_q == 0, "seq length must be a multiple of the Q block"
+    # 2 matmuls (QK^T, PV) at 2*t*t*d MACs->flops each; traffic:
+    # q, k, v read + o written (lse is negligible)
+    _note_kernel_cost("flash_attention_fwd", q, block_q, block_k,
+                      causal, n_matmuls=4, n_tensors=4)
 
     if t // block_k == 1:
         # T fits one VMEM panel: single-panel kernel (measured fastest
@@ -377,6 +410,11 @@ def _flash_attention_bwd_pallas(q, k, v, o, lse, g, causal, interpret):
     b, t, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
     block_q, block_k = _blocks(t)
+    # 5 matmuls (dV, dP, dQ, dK, S recompute) at 2*t*t*d each;
+    # traffic: q, k, v, o, dO read + dq, dk, dv written (lse/delta
+    # rows are negligible)
+    _note_kernel_cost("flash_attention_bwd", q, block_q, block_k,
+                      causal, n_matmuls=10, n_tensors=8)
 
     qt, kt, vt = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     dot = _fold_heads(g)

@@ -511,6 +511,12 @@ class ShardedTrainer:
         # compile() and jit calls, so a separate analysis compile would
         # double every compile)
         self._aot_exes = {}
+        # costdb dispatch scope: process-unique and rotated on rebuild,
+        # so a rebuilt fn reusing a collected fn's id cannot alias its
+        # dispatch counters (a compile dispatch mistaken for post-warm
+        # would get its compile timed as dispatch wall)
+        from ..telemetry import costdb as _costdb
+        self._costdb_scope = _costdb.next_scope()
         self._fwd_fn = None
         self._step_count = 0
         # current step's straggler-attribution accumulator (reset by
@@ -1250,6 +1256,11 @@ class ShardedTrainer:
         self._step_fn = self._build_step()
         self._scan_fns = {}
         self._aot_exes = {}
+        # retire the old costdb dispatch scope (see __init__): the new
+        # fns must warm up as compiles, and the old counters are pruned
+        from ..telemetry import costdb as _costdb
+        _costdb.drop_scope(self._costdb_scope)
+        self._costdb_scope = _costdb.next_scope()
         self._hyper_snapshot = self._hyper_state()
 
     def _cast_batch(self, batch):
@@ -1430,16 +1441,53 @@ class ShardedTrainer:
         except (StopIteration, AttributeError, IndexError, TypeError):
             return 0
 
-    def _dispatch_planned(self, program, fn, args):
+    def _dispatch_planned(self, program, fn, args, steps=1):
         """Dispatch through the AOT executable with the memory plan
         registered + budget-checked on first use
         (telemetry.memory.dispatch_planned).  Process-spanning meshes
         keep the plain jit dispatch (AOT example staging is a
-        per-process choice)."""
+        per-process choice) and skip the costdb sampling — a sampled
+        ``block_until_ready`` on one rank would skew the fleet.
+
+        Cost-database seam (telemetry.costdb): the fused blocks this
+        program's compile traced bind to it, and sampled dispatches
+        record synchronized wall time + flops/bytes + mesh shape as
+        persistent MFU/roofline records (:meth:`cost_summary`).
+        ``steps``: inner train steps one dispatch executes
+        (``run_steps`` passes its chain length so the per-step wall
+        meets the signatures' per-step flops)."""
+        from ..telemetry import costdb as _costdb, memory as _tmem
         if self._multiproc:
-            return fn(*args)
-        from ..telemetry import memory as _tmem
-        return _tmem.dispatch_planned(self._aot_exes, program, fn, args)
+            # bind-only: the compile's traced block signatures must not
+            # dangle (they would attach to the next single-proc program
+            # dispatched in this process); timing stays off — a sampled
+            # block_until_ready on one rank would skew the fleet
+            try:
+                return fn(*args)
+            finally:
+                _costdb.bind_pending(
+                    program, key=(self._costdb_scope, id(fn)))
+        obs = _costdb.begin_dispatch(
+            program, key=(self._costdb_scope, id(fn)))
+        try:
+            out = _tmem.dispatch_planned(self._aot_exes, program, fn,
+                                         args)
+        except BaseException:  # mxlint: allow-broad-except(re-raised unchanged — the handler only closes the costdb observation bind-only, so the compile's traced signatures cannot dangle and attach to the next program dispatched)
+            _costdb.end_dispatch(obs, failed=True)
+            raise
+        _costdb.end_dispatch(obs, out=out, args=args,
+                             mesh=self._mesh_axis_sizes(), steps=steps)
+        return out
+
+    def _mesh_axis_sizes(self):
+        """{axis name: size} of the trainer's mesh — part of every
+        costdb record key (the same block costs differently on a
+        different mesh)."""
+        try:
+            return {str(k): int(v)
+                    for k, v in dict(self.mesh.shape).items()}
+        except (AttributeError, TypeError, ValueError):
+            return None
 
     def _stage_timed(self, batch):
         """Stage a host batch, charging the wall time to the step's
@@ -1555,7 +1603,8 @@ class ShardedTrainer:
                 jnp.asarray(_np.asarray(ts, _np.float32)))
         self._measure_collective_entry("trainer.run_steps")
         self.params, self.opt_state, self.aux, losses = \
-            self._dispatch_planned("trainer.run_steps", fn, args)
+            self._dispatch_planned("trainer.run_steps", fn, args,
+                                   steps=num_steps)
         return losses
 
     def forward(self, batch, is_train=False):
@@ -1610,6 +1659,17 @@ class ShardedTrainer:
         ``fuse_blocks`` is off.  See docs/api/fusion.md."""
         from ..analysis import fusion as _fusion
         return _fusion.last_plan_summary() if self._fuse_blocks else None
+
+    def cost_summary(self, top=5):
+        """Roll-up of the process cost database
+        (:mod:`mxnet_tpu.telemetry.costdb`): record counts, measured
+        per-program wall/MFU, and the ``top`` worst-MFU fused blocks
+        with their roofline bound — the autotuner targeting signal.
+        Sampled collection runs through this trainer's dispatches
+        (``MXNET_TPU_COSTDB_SAMPLE``); ``MXNET_TPU_COSTDB`` persists
+        the records across runs.  See docs/api/telemetry.md."""
+        from ..telemetry import costdb as _costdb
+        return _costdb.summary(top=top)
 
     # ------------------------------------------------------- checkpoints
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):

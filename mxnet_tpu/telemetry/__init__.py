@@ -31,7 +31,12 @@ Three engines:
 * **flight recorder** (:mod:`.flight`) — a bounded ring of recent
   structured events dumped to a JSON black box
   (``MXNET_TPU_FLIGHT_DIR``) on MXNetError/OOM/SIGTERM/crash;
-  ``tools/flight_read.py`` pretty-prints a dump.
+  ``tools/flight_read.py`` pretty-prints a dump;
+* **cost database** (:mod:`.costdb`) — persistent op/block cost
+  records (``MXNET_TPU_COSTDB``, schema ``mxtpu-costdb/1``) joining
+  measured wall time, flops/bytes, and fused-block identity into
+  MFU/roofline attribution; ``tools/perf_top.py`` ranks the worst
+  blocks, ``tools/bench_diff.py`` guards the BENCH trajectory.
 
 Compile events come from ``jax.monitoring`` listeners where available
 (:mod:`.compile`), else a first-call-vs-steady-state heuristic.
@@ -50,6 +55,7 @@ from .spans import span, drain_step_spans, step_span_totals
 from . import flight
 from . import memory
 from . import distview
+from . import costdb
 from .exporters import (step_end, render_prom, report, start_http_server,
                         jsonl_path, env_port, reset, reset_steps)
 from . import compile as compile_events
@@ -62,7 +68,7 @@ __all__ = [
     "span", "drain_step_spans", "step_span_totals",
     "step_end", "render_prom", "report", "start_http_server",
     "jsonl_path", "env_port", "reset", "reset_steps", "compile_events",
-    "flight", "memory", "distview",
+    "flight", "memory", "distview", "costdb",
 ]
 
 # best-effort process-wide init: compile listener (jax.monitoring) and

@@ -129,6 +129,15 @@ CATALOG = {
     "mxtpu_fusion_fallback_total": (COUNTER, ("reason",),
                                     "candidate chains the fusion pass "
                                     "left unfused, by reason"),
+    # --------------------------------------- cost database (costdb)
+    "mxtpu_block_mfu": (GAUGE, ("block",),
+                        "latest derived model-FLOPs-utilization per "
+                        "fused block / Pallas kernel (costdb roofline "
+                        "attribution)"),
+    "mxtpu_costdb_records_total": (COUNTER, ("kind",),
+                                   "aggregate records created in the "
+                                   "op/block cost database "
+                                   "(kind=program|block|kernel)"),
     # ------------------------------------ cross-rank view (distview)
     "mxtpu_step_segment_seconds": (HISTOGRAM, ("segment",),
                                    "per-step host wall time split into "

@@ -417,6 +417,8 @@ def reset():
     drain_step_spans()
     flight.clear()
     memory_mod.clear_plans()
+    from . import costdb as costdb_mod
+    costdb_mod.reset()
     with _lock:
         _step_durs.clear()
         _last_counters.clear()

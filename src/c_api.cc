@@ -53,10 +53,21 @@ inline Scratch &TlsScratch() {
   return s;
 }
 
-// cached op-name list; creator == index+1 (0 stays invalid)
+// cached op-name list; creator == index+1 (0 stays invalid).  Filled
+// exactly once (see FillOpNames): the GIL alone is NOT a critical
+// section here, because the CallDriver that produces the list runs
+// Python code that can release the GIL mid-call — two threads in
+// MXSymbolListAtomicSymbolCreators could interleave and double-append,
+// corrupting the creator-index mapping.  Once non-empty the vector is
+// immutable.
 std::vector<std::string> &OpNames() {
-  static std::vector<std::string> names;  // filled under the GIL once
+  static std::vector<std::string> names;
   return names;
+}
+
+std::mutex &OpNamesMutex() {
+  static std::mutex m;
+  return m;
 }
 
 PyObject *Driver() {  // borrowed module ref (cached by CPython)
@@ -70,6 +81,29 @@ PyObject *CallDriver(const char *fn, PyObject *args) {
   Ref f(PyObject_GetAttrString(mod.p, fn));
   if (!f) return nullptr;
   return PyObject_CallObject(f.p, args);
+}
+
+// fill OpNames() from the driver if still empty; returns false with
+// g_last_error set on driver failure.  The list is built in a LOCAL
+// vector (no lock held across CallDriver — holding a lock while the
+// GIL can be released and re-taken by a waiter deadlocks) and swapped
+// in under the mutex only if no other thread won the race.
+bool FillOpNames() {
+  {
+    std::lock_guard<std::mutex> lock(OpNamesMutex());
+    if (!OpNames().empty()) return true;
+  }
+  Ref args(PyTuple_New(0));
+  Ref lst(CallDriver("op_names", args.p));
+  if (!lst) { SetPyError(); return false; }
+  std::vector<std::string> local;
+  const Py_ssize_t n = PyList_Size(lst.p);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    local.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(lst.p, i)));
+  }
+  std::lock_guard<std::mutex> lock(OpNamesMutex());
+  if (OpNames().empty()) OpNames().swap(local);
+  return true;
 }
 
 PyObject *StrList(const char **strs, mx_uint n) {
@@ -219,9 +253,17 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
     if (!dt) { SetPyError(); return -1; }
     code = DTypeCode(PyUnicode_AsUTF8(dt.p));
   }
+  // reference contract (c_api.cc CHECK_EQ): the caller-declared size
+  // must match the array EXACTLY.  Rejecting only the too-small side
+  // would silently short-copy when the caller over-declares, leaving
+  // the buffer tail untouched and the binding bug unnoticed.
   const size_t want = size * DTypeBytes(code);
-  if (static_cast<size_t>(n) > want) {
-    g_last_error = "destination buffer too small";
+  if (static_cast<size_t>(n) != want) {
+    g_last_error =
+        "MXNDArraySyncCopyToCPU: size mismatch (array is " +
+        std::to_string(static_cast<size_t>(n)) + " bytes, caller declared " +
+        std::to_string(size) + " elements = " + std::to_string(want) +
+        " bytes); size must equal the array's element count";
     return -1;
   }
   std::memcpy(data, buf, static_cast<size_t>(n));
@@ -384,16 +426,7 @@ int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
                                      AtomicSymbolCreator **out_array) {
   API_GUARD();
   Gil gil;
-  if (OpNames().empty()) {
-    Ref args(PyTuple_New(0));
-    Ref lst(CallDriver("op_names", args.p));
-    if (!lst) { SetPyError(); return -1; }
-    const Py_ssize_t n = PyList_Size(lst.p);
-    for (Py_ssize_t i = 0; i < n; ++i) {
-      OpNames().emplace_back(
-          PyUnicode_AsUTF8(PyList_GET_ITEM(lst.p, i)));
-    }
-  }
+  if (!FillOpNames()) return -1;
   Scratch &sc = TlsScratch();
   sc.creators.clear();
   for (size_t i = 0; i < OpNames().size(); ++i) {
@@ -731,12 +764,21 @@ int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
   }
   Gil gil;
   auto h = static_cast<Handle *>(handle);
+  // pass the trampoline addresses (MXTPUWrapNDArray / MXNDArrayFree)
+  // explicitly: the python side must not resolve them through the
+  // GLOBAL symbol table (ctypes.PyDLL(None)), which is empty for this
+  // library when the host application dlopen()ed it with the default
+  // RTLD_LOCAL — the plausible way to consume a C ABI (ADVICE).
   Ref args(Py_BuildValue(
-      "(OKK)", h->obj,
+      "(OKKKK)", h->obj,
       static_cast<unsigned long long>(
           reinterpret_cast<uintptr_t>(updater)),
       static_cast<unsigned long long>(
-          reinterpret_cast<uintptr_t>(updater_handle))));
+          reinterpret_cast<uintptr_t>(updater_handle)),
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(&MXTPUWrapNDArray)),
+      static_cast<unsigned long long>(
+          reinterpret_cast<uintptr_t>(&MXNDArrayFree))));
   if (!args) { SetPyError(); return -1; }
   Ref r(CallDriver("kv_set_updater", args.p));
   if (!r) { SetPyError(); return -1; }

@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Seven stages, all of which must be clean:
+Eight stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -34,6 +34,14 @@ Seven stages, all of which must be clean:
    the reference corpus, and a fused-vs-unfused executor
    forward+backward on a conv+BN+ReLU micro-net must agree
    numerically (train and eval BN semantics).
+8. **perf ground truth** — a ``bench.py --dry-run`` under
+   ``MXNET_TPU_COSTDB`` must leave a parseable ``mxtpu-costdb/1``
+   database with a measured record (non-null wall/flops/MFU) for the
+   step program AND for every dispatched fused block;
+   ``tools/perf_top.py --json`` must parse it and name the worst-MFU
+   block; ``tools/bench_diff.py`` over the committed BENCH_r* series
+   must exit 0 (tunnel-down runs skipped) and must exit nonzero on a
+   synthetic 20%% regression appended to the series.
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -69,7 +77,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/7] mxlint: %d finding(s) over %s"
+        say("ci_check[1/8] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -78,7 +86,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/7] registry selfcheck: %d problem(s)"
+        say("ci_check[2/8] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -92,14 +100,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/7] verify model %-22s %s" % (name, status))
+            say("ci_check[3/8] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/7] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/8] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -107,7 +115,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/7] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/8] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -115,7 +123,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/7] distview smoke: %d problem(s)"
+        say("ci_check[6/8] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -123,9 +131,17 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/7] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/8] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
+            say("  " + p)
+
+        # stage 8: perf ground truth (costdb + perf_top + bench_diff)
+        problems = costdb_check(repo_root)
+        say("ci_check[8/8] perf ground truth: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("costdb: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -382,7 +398,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/7] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/8] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -442,6 +458,171 @@ def fusion_check(say=None):
             problems.append("parity: gradient %r diverges fused vs "
                             "unfused (max abs %.3g)"
                             % (k, np.max(np.abs(g_ref[k] - g_fused[k]))))
+    return problems
+
+
+def costdb_check(repo_root=_ROOT):
+    """Perf-ground-truth gate.  Three checks:
+
+    1. ``bench.py --dry-run`` under ``MXNET_TPU_COSTDB`` leaves a
+       parseable ``mxtpu-costdb/1`` database with a measured record
+       (non-null wall/flops/MFU) for the step program and one per
+       dispatched fused block (the dry-run MLP fuses its fc_act
+       chains), and the BENCH JSON embeds the roll-up + ``valid``;
+    2. ``tools/perf_top.py --json`` parses the database and names the
+       worst-MFU block;
+    3. ``tools/bench_diff.py`` over the committed ``BENCH_r*.json``
+       series exits 0 (errored/tunnel-down rounds are skipped, not
+       read as regressions) and exits NONZERO when a synthetic 20%
+       regression is appended — the trajectory guard actually guards.
+
+    Returns a list of problem strings (empty = clean)."""
+    import glob as glob_mod
+    import importlib.util
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_costdb_check_")
+    dbdir = os.path.join(tmpdir, "costdb")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "MXNET_TPU_COSTDB": dbdir,
+                # deterministic: measure every post-compile dispatch
+                "MXNET_TPU_COSTDB_SAMPLE": "1"})
+    env.pop("MXNET_TPU_TELEMETRY_JSONL", None)
+    # TPU-tunnel site plugins (axon) must not hijack the CPU dry-run
+    if "PYTHONPATH" in env:
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if "axon" not in p]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            env.pop("PYTHONPATH")
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo_root, "bench.py"),
+             "--dry-run"],
+            capture_output=True, text=True, timeout=300,
+            cwd=repo_root, env=env)
+        if res.returncode != 0:
+            problems.append("bench.py --dry-run failed (%d): %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-800:]))
+            return problems
+        try:
+            bench = json.loads(res.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError) as e:
+            problems.append("bench.py --dry-run printed no parseable "
+                            "JSON line: %s" % e)
+            return problems
+        if bench.get("valid") is not True:
+            problems.append("completed dry-run not marked valid=true")
+        roll = bench.get("costdb") or {}
+        if roll.get("schema") != "mxtpu-costdb/1":
+            problems.append("BENCH JSON costdb roll-up schema %r != "
+                            "'mxtpu-costdb/1'" % roll.get("schema"))
+        n_fused = ((bench.get("fusion") or {}).get("summary")
+                   or {}).get("blocks", 0)
+
+        from mxnet_tpu.telemetry import costdb as costdb_mod
+        try:
+            records, skipped = costdb_mod.read_records(dbdir,
+                                                       strict=True)
+        except ValueError as e:
+            problems.append("costdb reader rejects the dry-run "
+                            "database: %s" % e)
+            return problems
+        measured = lambda r: (r.get("wall_s") is not None
+                              and r.get("flops") is not None
+                              and r.get("mfu") is not None)
+        progs = [r for r in records if r["kind"] == "program"
+                 and measured(r)]
+        if not progs:
+            problems.append("no measured program record (wall+flops+"
+                            "MFU) in the dry-run costdb")
+        blocks = [r for r in records if r["kind"] == "block"
+                  and measured(r)]
+        if n_fused and len({b["name"] for b in blocks}) < n_fused:
+            problems.append(
+                "dry-run fused %d block(s) but only %d have measured "
+                "costdb records (%s)"
+                % (n_fused, len({b["name"] for b in blocks}),
+                   sorted({b["name"] for b in blocks})))
+
+        # perf_top must parse the database and name the worst block
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "perf_top.py"),
+             dbdir, "--json"],
+            capture_output=True, text=True, timeout=60, cwd=repo_root)
+        if res.returncode != 0:
+            problems.append("perf_top --json failed (%d): %s"
+                            % (res.returncode, res.stderr[-400:]))
+        else:
+            try:
+                top = json.loads(res.stdout)
+            except ValueError as e:
+                problems.append("perf_top --json not parseable: %s" % e)
+                top = {}
+            if top and not (top.get("worst") or {}).get("name"):
+                problems.append("perf_top names no worst-MFU block "
+                                "(got %r)" % top.get("worst"))
+
+        # bench_diff over the committed series must pass...
+        series = sorted(glob_mod.glob(
+            os.path.join(repo_root, "BENCH_r*.json")))
+        if len(series) < 2:
+            problems.append("fewer than 2 committed BENCH_r*.json "
+                            "artifacts to diff")
+            return problems
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "bench_diff.py")]
+            + series, capture_output=True, text=True, timeout=60,
+            cwd=repo_root)
+        if res.returncode != 0:
+            problems.append("bench_diff over the committed series "
+                            "exited %d: %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-400:]))
+        # ...and a synthetic 20% regression must trip it.  The
+        # baseline uses bench_diff's own run-validity rules (one
+        # definition of "valid run", not a drifting copy).
+        reg_dir = os.path.join(tmpdir, "series")
+        os.makedirs(reg_dir)
+        copies = [shutil.copy(p, reg_dir) for p in series]
+        spec = importlib.util.spec_from_file_location(
+            "bench_diff", os.path.join(repo_root, "tools",
+                                       "bench_diff.py"))
+        bench_diff = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench_diff)
+        valid_runs = [r for r in map(bench_diff.load_run, series)
+                      if r["valid"]]
+        best = max((r["value"] for r in valid_runs), default=0.0)
+        # the synthetic run inherits the series' own metric name —
+        # renaming bench.py's metric must not false-fail this stage
+        synth = {"rc": 0, "parsed": {
+            "metric": valid_runs[0]["metric"] if valid_runs else "m",
+            "value": round(best * 0.8, 2), "unit": "img/s/chip"}}
+        synth_path = os.path.join(reg_dir, "BENCH_zz_synthetic.json")
+        with open(synth_path, "w") as f:
+            json.dump(synth, f)
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "bench_diff.py")]
+            + copies + [synth_path],
+            capture_output=True, text=True, timeout=60, cwd=repo_root)
+        if res.returncode == 0:
+            problems.append("bench_diff did NOT flag a synthetic 20%% "
+                            "regression (output: %s)"
+                            % res.stdout[-300:])
+    except subprocess.TimeoutExpired:
+        problems.append("costdb dry-run timed out")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 
 

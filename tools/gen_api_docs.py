@@ -72,7 +72,10 @@ SEE_ALSO = {
                  "graph verification before any compile",
                  "[telemetry](telemetry.md) — executor fwd/bwd/fused "
                  "spans, the per-program memory plan, flight-recorder "
-                 "dumps on dispatch failures"],
+                 "dumps on dispatch failures, and the cost database "
+                 "(`telemetry.costdb`): sampled dispatch timing joined "
+                 "with flops/bytes into persistent MFU/roofline "
+                 "records ranked by `tools/perf_top.py`"],
     "io": ["[resilience](resilience.md) — bad-record quotas, the "
            "io.prefetch/recordio.read fault seams, retry/backoff",
            "[telemetry](telemetry.md) — prefetch depth/stall gauges, "
@@ -100,7 +103,10 @@ SEE_ALSO = {
                  "(`telemetry.distview`): per-step compute/input/"
                  "collective segments, the pre-collective timestamp "
                  "barrier measuring rank skew, and the launch.py "
-                 "run timeline rendered by `tools/run_top.py`",
+                 "run timeline rendered by `tools/run_top.py`; "
+                 "`ShardedTrainer.cost_summary()` surfaces the cost "
+                 "database's per-program wall/MFU roll-up "
+                 "(`telemetry.costdb`)",
                  "[fusion](fusion.md) — `ShardedTrainer(fuse_blocks=...)`"
                  ": block-granularity fusion + layout planning on the "
                  "fused train step"],

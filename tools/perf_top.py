@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""perf_top — rank the cost database's worst-MFU ops and blocks.
+
+The targeting input for the autotuner (ROADMAP item 2): reads the
+persistent ``mxtpu-costdb/1`` records a run left under
+``MXNET_TPU_COSTDB`` (telemetry.costdb; ``bench.py`` and any
+Executor/ShardedTrainer run with sampling enabled write them) and
+prints the fused blocks / Pallas kernels / programs ranked worst-MFU
+first, each with its roofline bound (compute vs bandwidth), arithmetic
+intensity, attained-roofline fraction, and — for Pallas entries — the
+chosen block configuration, so a block-size cliff (e.g. the 2176-seq
+17-tiny-K-blocks fallback) is visible next to the MFU it costs.
+
+Stdlib-only.  Usage::
+
+    python tools/perf_top.py [PATH] [--top N] [--kind block|kernel|program]
+                             [--min-count N] [--json] [--strict]
+
+``PATH`` defaults to ``$MXNET_TPU_COSTDB``.  ``--json`` emits one
+machine-readable document (schema ``mxtpu-perftop/1``) whose ``worst``
+entry names the single worst-MFU block — what ci_check stage 8 parses.
+Exit codes: 0 ok, 2 no readable records.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def load(path, strict=False):
+    """Records from a costdb file/directory, via the canonical reader
+    (schema-validated; bad lines skipped unless ``strict``)."""
+    from mxnet_tpu.telemetry import costdb
+    return costdb.read_records(path, strict=strict)
+
+
+def rank(records, kind=None, min_count=0):
+    """Measured records (non-null mfu), worst MFU first.  ``kind``
+    filters (None = blocks+kernels+programs all eligible);
+    ``min_count`` drops records observed fewer times (noise guard)."""
+    out = [r for r in records
+           if r.get("mfu") is not None
+           and (kind is None or r.get("kind") == kind)
+           and (r.get("count") or 0) >= min_count]
+    out.sort(key=lambda r: (r["mfu"], r.get("name", "")))
+    return out
+
+
+def _fmt_cfg(cfg):
+    if not cfg:
+        return "-"
+    return ",".join("%s=%s" % (k, v) for k, v in sorted(cfg.items()))
+
+
+def _fmt_num(x, unit=""):
+    if x is None:
+        return "-"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                          (1e3, "k")):
+        if abs(x) >= scale:
+            return "%.2f%s%s" % (x / scale, suffix, unit)
+    return "%.3g%s" % (x, unit)
+
+
+def render(ranked, top):
+    """Human table, worst first."""
+    lines = ["%-28s %-8s %-12s %6s  %-9s %8s %8s %9s  %s"
+             % ("name", "kind", "block_kind", "mfu%", "bound",
+                "ai", "flops", "wall", "block config")]
+    for r in ranked[:top]:
+        lines.append(
+            "%-28s %-8s %-12s %6.2f  %-9s %8s %8s %9s  %s"
+            % (r["name"][:28], r["kind"],
+               str(r.get("block_kind") or "-")[:12],
+               100.0 * r["mfu"], r.get("bound") or "-",
+               _fmt_num(r.get("ai")), _fmt_num(r.get("flops")),
+               _fmt_num(r.get("wall_s"), "s"),
+               _fmt_cfg(r.get("block_config"))))
+    return "\n".join(lines)
+
+
+def _doc(ranked, records, skipped, top):
+    """The --json document: worst-first entries + the headline worst
+    block (fusion blocks that underperform their roofline are exactly
+    the entries with attained_frac < 1, worst MFU first)."""
+    worst_block = next((r for r in ranked
+                        if r.get("kind") in ("block", "kernel")), None)
+    return {
+        "schema": "mxtpu-perftop/1",
+        "records": len(records),
+        "measured": len(ranked),
+        "skipped": skipped,
+        "worst": None if worst_block is None else {
+            "name": worst_block["name"],
+            "kind": worst_block["kind"],
+            "block_kind": worst_block.get("block_kind"),
+            "mfu": worst_block["mfu"],
+            "bound": worst_block.get("bound"),
+            "attained_frac": worst_block.get("attained_frac"),
+            "block_config": worst_block.get("block_config"),
+            "program": worst_block.get("program"),
+        },
+        "entries": ranked[:top],
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="perf_top",
+        description="rank costdb records, worst MFU first")
+    ap.add_argument("path", nargs="?",
+                    default=os.environ.get("MXNET_TPU_COSTDB"),
+                    help="costdb-*.jsonl file or directory "
+                         "(default: $MXNET_TPU_COSTDB)")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--kind", choices=("block", "kernel", "program"),
+                    default=None,
+                    help="restrict to one record kind (default: all)")
+    ap.add_argument("--min-count", type=int, default=0,
+                    help="drop records measured fewer than N times")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on any malformed record")
+    args = ap.parse_args(argv)
+
+    if not args.path:
+        print("perf_top: no PATH and MXNET_TPU_COSTDB is unset",
+              file=sys.stderr)
+        return 2
+    if not os.path.exists(args.path):
+        print("perf_top: %r does not exist" % args.path,
+              file=sys.stderr)
+        return 2
+    try:
+        records, skipped = load(args.path, strict=args.strict)
+    except ValueError as e:
+        print("perf_top: %s" % e, file=sys.stderr)
+        return 2
+    if not records:
+        print("perf_top: no costdb records under %r" % args.path,
+              file=sys.stderr)
+        return 2
+    ranked = rank(records, kind=args.kind, min_count=args.min_count)
+    if args.as_json:
+        print(json.dumps(_doc(ranked, records, skipped, args.top),
+                         sort_keys=True))
+        return 0
+    print("costdb: %d record(s), %d measured%s"
+          % (len(records), len(ranked),
+             ", %d malformed line(s) skipped" % skipped if skipped
+             else ""))
+    if ranked:
+        print(render(ranked, args.top))
+        worst = ranked[0]
+        print("\nworst MFU: %s (%s%s) at %.2f%% — %s-bound"
+              % (worst["name"], worst["kind"],
+                 "/" + worst["block_kind"] if worst.get("block_kind")
+                 else "",
+                 100.0 * worst["mfu"], worst.get("bound") or "un"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
