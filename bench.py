@@ -25,7 +25,10 @@ fused block collapses its chain into ONE custom-vjp call).
 
 The JSON also carries a ``costdb`` roll-up (telemetry.costdb: measured
 per-program wall/MFU + the worst-MFU fused blocks with their roofline
-bound; set ``MXNET_TPU_COSTDB`` to persist the full record set) and a
+bound; set ``MXNET_TPU_COSTDB`` to persist the full record set), an
+``autotune`` block (tuning-cache mode + hit/miss counts + the tuned
+block configs actually dispatched, so a trajectory win is attributable
+to tuning — ``MXNET_TPU_TUNE_CACHE`` arms the cache) and a
 ``valid`` flag — ``false`` on the tunnel-down watchdog artifact, so
 ``tools/bench_diff.py`` and the trajectory plots skip dead runs
 instead of reading their 0 as a 100% regression.
@@ -258,7 +261,7 @@ def _emit(result, fusion=None):
     database roll-up (worst-MFU blocks + per-program roofline;
     MXNET_TPU_COSTDB additionally persists the full record set), and
     print the one-line JSON artifact."""
-    from mxnet_tpu import telemetry
+    from mxnet_tpu import autotune, telemetry
     from mxnet_tpu.telemetry import costdb
     rep = telemetry.report()
     # a completed measurement is a valid trajectory point (the tunnel-
@@ -269,6 +272,10 @@ def _emit(result, fusion=None):
     cost = costdb.summary()
     cost["flushed_to"] = costdb.flush()
     result["costdb"] = cost
+    # tuning-cache attribution: hit/miss counts plus the identity of
+    # every tuned config this run dispatched with, so bench_diff
+    # trajectories can attribute a win to tuning (not just see it)
+    result["autotune"] = autotune.summary()
     result["telemetry"] = {
         "steps": rep["steps"],
         "step_time_s": rep["step_time_s"],

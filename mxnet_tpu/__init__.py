@@ -77,6 +77,7 @@ from . import visualization
 from . import visualization as viz
 from . import test_utils
 from . import analysis
+from . import autotune
 from . import contrib
 from . import config
 from . import predictor

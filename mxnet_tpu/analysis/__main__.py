@@ -55,6 +55,14 @@ def main(argv=None):
                          "(default: mxnet_tpu/ tools/ examples/)")
     ap.add_argument("--strict-warnings", action="store_true",
                     help="exit 1 on warnings too")
+    ap.add_argument("--cost-model", default=None, metavar="PATH",
+                    help="fitted mxnet_tpu.autotune cost model "
+                         "(mxtpu-costmodel/1 JSON); enables MXG010 "
+                         "predicted-slow node detection")
+    ap.add_argument("--slow-factor", type=float, default=3.0,
+                    help="MXG010 threshold: flag nodes predicted "
+                         "slower than this multiple of their "
+                         "roofline-attainable time (default 3.0)")
     args = ap.parse_args(argv)
 
     if not (args.json or args.model or args.registry
@@ -80,7 +88,9 @@ def main(argv=None):
         models = list(_zoo._MODELS)
     for name in models:
         _net, report = verify_model(name, batch=args.batch,
-                                    tp_size=args.tp)
+                                    tp_size=args.tp,
+                                    cost_model=args.cost_model,
+                                    slow_factor=args.slow_factor)
         print("model %-20s %s" % (name, report))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)
@@ -94,7 +104,9 @@ def main(argv=None):
             shapes["softmax_label"] = (_parse_shape(args.label)
                                        if args.label
                                        else (shapes["data"][0],))
-        report = verify_json(js, shapes=shapes or None, tp_size=args.tp)
+        report = verify_json(js, shapes=shapes or None, tp_size=args.tp,
+                             cost_model=args.cost_model,
+                             slow_factor=args.slow_factor)
         print("%s: %s" % (path, report))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)

@@ -326,10 +326,14 @@ def apply_block(blk, vals, is_train):
         b = None if conv.attrs.get("no_bias") else val(conv, 2)
         gamma, beta = val(bn, 1), val(bn, 2)
         mm, mv = val(bn, 3), val(bn, 4)
+        pallas = _tuned_pallas(blk, x, w)
         out, new_mm, new_mv = _fused.fused_block_conv_bn_act(
             conv.attrs, bn.attrs, blk.layout, is_train, blk.act,
-            blk.pallas, x, w, b, gamma, beta, mm, mv)
-        _note_block_cost(blk, out, x, w)
+            pallas, x, w, b, gamma, beta, mm, mv)
+        # the costdb signature records the DISPATCHED lowering — a
+        # cache veto must be visible in the ground truth, not the
+        # planner's pre-veto choice
+        _note_block_cost(blk, out, x, w, pallas=pallas)
         return out, bn, [new_mm, new_mv]
     if blk.kind == "bn_act":
         bn = blk.bn
@@ -350,13 +354,43 @@ def apply_block(blk, vals, is_train):
     raise ValueError("unknown fused block kind %r" % (blk.kind,))
 
 
-def _note_block_cost(blk, out, x, w):
+def _tuned_pallas(blk, x, w):
+    """The block's Pallas-vs-XLA lowering choice, tuning cache first
+    (``mxnet_tpu.autotune.block_config``, keyed by kind + the traced
+    activation/weight shapes): a committed ``{"pallas": 0}`` from a
+    ``tools/autotune.py`` A/B turns the Pallas leg off for this shape.
+    The cache can only VETO the Pallas route, never force it onto an
+    ineligible block; the region's interior row-block split is tuned
+    separately under the ``matmul_stats`` key it dispatches.  Never
+    raises — any failure keeps the planner's choice."""
+    if not blk.pallas:
+        return False
+    try:
+        from .. import autotune
+        cfg = autotune.block_config(
+            blk.kind, [tuple(x.shape), tuple(w.shape)],
+            [str(x.dtype), str(w.dtype)],
+            extra={"layout": blk.layout, "act": blk.act or ""})
+        if cfg and not cfg.get("pallas", True):
+            return False
+    except MemoryError:  # pragma: no cover - never mask resource exhaustion
+        raise
+    except Exception:  # mxlint: allow-broad-except(the tuning-cache lookup is advisory trace-time observability; a failure keeps the planner's lowering choice)
+        pass
+    return True
+
+
+def _note_block_cost(blk, out, x, w, pallas=None):
     """Register the applied block as a pending cost-database signature
     (telemetry.costdb) with analytic flops/bytes estimates from the
     trace-time shapes — runs host-side inside the trace, once per
     compile.  The dispatch that owns this compile binds the signature
-    and attributes measured wall time to it.  Observability: any
-    failure is swallowed, the trace must never pay for it."""
+    and attributes measured wall time to it.  ``pallas``: the
+    lowering actually dispatched (defaults to the planner's choice).
+    Observability: any failure is swallowed, the trace must never pay
+    for it."""
+    if pallas is None:
+        pallas = blk.pallas
     try:
         from ..telemetry import costdb
         import numpy as _np
@@ -389,7 +423,7 @@ def _note_block_cost(blk, out, x, w):
         costdb.note_block(
             blk.name, blk.kind, shapes, dtypes, flops=flops,
             bytes_accessed=bytes_, layout=blk.layout,
-            pallas=blk.pallas)
+            pallas=pallas)
     except MemoryError:  # pragma: no cover - never mask resource exhaustion
         raise
     except Exception:  # mxlint: allow-broad-except(cost-signature capture is observability inside a jit trace; any failure must not fail the compile)

@@ -1,0 +1,133 @@
+"""MXG010 — predicted-slow graph nodes, named before any compile.
+
+The static half of the learned-cost-model loop (ROADMAP item 2): the
+verifier's abstract interpretation already knows every node's input and
+output shapes, so each heavy node gets an analytic flops/bytes estimate
+(the same formulas ``analysis.fusion`` and ``ops/pallas_kernels`` feed
+the cost database), a roofline-attainable lower bound against the
+costdb peak tables, and a wall-time *prediction* from a fitted
+:class:`mxnet_tpu.autotune.CostModel`.  A node whose predicted wall
+exceeds ``factor`` x its attainable time is reported as **MXG010**
+(warning) with both numbers — so a graph that the accumulated ground
+truth says will run far off the roofline is named before any device
+time is spent.
+
+Opt-in: the check runs only when a cost model is supplied —
+``verify_symbol(..., cost_model=...)``, ``python -m mxnet_tpu.analysis
+--cost-model model.json``, or ``tools/autotune.py``'s CI hook.  A
+model fitted on a different backend's records predicts that backend's
+walls; fit and check against the same peak table
+(``MXNET_TPU_PEAK_FLOPS``/``MXNET_TPU_PEAK_BW`` pin it).
+"""
+from __future__ import annotations
+
+__all__ = ["node_cost_estimate", "check_predicted_slow"]
+
+#: ops the analytic estimator covers; everything else is skipped (an
+#: elementwise op's wall is noise next to the convs/GEMMs MXG010 hunts)
+_HEAVY_OPS = ("Convolution", "FullyConnected", "BatchNorm",
+              "_contrib_FlashAttention", "_contrib_RingAttention")
+
+
+def _nbytes(shape, itemsize=4):
+    n = itemsize
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def node_cost_estimate(node, in_shapes, out_shapes, itemsize=4):
+    """Analytic ``(flops, bytes_accessed)`` for one op node, or None
+    when the op is not modeled.  Formulas mirror the trace-time costdb
+    estimates (``fusion._note_block_cost``, ``pallas_kernels.
+    _note_kernel_cost``) so the static prediction and the measured
+    record describe the same quantity."""
+    op = node.op.name
+    if op not in _HEAVY_OPS or not in_shapes or not out_shapes:
+        return None
+    out = out_shapes[0]
+    out_size = 1
+    for d in out:
+        out_size *= int(d)
+    io_bytes = sum(_nbytes(s, itemsize) for s in in_shapes) \
+        + _nbytes(out, itemsize)
+    if op in ("Convolution", "FullyConnected"):
+        if len(in_shapes) < 2:
+            return None
+        w = in_shapes[1]
+        w_size = 1
+        for d in w:
+            w_size *= int(d)
+        n_out = int(node.attrs.get("num_filter")
+                    or node.attrs.get("num_hidden") or w[0])
+        flops = 2.0 * out_size * w_size / max(1, n_out)
+        return flops, float(io_bytes)
+    if op == "BatchNorm":
+        return 10.0 * out_size, float(io_bytes)
+    # flash/ring attention over (B, T, H, D): 2 matmuls of
+    # 2*T*T*D MACs each per (batch, head)
+    q = in_shapes[0]
+    if len(q) != 4:
+        return None
+    b, t, h, d = (int(x) for x in q)
+    return 4.0 * b * h * t * t * d, float(io_bytes)
+
+
+def check_predicted_slow(topo, structs, cost_model, factor=3.0,
+                         report=None):
+    """Run MXG010 over a verified graph: for each modeled node with
+    resolved shapes, predict its wall with ``cost_model`` (a
+    ``mxnet_tpu.autotune.CostModel`` or saved-model path) and flag it
+    when ``predicted > factor * attainable``.  Appends to ``report``
+    (or a fresh one) and returns it."""
+    from ..autotune import model as _model
+    from ..telemetry import costdb
+    from .verifier import Report
+
+    report = report if report is not None else Report()
+    model = _model.load_model(cost_model)
+    factor = float(factor)
+    backend = costdb.backend_name()
+    pf, pbw = costdb.peak_flops(backend), costdb.peak_bandwidth(backend)
+
+    for node in topo:
+        if node.is_variable or node.op is None:
+            continue
+        sts = structs.get(id(node))
+        if not sts:
+            continue
+        in_sts = []
+        missing = False
+        for (src, idx) in node.inputs:
+            st = structs.get(id(src))
+            if st is None or len(st) <= idx:
+                missing = True
+                break
+            in_sts.append(st[idx])
+        if missing:
+            continue
+        itemsize = max([getattr(getattr(st, "dtype", None), "itemsize",
+                                4) or 4 for st in sts] or [4])
+        est = node_cost_estimate(
+            node, [tuple(st.shape) for st in in_sts],
+            [tuple(st.shape) for st in sts], itemsize=itemsize)
+        if est is None:
+            continue
+        flops, bytes_ = est
+        attainable = costdb._attainable_s(flops, bytes_ or None, pf,
+                                          pbw)
+        predicted = model.predict(flops=flops, bytes_accessed=bytes_,
+                                  backend=backend)
+        if not attainable or not predicted:
+            continue
+        if predicted > factor * attainable:
+            report.add(
+                "MXG010", "warning",
+                "cost model predicts %.3g ms against a roofline-"
+                "attainable %.3g ms (%.1fx > the %.1fx budget); this "
+                "node is expected to run far off the roofline — "
+                "candidate for tuning (tools/autotune.py) or fusion"
+                % (predicted * 1e3, attainable * 1e3,
+                   predicted / attainable, factor),
+                node=node.name, op=node.op.name)
+    return report

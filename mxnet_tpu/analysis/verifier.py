@@ -30,6 +30,11 @@ MXG007    error     sharded-graph coverage: a shardable parameter gets no
 MXG008    error     registry self-check finding (alias/hook/rule drift)
 MXG009    warning   shape underdetermined — a rule exists but could not
                     produce the parameter's shape from what is known
+MXG010    warning   predicted-slow node: the learned cost model
+                    (``mxnet_tpu.autotune``) predicts a wall time more
+                    than ``slow_factor`` x the node's roofline-
+                    attainable time (opt-in: runs only when a
+                    ``cost_model`` is supplied; see :mod:`.perf`)
 ========  ========  ====================================================
 
 Entry points: :func:`verify_symbol` (the engine), :meth:`Symbol.verify`,
@@ -44,7 +49,7 @@ import json
 from ..base import MXNetError
 
 __all__ = ["Diagnostic", "Report", "verify_symbol", "verify_json",
-           "verify_model"]
+           "verify_model", "infer_node_shapes"]
 
 _SEVERITIES = ("error", "warning")
 
@@ -240,7 +245,9 @@ def _shape_pass(sym, topo, known_shapes, type_overrides, report):
     Param-shape hooks run just-in-time at each consumer op, exactly as
     Symbol.infer_shape does, but a failure is localized to the node that
     raised instead of aborting the whole inference.  Returns
-    {var_name: shape} for everything that resolved (feeds the TP pass).
+    ``({var_name: shape}, {id(node): tuple(ShapeDtypeStruct)})`` — the
+    resolved variable shapes feed the TP pass, the per-node structs
+    feed MXG010 (:mod:`.perf`) and the autotuner's zoo mode.
     """
     import jax
     import jax.numpy as jnp
@@ -401,7 +408,7 @@ def _shape_pass(sym, topo, known_shapes, type_overrides, report):
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
         structs[id(node)] = tuple(outs)
-    return resolved
+    return resolved, structs
 
 
 def _check_tp_coverage(topo, arg_shapes, tp_size, report):
@@ -453,7 +460,8 @@ def _registry_diagnostics(report):
 # ------------------------------------------------------------- entry points
 
 def verify_symbol(sym, shapes=None, types=None, tp_size=1,
-                  check_registry=False, report=None):
+                  check_registry=False, report=None, cost_model=None,
+                  slow_factor=3.0):
     """Verify a Symbol graph; returns a :class:`Report`.
 
     ``shapes``: {input_name: shape} (same keys as ``infer_shape`` kwargs;
@@ -461,7 +469,11 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
     inference run).  ``types``: {input_name: dtype}.  ``tp_size`` > 1
     additionally runs the sharding-coverage check against
     ``parallel.tp_rules``.  ``check_registry`` folds the op-registry
-    self-check into the report.
+    self-check into the report.  ``cost_model`` (a fitted
+    ``mxnet_tpu.autotune.CostModel`` or a saved-model path)
+    additionally runs MXG010: nodes whose predicted wall exceeds their
+    roofline-attainable time by more than ``slow_factor`` are named
+    before any compile (:mod:`.perf`).
     """
     report = report if report is not None else Report()
     shapes = dict(shapes or {})
@@ -486,15 +498,39 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
     _check_dead_entries(entries, nodes, report)
 
     topo = _topo_from_entries(entries)
-    arg_shapes = _shape_pass(sym, topo, shapes, types, report)
+    arg_shapes, structs = _shape_pass(sym, topo, shapes, types, report)
 
     if tp_size and tp_size > 1:
         _check_tp_coverage(topo, arg_shapes, tp_size, report)
+    if cost_model is not None:
+        from .perf import check_predicted_slow
+        check_predicted_slow(topo, structs, cost_model,
+                             factor=slow_factor, report=report)
     return report
 
 
+def infer_node_shapes(sym, shapes=None, types=None):
+    """Per-node output shapes via the verifier's abstract-
+    interpretation pass, without diagnostics: ``(topo,
+    {id(node): tuple(shape tuples)})``.  Nodes whose shapes could not
+    be resolved are absent.  Feeds the autotuner's zoo-model mode
+    (``tools/autotune.py --model``)."""
+    entries = sym._entries
+    topo = _topo_from_entries(entries)
+    scratch = Report()
+    _resolved, structs = _shape_pass(sym, topo, dict(shapes or {}),
+                                     dict(types or {}), scratch)
+    out = {}
+    for nid, sts in structs.items():
+        if sts is None:
+            continue
+        out[nid] = tuple(tuple(int(d) for d in st.shape) for st in sts)
+    return topo, out
+
+
 def verify_json(json_str, shapes=None, types=None, tp_size=1,
-                check_registry=False):
+                check_registry=False, cost_model=None,
+                slow_factor=3.0):
     """Verify a serialized symbol (the reference JSON graph layout).
 
     Runs every :func:`verify_symbol` check *plus* true dead-node
@@ -542,7 +578,8 @@ def verify_json(json_str, shapes=None, types=None, tp_size=1,
                    "graph does not deserialize: %s" % e)
         return report
     return verify_symbol(sym, shapes=shapes, types=types, tp_size=tp_size,
-                         check_registry=check_registry, report=report)
+                         check_registry=check_registry, report=report,
+                         cost_model=cost_model, slow_factor=slow_factor)
 
 
 # default verification inputs per model-zoo entry: (data kwargs)
@@ -553,12 +590,16 @@ _MODEL_SHAPES = {
 _DEFAULT_IMAGE = {"data": (2, 3, 224, 224)}
 
 
-def verify_model(name, batch=2, tp_size=1, num_classes=10, **model_kwargs):
+def verify_model(name, batch=2, tp_size=1, num_classes=10,
+                 cost_model=None, slow_factor=3.0, **model_kwargs):
     """Build a model-zoo symbol and verify it with its canonical input
-    shape.  Returns (symbol, Report)."""
+    shape.  Returns (symbol, Report).  ``cost_model`` additionally
+    runs the MXG010 predicted-slow check (:mod:`.perf`)."""
     from .. import models
     net = models.get_model(name, num_classes=num_classes, **model_kwargs)
     shapes = dict(_MODEL_SHAPES.get(name, _DEFAULT_IMAGE))
     shapes = {k: (batch,) + tuple(v[1:]) for k, v in shapes.items()}
     shapes["softmax_label"] = (batch,)
-    return net, verify_symbol(net, shapes=shapes, tp_size=tp_size)
+    return net, verify_symbol(net, shapes=shapes, tp_size=tp_size,
+                              cost_model=cost_model,
+                              slow_factor=slow_factor)

@@ -178,6 +178,22 @@ _CATALOG = {
                           "per-chip peak memory bytes/s override for "
                           "costdb roofline derivation (default: "
                           "built-in per-backend table)"),
+    # autotuner (docs/api/autotune.md)
+    "MXNET_TPU_AUTOTUNE": ("cache", "honored",
+                           "trace-time tuned-block-config lookup mode: "
+                           "off (heuristics only), cache (tuned cache "
+                           "entry wins, heuristic on miss — the "
+                           "default), search (a miss triggers a "
+                           "bounded inline measurement search whose "
+                           "winner is committed and used)"),
+    "MXNET_TPU_TUNE_CACHE": ("", "honored",
+                             "persistent Pallas tuning cache directory "
+                             "(mxnet_tpu.autotune, JSONL schema "
+                             "mxtpu-tunecache/1); tunecache*.jsonl "
+                             "files are merged on load with best-"
+                             "measured-wall-wins so multi-host/multi-"
+                             "run caches compose; tools/autotune.py "
+                             "writes it"),
 }
 
 

@@ -109,13 +109,46 @@ def _stats_kernel(x_ref, w_ref, c_ref, y_ref, s1_ref, s2_ref):
     s2_ref[:] += jnp.sum(ys * ys, axis=0, keepdims=True)
 
 
-def matmul_stats(x2d, w2d, c):
+def _tuned_bm(m, k, n, x_dtype, w_dtype):
+    """Tuning-cache row block for this GEMM shape (None on miss/off/
+    invalid; emits the cache hit/miss metrics) — the ``bm`` the
+    autotuner measured fastest wins over the `_pick_bm` heuristic."""
+    try:
+        from .. import autotune
+        cfg = autotune.kernel_config(
+            "matmul_stats", [(m, k), (k, n)],
+            [str(x_dtype), str(w_dtype)])
+        if cfg:
+            bm = int(cfg.get("bm", 0))
+            if bm > 0 and m % bm == 0:
+                return bm
+    except MemoryError:  # pragma: no cover - never mask resource exhaustion
+        raise
+    except Exception:  # mxlint: allow-broad-except(the tuning-cache lookup is advisory; any failure degrades to the heuristic block pick)
+        pass
+    return None
+
+
+def matmul_stats(x2d, w2d, c, bm=None, interpret=False):
     """(M,K)@(K,N) -> y (M,N) in x's dtype, plus f32 (N,) sums of
-    (y - c) and (y - c)^2.  Pallas on TPU, jnp elsewhere."""
+    (y - c) and (y - c)^2.  Pallas on TPU, jnp elsewhere.  ``bm``:
+    explicit row-block override (the autotuner measures candidates
+    through it); default consults the tuning cache, then the
+    `_pick_bm` heuristic.  ``interpret`` runs the Pallas path in
+    interpreter mode regardless of backend (CPU tuning/CI)."""
     m, k = x2d.shape
     n = w2d.shape[1]
-    bm = _pick_bm(m)
-    if _on_tpu() and bm is not None and n % 128 == 0 and k % 8 == 0:
+    # the cache is consulted (and hit/miss counted) ONLY when the
+    # Pallas path is actually reachable — a jnp-fallback dispatch must
+    # not report a tuned config it never used
+    eligible = (_on_tpu() or interpret) and n % 128 == 0 and k % 8 == 0
+    if eligible:
+        if bm is None or m % bm:
+            bm = _tuned_bm(m, k, n, x2d.dtype, w2d.dtype) \
+                or _pick_bm(m)
+    else:
+        bm = None
+    if eligible and bm is not None:
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
@@ -162,6 +195,7 @@ def matmul_stats(x2d, w2d, c):
                 bytes_accessed=m * k * x2d.dtype.itemsize
                 + k * n * w2d.dtype.itemsize + m * n * x2d.dtype.itemsize,
                 transcendentals=0),
+            interpret=interpret,
         )(x2d, w2d, c.reshape(1, n).astype(jnp.float32))
         return y, s1[0], s2[0]
     # fallback: plain dot + fused reduces (still correct, not fused)
@@ -175,12 +209,13 @@ def matmul_stats(x2d, w2d, c):
 
 # --------------------------------------------- fused conv1x1+BN (train)
 @functools.lru_cache(maxsize=None)
-def _fused_conv_bn(eps, momentum, relu=False):
+def _fused_conv_bn(eps, momentum, relu=False, interpret=False):
     """custom_vjp: NHWC x (N,H,W,K) + OIHW w (N_out,K,1,1) + BN params
     -> (out, mean, var, new_mm, new_mv), _bn_core numerics.  With
     ``relu`` the activation folds into the same region (forward epilogue
     + mask in the hand-written backward) — the conv+BN+ReLU block stays
-    one fused dispatch each way (analysis.fusion)."""
+    one fused dispatch each way (analysis.fusion).  ``interpret`` runs
+    the Pallas GEMM in interpreter mode (autotuner A/B on CPU)."""
 
     def fwd_math(x, w, gamma, beta, mm, mv):
         nb, h, wd, k = x.shape
@@ -189,7 +224,7 @@ def _fused_conv_bn(eps, momentum, relu=False):
         x2d = x.reshape(m, k)
         w2d = jnp.transpose(w.reshape(nout, k)).astype(x.dtype)
         c = lax.stop_gradient(mm.astype(jnp.float32))
-        y2d, s1, s2 = matmul_stats(x2d, w2d, c)
+        y2d, s1, s2 = matmul_stats(x2d, w2d, c, interpret=interpret)
         meanc = s1 / m
         var = jnp.maximum(s2 / m - jnp.square(meanc), 0.0)
         mean = meanc + c
@@ -586,11 +621,14 @@ def _fused_fc_act_xla(act, flatten, has_bias):
 
 
 def fused_block_conv_bn_act(conv_attrs, bn_attrs, layout, is_train, act,
-                            pallas, x, w, b, gamma, beta, mm, mv):
+                            pallas, x, w, b, gamma, beta, mm, mv,
+                            interpret=False):
     """Evaluate a planned conv->BN(->act) block; returns
     (out, new_mm, new_mv).  ``pallas`` routes the eligible 1x1 case
     through the matmul-with-stats-epilogue kernel (`matmul_stats`);
-    everything else runs the general single-region custom_vjp."""
+    everything else runs the general single-region custom_vjp.
+    ``interpret`` runs the Pallas leg in interpreter mode (the
+    autotuner's CPU A/B; never set on the training path)."""
     eps = float(bn_attrs["eps"])
     momentum = float(bn_attrs["momentum"])
     train_stats = bool(is_train and not bn_attrs.get("use_global_stats"))
@@ -599,7 +637,8 @@ def fused_block_conv_bn_act(conv_attrs, bn_attrs, layout, is_train, act,
     mm32 = mm.astype(jnp.float32)
     mv32 = mv.astype(jnp.float32)
     if pallas and train_stats and b is None and layout == "NHWC":
-        f = _fused_conv_bn(eps, momentum, relu=(act == "relu"))
+        f = _fused_conv_bn(eps, momentum, relu=(act == "relu"),
+                           interpret=interpret)
         out, _mean, _var, new_mm, new_mv = f(x, w, gamma, beta, mm32,
                                              mv32)
     else:

@@ -138,6 +138,19 @@ CATALOG = {
                                    "aggregate records created in the "
                                    "op/block cost database "
                                    "(kind=program|block|kernel)"),
+    # ----------------------------------------- autotuner (autotune)
+    "mxtpu_tune_cache_hit_total": (COUNTER, ("op",),
+                                   "trace-time tuning-cache lookups "
+                                   "answered by a tuned entry "
+                                   "(mxnet_tpu.autotune; the dispatch "
+                                   "uses the measured-best block "
+                                   "config)"),
+    "mxtpu_tune_cache_miss_total": (COUNTER, ("op",),
+                                    "tuning-cache lookups that fell "
+                                    "back to the built-in heuristic "
+                                    "(or triggered an inline search "
+                                    "under MXNET_TPU_AUTOTUNE="
+                                    "search)"),
     # ------------------------------------ cross-rank view (distview)
     "mxtpu_step_segment_seconds": (HISTOGRAM, ("segment",),
                                    "per-step host wall time split into "

@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Eight stages, all of which must be clean:
+Nine stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -42,6 +42,14 @@ Eight stages, all of which must be clean:
    block; ``tools/bench_diff.py`` over the committed BENCH_r* series
    must exit 0 (tunnel-down runs skipped) and must exit nonzero on a
    synthetic 20%% regression appended to the series.
+9. **autotuner** — a dry-run tune (``tools/autotune.py``, interpret
+   mode) of one flash shape + one matmul_stats shape must leave a
+   strict-parseable ``mxtpu-tunecache/1`` cache, a SECOND run of the
+   same commands must be all cache hits (0 searched), the cost model
+   must fit on the accumulated costdb records, and a model fitted on
+   seeded pathological records must flag a pathological-block graph
+   via MXG010.  (The stage-4 drift guard covers the new
+   ``mxtpu_tune_cache_*`` metrics automatically.)
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -77,7 +85,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/8] mxlint: %d finding(s) over %s"
+        say("ci_check[1/9] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -86,7 +94,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/8] registry selfcheck: %d problem(s)"
+        say("ci_check[2/9] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -100,14 +108,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/8] verify model %-22s %s" % (name, status))
+            say("ci_check[3/9] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/8] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/9] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -115,7 +123,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/8] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/9] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -123,7 +131,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/8] distview smoke: %d problem(s)"
+        say("ci_check[6/9] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -131,17 +139,24 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/8] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/9] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/8] perf ground truth: %d problem(s)"
+        say("ci_check[8/9] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
+            say("  " + p)
+
+        # stage 9: autotuner (tune cache + cost model + MXG010)
+        problems = autotune_check(repo_root)
+        say("ci_check[9/9] autotune: %d problem(s)" % len(problems))
+        for p in problems:
+            failures.append("autotune: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -398,7 +413,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/8] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/9] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -621,6 +636,170 @@ def costdb_check(repo_root=_ROOT):
                             % res.stdout[-300:])
     except subprocess.TimeoutExpired:
         problems.append("costdb dry-run timed out")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def autotune_check(repo_root=_ROOT):
+    """Autotuner gate (docs/api/autotune.md).  Four checks:
+
+    1. a dry-run tune (interpret mode: the real Pallas code paths on
+       CPU) of one flash shape + one matmul_stats shape via
+       ``tools/autotune.py`` leaves a STRICT-parseable
+       ``mxtpu-tunecache/1`` cache whose entries carry both the tuned
+       and heuristic walls with tuned <= heuristic;
+    2. a SECOND run of the same commands is all cache hits (tuned 0,
+       cached == number of keys) — the skip-already-tuned contract the
+       zoo sweep relies on;
+    3. the learned cost model fits on the costdb records the tuning
+       run accumulated (``--fit-model`` emits a loadable
+       ``mxtpu-costmodel/1`` document with calibration stats);
+    4. a model fitted on seeded pathological records (wall = 100x the
+       roofline-attainable time) flags a conv graph via MXG010, and a
+       well-calibrated model (wall == attainable) does NOT — the rule
+       actually discriminates.
+
+    Returns a list of problem strings (empty = clean)."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_autotune_check_")
+    cache = os.path.join(tmpdir, "tunecache")
+    dbdir = os.path.join(tmpdir, "costdb")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu"})
+    env.pop("MXNET_TPU_TUNE_CACHE", None)
+    env.pop("MXNET_TPU_COSTDB", None)
+    if "PYTHONPATH" in env:
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if "axon" not in p]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            env.pop("PYTHONPATH")
+    tool = os.path.join(repo_root, "tools", "autotune.py")
+    cmds = [
+        [sys.executable, tool, "--op", "flash_fwd", "--shapes",
+         "1x256x1x32", "--repeats", "1", "--max-candidates", "3",
+         "--interpret", "--cache", cache, "--costdb", dbdir, "--json"],
+        [sys.executable, tool, "--op", "matmul_stats", "--shapes",
+         "256x64x128", "--repeats", "1", "--max-candidates", "3",
+         "--interpret", "--cache", cache, "--costdb", dbdir, "--json"],
+    ]
+
+    def run_cmds():
+        docs = []
+        for cmd in cmds:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=240, cwd=repo_root, env=env)
+            if res.returncode != 0:
+                problems.append("%s exited %d: %s"
+                                % (" ".join(cmd[2:6]), res.returncode,
+                                   (res.stdout + res.stderr)[-400:]))
+                return None
+            try:
+                docs.append(json.loads(res.stdout.strip()
+                                       .splitlines()[-1]))
+            except (ValueError, IndexError) as e:
+                problems.append("autotune.py printed no parseable "
+                                "JSON: %s" % e)
+                return None
+        return docs
+
+    try:
+        docs = run_cmds()
+        if docs is None:
+            return problems
+        if sum(d["tuned"] for d in docs) < 2:
+            problems.append("first tuning run searched %d key(s), "
+                            "expected 2"
+                            % sum(d["tuned"] for d in docs))
+
+        from mxnet_tpu import autotune
+        try:
+            entries, _sk = autotune.read_entries(cache, strict=True)
+        except ValueError as e:
+            problems.append("tunecache reader (strict) rejects the "
+                            "dry-run cache: %s" % e)
+            return problems
+        if len(entries) < 2:
+            problems.append("expected >= 2 cache entries, got %d"
+                            % len(entries))
+        for e in entries:
+            tw, hw = e.get("wall_s"), e.get("heuristic_wall_s")
+            if tw is None or hw is None:
+                problems.append("entry %s lacks the tuned/heuristic "
+                                "A/B walls" % e["op"])
+            elif tw > hw * (1 + 1e-9):
+                problems.append("entry %s: tuned wall %.3g > heuristic "
+                                "%.3g — the heuristic must be in the "
+                                "candidate set" % (e["op"], tw, hw))
+
+        docs2 = run_cmds()
+        if docs2 is None:
+            return problems
+        if any(d["tuned"] != 0 for d in docs2) or \
+                sum(d["cached"] for d in docs2) < 2:
+            problems.append("second run was not all cache hits "
+                            "(tuned=%s cached=%s)"
+                            % ([d["tuned"] for d in docs2],
+                               [d["cached"] for d in docs2]))
+
+        # cost model fit on the accumulated ground truth
+        model_path = os.path.join(tmpdir, "costmodel.json")
+        res = subprocess.run(
+            [sys.executable, tool, "--fit-model", model_path,
+             "--costdb", dbdir, "--json"],
+            capture_output=True, text=True, timeout=120,
+            cwd=repo_root, env=env)
+        if res.returncode != 0:
+            problems.append("--fit-model exited %d: %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-400:]))
+        else:
+            try:
+                autotune.CostModel.load(model_path)
+            except (ValueError, OSError) as e:
+                problems.append("fitted cost model does not load: %s"
+                                % e)
+
+        # MXG010 discriminates: pathological records -> flagged;
+        # roofline-attaining records -> clean
+        from mxnet_tpu.analysis import verify_model
+        from mxnet_tpu.telemetry import costdb as costdb_mod
+        backend = costdb_mod.backend_name()
+        pf = costdb_mod.peak_flops(backend)
+        pbw = costdb_mod.peak_bandwidth(backend)
+
+        def seeded(factor):
+            recs = []
+            for i in range(16):
+                flops = 10.0 ** (6 + i % 6)
+                bytes_ = flops / 8.0
+                att = costdb_mod._attainable_s(flops, bytes_, pf, pbw)
+                recs.append({"wall_s": att * factor, "flops": flops,
+                             "bytes_accessed": bytes_,
+                             "block_config": None, "backend": backend})
+            return autotune.CostModel().fit(recs)
+
+        _net, rep = verify_model("lenet", cost_model=seeded(100.0),
+                                 slow_factor=3.0)
+        if not [d for d in rep if d.rule == "MXG010"]:
+            problems.append("pathological cost model raised no MXG010 "
+                            "on the seeded graph")
+        _net, rep = verify_model("lenet", cost_model=seeded(1.0),
+                                 slow_factor=3.0)
+        flagged = [d for d in rep if d.rule == "MXG010"]
+        if flagged:
+            problems.append("roofline-attaining cost model still "
+                            "flagged %d node(s) via MXG010 — the rule "
+                            "does not discriminate" % len(flagged))
+    except subprocess.TimeoutExpired:
+        problems.append("autotune dry-run timed out")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return problems

@@ -57,6 +57,8 @@ HAND_WRITTEN = [
     ("analysis (static verifier + mxlint)", "analysis.md"),
     ("telemetry (metrics, spans, run reports)", "telemetry.md"),
     ("fusion (block-granularity fusion + layout planning)", "fusion.md"),
+    ("autotune (Pallas autotuner, tuning cache, learned cost model)",
+     "autotune.md"),
 ]
 
 # cross-links appended to generated pages (page key = module filename
@@ -75,7 +77,11 @@ SEE_ALSO = {
                  "dumps on dispatch failures, and the cost database "
                  "(`telemetry.costdb`): sampled dispatch timing joined "
                  "with flops/bytes into persistent MFU/roofline "
-                 "records ranked by `tools/perf_top.py`"],
+                 "records ranked by `tools/perf_top.py`",
+                 "[autotune](autotune.md) — the persistent tuning "
+                 "cache the Pallas kernels and fused regions consult "
+                 "at trace time (`MXNET_TPU_TUNE_CACHE`; "
+                 "`tools/autotune.py` searches it)"],
     "io": ["[resilience](resilience.md) — bad-record quotas, the "
            "io.prefetch/recordio.read fault seams, retry/backoff",
            "[telemetry](telemetry.md) — prefetch depth/stall gauges, "

@@ -25,7 +25,6 @@ import json
 import math
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -188,29 +187,6 @@ def main():
                                         rows=args.rows,
                                         interpret=args.interpret))
     jx = jax.jit(lambda x: xla_block(x, w1, w2, w3, scales, shifts))
-    # timed variants: K block applications chained in ONE program via
-    # lax.scan (the axon tunnel charges ~80-110 ms per dispatch with a
-    # 51 MB argument regardless of compute — measured; bench.py uses
-    # the same in-program chaining), with a cheap data dependence so
-    # iterations cannot be CSE'd, returning one scalar
-    from jax import lax
-    K = 10
-
-    def chained(block_fn):
-        def run(x):
-            def body(xc, _):
-                y = block_fn(xc)
-                xc = xc + y[..., :xc.shape[-1]].astype(xc.dtype) * \
-                    jnp.asarray(1e-6, xc.dtype)
-                return xc, ()
-            xK, _ = lax.scan(body, x, None, length=K)
-            return jnp.sum(xK.astype(jnp.float32))
-        return jax.jit(run)
-
-    jp_t = chained(lambda x: pallas_block(
-        x, w1, w2, w3, scales, shifts, rows=args.rows,
-        interpret=args.interpret))
-    jx_t = chained(lambda x: xla_block(x, w1, w2, w3, scales, shifts))
 
     yp = np.asarray(jp(x), np.float32)
     yx = np.asarray(jx(x), np.float32)
@@ -221,16 +197,21 @@ def main():
         print("OK")
         return
 
-    def best(f):
-        np.asarray(f(x))             # warm
-        ts = []
-        for _ in range(args.repeats):
-            t0 = time.perf_counter()
-            np.asarray(f(x))         # VALUE fetch of one scalar
-            ts.append(time.perf_counter() - t0)
-        return min(ts) / K           # per block application
-
-    tp, tx = best(jp_t), best(jx_t)
+    # timing via the autotuner's measurement runner (mxnet_tpu.
+    # autotune.measure): K=10 data-dependent applications chained in
+    # ONE program (the axon tunnel charges ~80-110 ms per dispatch
+    # with a 51 MB argument regardless of compute — measured; bench.py
+    # uses the same in-program chaining), compile excluded, min-of-N
+    # wall — the costdb timing semantics, one code path for every
+    # experiment.
+    from mxnet_tpu.autotune import measure
+    K = 10
+    tp = measure(lambda x: pallas_block(x, w1, w2, w3, scales, shifts,
+                                        rows=args.rows,
+                                        interpret=args.interpret),
+                 (x,), repeats=args.repeats, chain=K)
+    tx = measure(lambda x: xla_block(x, w1, w2, w3, scales, shifts),
+                 (x,), repeats=args.repeats, chain=K)
     gflop = (2 * n * H * W *
              (args.cin * args.cmid + 9 * args.cmid * args.cmid
               + args.cmid * args.cout)) / 1e9

@@ -16,13 +16,16 @@ prologue (x is read raw, scale/shift applied in VMEM).
 from __future__ import annotations
 
 import functools
-import time
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def _kernel(x_ref, w_ref, y_ref, s_ref, ss_ref):
@@ -138,44 +141,16 @@ def xla_dot_only(x, w):
     return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
 
 
-def _fetch(out):
-    """Value fetch closes the async chain (on the axon tunnel,
-    block_until_ready alone can return before device compute — see
-    bench.py)."""
-    leaf = out[1] if isinstance(out, (tuple, list)) and len(out) > 1 \
-        else (out[0] if isinstance(out, (tuple, list)) else out)
-    small = leaf[(0,) * (leaf.ndim - 1)][:8] if leaf.ndim else leaf
-    return np.asarray(jax.device_get(small))
-
-
-_CHAIN = {}
-
-
 def bench(f, *args, iters=24):
-    """Time `iters` data-dependent applications INSIDE one jit — the
-    per-call tunnel dispatch (~2 ms) otherwise buries the kernel time."""
-    import jax.lax as lax
-
-    key = (f, tuple(a.shape for a in args))
-    chained = _CHAIN.get(key)
-    if chained is None:
-        @jax.jit
-        def chained(x, w, *rest):
-            def body(carry, _):
-                out = f(x, w + carry, *rest)
-                y = out[0] if isinstance(out, (tuple, list)) else out
-                # scalar tap creates the cross-iteration dependency
-                return y[0, :1].astype(w.dtype).reshape(()) * 0, y[0, 0]
-            _, taps = lax.scan(body, jnp.zeros((), w.dtype), None,
-                               length=iters)
-            return taps
-        _CHAIN[key] = chained
-    out = chained(*args)
-    _fetch(out)
-    t0 = time.perf_counter()
-    out = chained(*args)
-    _fetch(out)
-    return (time.perf_counter() - t0) / iters * 1e3  # ms
+    """ms per application via the autotuner's measurement runner
+    (:func:`mxnet_tpu.autotune.measure`): `iters` data-dependent
+    applications chained inside ONE jitted program (the per-call
+    tunnel dispatch of ~2 ms otherwise buries the kernel time),
+    compile excluded, min-of-N wall, value-fetch synchronized — the
+    exact costdb timing semantics, one code path for every
+    experiment."""
+    from mxnet_tpu.autotune import measure
+    return measure(f, args, repeats=2, chain=iters) * 1e3
 
 
 def main():

@@ -15,11 +15,18 @@ Stdlib-only.  Usage::
 
     python tools/perf_top.py [PATH] [--top N] [--kind block|kernel|program]
                              [--min-count N] [--json] [--strict]
+                             [--suggest [--cache DIR]]
 
 ``PATH`` defaults to ``$MXNET_TPU_COSTDB``.  ``--json`` emits one
 machine-readable document (schema ``mxtpu-perftop/1``) whose ``worst``
 entry names the single worst-MFU block — what ci_check stage 8 parses.
-Exit codes: 0 ok, 2 no readable records.
+
+``--suggest`` joins the ranking against the persistent tuning cache
+(``--cache`` or ``MXNET_TPU_TUNE_CACHE``, ``mxnet_tpu.autotune``): for
+each worst-MFU block/kernel it reports whether the cache holds a
+better-measured config for its key and the expected delta vs the
+heuristic — the "what would tuning buy here" view.  Exit codes: 0 ok,
+2 no readable records.
 """
 from __future__ import annotations
 
@@ -83,6 +90,87 @@ def render(ranked, top):
     return "\n".join(lines)
 
 
+def _cache_entries(cache_path):
+    """Tuning-cache entries merged from ``cache_path`` (or the
+    ``MXNET_TPU_TUNE_CACHE`` env), [] when absent/unreadable."""
+    from mxnet_tpu import autotune
+    path = cache_path or os.environ.get("MXNET_TPU_TUNE_CACHE")
+    if not path or not os.path.exists(path):
+        return []
+    entries, _skipped = autotune.read_entries(path)
+    return entries
+
+
+def _match_entry(rec, entries):
+    """The tuning-cache entry for a costdb block/kernel record's key:
+    op name + shapes + dtypes must agree (block records match their
+    ``block:<kind>`` key by traced shapes)."""
+    name = str(rec.get("name"))
+    kind = rec.get("kind")
+    shapes = json.dumps(rec.get("shapes") or [])
+    dtypes = json.dumps([str(d) for d in (rec.get("dtypes") or [])])
+    want_ops = {name}
+    if kind == "block" and rec.get("block_kind"):
+        want_ops.add("block:%s" % rec["block_kind"])
+    for e in entries:
+        if e["op"] in want_ops \
+                and json.dumps(e.get("shapes") or []) == shapes \
+                and json.dumps([str(d) for d in
+                                (e.get("dtypes") or [])]) == dtypes:
+            return e
+    return None
+
+
+def suggest(ranked, entries):
+    """For each worst-MFU block/kernel record: does the tuning cache
+    hold a better-measured config for its key, and what delta did it
+    measure vs the heuristic?  Returns one row per record."""
+    from mxnet_tpu.autotune import same_config
+    rows = []
+    for r in ranked:
+        if r.get("kind") not in ("block", "kernel"):
+            continue
+        e = _match_entry(r, entries)
+        if e is None:
+            rows.append({"name": r["name"], "kind": r["kind"],
+                         "mfu": r["mfu"],
+                         "current_config": r.get("block_config"),
+                         "status": "untuned",
+                         "hint": "no cache entry for this key — "
+                                 "tools/autotune.py can search it"})
+            continue
+        tw, hw = e.get("wall_s"), e.get("heuristic_wall_s")
+        delta = (hw - tw) / hw if (tw and hw) else None
+        same = same_config(r.get("block_config"), e.get("config"))
+        rows.append({
+            "name": r["name"], "kind": r["kind"], "mfu": r["mfu"],
+            "current_config": r.get("block_config"),
+            "tuned_config": e.get("config"),
+            "tuned_wall_s": tw, "heuristic_wall_s": hw,
+            "expected_delta_frac": delta,
+            "status": "already-tuned" if same else "better-available",
+        })
+    return rows
+
+
+def render_suggestions(rows):
+    lines = ["", "tuning suggestions (cache vs dispatched config):",
+             "%-28s %-8s %6s  %-16s %-24s %-24s %s"
+             % ("name", "kind", "mfu%", "status", "current", "tuned",
+                "expected")]
+    for r in rows:
+        exp = "-"
+        if r.get("expected_delta_frac") is not None:
+            exp = "%+.1f%% vs heuristic" \
+                % (100.0 * r["expected_delta_frac"])
+        lines.append("%-28s %-8s %6.2f  %-16s %-24s %-24s %s"
+                     % (r["name"][:28], r["kind"],
+                        100.0 * r["mfu"], r["status"],
+                        _fmt_cfg(r.get("current_config"))[:24],
+                        _fmt_cfg(r.get("tuned_config"))[:24], exp))
+    return "\n".join(lines)
+
+
 def _doc(ranked, records, skipped, top):
     """The --json document: worst-first entries + the headline worst
     block (fusion blocks that underperform their roofline are exactly
@@ -125,6 +213,13 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--strict", action="store_true",
                     help="fail on any malformed record")
+    ap.add_argument("--suggest", action="store_true",
+                    help="join against the tuning cache: per worst-MFU "
+                         "block, is a better-measured config cached "
+                         "for its key, and what delta did it measure")
+    ap.add_argument("--cache", default=None,
+                    help="tuning-cache path for --suggest (default: "
+                         "$MXNET_TPU_TUNE_CACHE)")
     args = ap.parse_args(argv)
 
     if not args.path:
@@ -145,9 +240,14 @@ def main(argv=None):
               file=sys.stderr)
         return 2
     ranked = rank(records, kind=args.kind, min_count=args.min_count)
+    sugg = None
+    if args.suggest:
+        sugg = suggest(ranked[:args.top], _cache_entries(args.cache))
     if args.as_json:
-        print(json.dumps(_doc(ranked, records, skipped, args.top),
-                         sort_keys=True))
+        doc = _doc(ranked, records, skipped, args.top)
+        if sugg is not None:
+            doc["suggestions"] = sugg
+        print(json.dumps(doc, sort_keys=True))
         return 0
     print("costdb: %d record(s), %d measured%s"
           % (len(records), len(ranked),
@@ -161,6 +261,8 @@ def main(argv=None):
                  "/" + worst["block_kind"] if worst.get("block_kind")
                  else "",
                  100.0 * worst["mfu"], worst.get("bound") or "un"))
+    if sugg:
+        print(render_suggestions(sugg))
     return 0
 
 
