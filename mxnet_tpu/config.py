@@ -178,6 +178,24 @@ _CATALOG = {
                           "per-chip peak memory bytes/s override for "
                           "costdb roofline derivation (default: "
                           "built-in per-backend table)"),
+    # elastic training (docs/api/reshard.md)
+    "MXNET_TPU_ELASTIC": ("0", "honored",
+                          "tools/launch.py --elastic default: a failed "
+                          "attempt relaunches at the SURVIVING worker "
+                          "count (rank leave) instead of the fixed one; "
+                          "resumed workers reshard their checkpoint "
+                          "onto the smaller mesh"),
+    "MXNET_TPU_MIN_WORKERS": ("1", "honored",
+                              "floor for elastic shrinking in "
+                              "tools/launch.py --elastic"),
+    "MXNET_TPU_RESHARD_RULES": ("", "honored",
+                                "match_partition_rules table "
+                                "(parallel.reshard grammar: "
+                                "'regex=axis,axis;...' or @file.json) "
+                                "overriding the trainer's derived "
+                                "tp_rules per matching param — the "
+                                "hand-written partition layout for the "
+                                "target mesh of a reshard"),
     # autotuner (docs/api/autotune.md)
     "MXNET_TPU_AUTOTUNE": ("cache", "honored",
                            "trace-time tuned-block-config lookup mode: "

@@ -26,7 +26,8 @@ from .context import cpu
 from .ndarray import NDArray
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "find_checkpoints", "load_latest_checkpoint", "FeedForward"]
+           "find_checkpoints", "find_latest_checkpoint",
+           "load_latest_checkpoint", "FeedForward"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -198,6 +199,30 @@ def find_checkpoints(prefix, require_states=False):
             continue
         epochs.append(ep)
     return sorted(epochs)
+
+
+def find_latest_checkpoint(prefix, require_states=False):
+    """Epoch of the newest checkpoint that passes FULL CRC
+    verification, or None when no epoch verifies.
+
+    :func:`find_checkpoints` only size-screens (``quick=True``) — a
+    bit-flipped file of the right size still passes it, so its newest
+    epoch is not necessarily loadable.  This walks newest-first and
+    CRC-verifies each manifest, falling back past corrupt epochs (each
+    skip is logged) to the newest epoch that actually verifies — the
+    resume-point discovery elastic restarts use (the epoch it returns
+    is what a subsequent :func:`load_checkpoint` /
+    ``ShardedTrainer.load_checkpoint`` will verify again and open)."""
+    for ep in reversed(find_checkpoints(prefix,
+                                        require_states=require_states)):
+        try:
+            resilience.verify_manifest(prefix, ep)
+            return ep
+        except MXNetError as e:
+            logging.warning("find_latest_checkpoint: skipping "
+                            "unverifiable epoch %d of %r: %s",
+                            ep, prefix, e)
+    return None
 
 
 def load_latest_checkpoint(prefix, require_states=False):

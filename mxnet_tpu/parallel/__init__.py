@@ -14,9 +14,12 @@ holds the TPU-native machinery:
   per-process shard staging, checkpoint gather).
 * :mod:`sequence` — ring attention (sequence/context parallelism).
 * :mod:`pipeline` — GPipe-style microbatch pipeline over a ``pipe`` axis.
+* :mod:`reshard` — elastic training: checkpoint resharding across mesh
+  shapes, rank join/leave events, ``match_partition_rules`` tables.
 """
 from . import multihost
-from .mesh import build_mesh, data_parallel_spec
+from . import reshard
+from .mesh import build_mesh, build_mesh_from_axes, data_parallel_spec
 from .moe import make_expert_mesh, switch_moe
 from .pipeline import make_pipeline_mesh, pipeline_apply, pipeline_grad
 from .trainer import ShardedTrainer

@@ -157,6 +157,109 @@ class DistKVStore(KVStore):
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
 
+    # --------------------------------------------------- elastic migration
+    def save_state(self, prefix, epoch):
+        """Checkpoint every initialized key (rank 0 writes, ordered by a
+        barrier) in the standard manifest-verified checkpoint format
+        (``prefix-%04d.params`` + CRC manifest, schema v2 meta carrying
+        the saving world size).  KVStore values are replicated across
+        workers, so the file is world-size independent — the elastic
+        migration path: a fleet restarted at a different size reloads
+        it via :meth:`load_state` (docs/api/reshard.md).  Returns the
+        params path."""
+        import numpy as np_
+        from .. import ndarray as _nd
+        from .. import resilience
+
+        path = "%s-%04d.params" % (prefix, int(epoch))
+        # the gather seam is evaluated SYMMETRICALLY on every rank: an
+        # armed chaos fault fails the whole fleet's save together
+        # instead of rank 0 alone raising while its peers sit in the
+        # barrier below
+        for k in sorted(self._store, key=str):
+            resilience.fault_point("reshard.gather")
+        if self._rank == 0:
+            # only the writer gathers: values are replicated, so the
+            # other ranks would pay a full device-to-host copy of the
+            # store just to discard it at the barrier
+            arrays = {}
+            for k in sorted(self._store, key=str):
+                v = self._store[k]
+                # keys keep their type across the file: "kv:i:3" for
+                # int 3, "kv:s:7" for the STRING "7" (a bare "kv:7"
+                # could not tell them apart on load)
+                tag = "i" if isinstance(k, int) else "s"
+                arrays["kv:%s:%s" % (tag, k)] = np_.asarray(
+                    v.asnumpy() if hasattr(v, "asnumpy") else v)
+            resilience.atomic_write(
+                path,
+                lambda tmp: _nd.save(
+                    tmp, {k: _nd.array(v) for k, v in arrays.items()}),
+                fault_site="checkpoint.save")
+            resilience.write_manifest(
+                prefix, int(epoch), [path], arrays=arrays,
+                meta={"mesh": {"format": 2, "axes": {},
+                               "world": self._num_workers},
+                      "kvstore": self.type})
+        # the timeout-bounded barrier (MXNET_TPU_BARRIER_TIMEOUT): a
+        # rank-0 write failure must surface on the peers as the
+        # dead-rank barrier error, not an unbounded hang
+        from . import multihost
+        multihost.process_barrier("dist_kvstore_state_save")
+        return path
+
+    def load_state(self, prefix, epoch):
+        """Restore the key/value store saved by :meth:`save_state` on
+        ANY world size (every rank reads the shared file).  A world-size
+        change fires the ``elastic.rejoin`` seam and records
+        ``rank_join``/``rank_leave`` + ``mxtpu_reshard_total``
+        {kind="kvstore"} — the kvstore analogue of the trainer's
+        checkpoint reshard.  Returns the world size the state was saved
+        at."""
+        from .. import ndarray as _nd
+        from .. import resilience
+        from . import reshard as _reshard
+
+        resilience.fault_point("checkpoint.load")
+        manifest = resilience.verify_manifest(prefix, int(epoch))
+        saved_desc = _reshard.manifest_mesh(manifest)
+        saved_world = int((saved_desc or {}).get("world") or 1)
+        if saved_world != self._num_workers:
+            resilience.fault_point("elastic.rejoin")
+        path = "%s-%04d.params" % (prefix, int(epoch))
+        try:
+            loaded = _nd.load(path)
+        except FileNotFoundError as e:
+            raise MXNetError("kvstore state file %r is missing for "
+                             "epoch %d" % (path, int(epoch))) from e
+        store = {}
+        nbytes = 0
+        for k, v in loaded.items():
+            parts = k.split(":", 2)
+            if len(parts) != 3 or parts[0] != "kv" or \
+                    parts[1] not in ("i", "s"):
+                raise MXNetError(
+                    "%r is not a kvstore state file: unexpected key %r "
+                    "(expected kv:i:<int>/kv:s:<name> entries)"
+                    % (path, k))
+            key = int(parts[2]) if parts[1] == "i" else parts[2]
+            # nd.load already yields jax-backed NDArrays; keep them
+            # (an asnumpy round trip would both copy and break the
+            # '_data is a jax.Array' invariant)
+            store[key] = v
+            nbytes += _nbytes(v)
+        self._store = store
+        if saved_world != self._num_workers:
+            _reshard.note_reshape(
+                "kvstore",
+                {"n_params": len(store), "n_resharded": 0,
+                 "bytes": nbytes, "src": "world=%d" % saved_world,
+                 "dst": "world=%d" % self._num_workers},
+                epoch=int(epoch))
+            _reshard.note_world_change(saved_world, self._num_workers,
+                                       kind="kvstore")
+        return saved_world
+
     @staticmethod
     def init_env(**kwargs):
         """Initialize the multi-host runtime (replaces InitPSEnv)."""

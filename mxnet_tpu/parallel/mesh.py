@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["build_mesh", "data_parallel_spec", "largest_tp_factor"]
+__all__ = ["build_mesh", "build_mesh_from_axes", "data_parallel_spec",
+           "largest_tp_factor"]
 
 
 def largest_tp_factor(n, cap=8):
@@ -53,6 +54,28 @@ def build_mesh(n_devices=None, tp=1, pp=1, axis_names=None,
     else:
         arr = np.array(devices).reshape(n // tp, tp)
     return Mesh(arr, axis_names=axis_names)
+
+
+def build_mesh_from_axes(axes, devices=None):
+    """Mesh matching a reshard mesh-descriptor's axes dict, e.g.
+    ``{"data": 4, "model": 2}`` (``parallel/reshard.py``;
+    ``tools/reshard.py --mesh data=4,model=2`` parses into this form).
+    Axis order follows the dict's insertion order; an empty dict gives
+    a single-device ``('data',)`` mesh.  Raises ValueError when the
+    product exceeds the available devices."""
+    import jax
+    from jax.sharding import Mesh
+    axes = {str(k): int(v) for k, v in (axes or {}).items()} \
+        or {"data": 1}
+    n = 1
+    for v in axes.values():
+        n *= v
+    devs = list(devices if devices is not None else jax.devices())
+    if n > len(devs):
+        raise ValueError(
+            "mesh axes %r need %d devices, have %d" % (axes, n, len(devs)))
+    arr = np.array(devs[:n]).reshape(tuple(axes.values()))
+    return Mesh(arr, axis_names=tuple(axes))
 
 
 def shard_map_nocheck(f, mesh, in_specs, out_specs):

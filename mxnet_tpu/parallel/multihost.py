@@ -28,7 +28,25 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["ensure_initialized", "spans_processes", "stage_local",
-           "scale_local_shape", "gather_to_host", "process_barrier"]
+           "scale_local_shape", "gather_to_host", "process_barrier",
+           "world_size"]
+
+
+def world_size():
+    """Process count of the running job (1 single-process).
+
+    Elastic contract (docs/api/reshard.md): this is the CURRENT world —
+    after a rank leave/join restart, ``tools/launch.py --elastic``
+    relaunches every worker with the new ``MXNET_TPU_NUM_PROCESSES``,
+    :func:`ensure_initialized` joins the resized ``jax.distributed``
+    job under the same ``MXNET_TPU_INIT_TIMEOUT``/``_RETRIES`` bounds,
+    and checkpoint loaders compare this value against the manifest's
+    saved world to emit ``rank_join``/``rank_leave`` events."""
+    import jax
+    try:
+        return int(jax.process_count())
+    except (RuntimeError, ValueError):
+        return 1
 
 
 def _distributed_initialized():

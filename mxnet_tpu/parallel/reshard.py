@@ -1,0 +1,407 @@
+"""Checkpoint resharding + elastic mesh reshape.
+
+The reference's ps-lite parameter server tolerated worker churn by
+design (``src/kvstore/kvstore_dist.h:39-80`` heartbeats + restart-from-
+checkpoint); the TPU-native fused path compiles ONE program against ONE
+``jax.sharding.Mesh``, so a fleet that grows or shrinks must *reshard*:
+the state saved under one mesh shape has to come back under another.
+This module is the shared substrate (ROADMAP item 5):
+
+* a ``match_partition_rules``-style **rule table** (regex rules →
+  PartitionSpec-like tuples, the fmengine/fmtrainer exemplar in
+  SNIPPETS.md): :func:`parse_rules` / :func:`match_partition_rules` /
+  :func:`first_match`, armed process-wide via
+  ``MXNET_TPU_RESHARD_RULES`` (:func:`env_rules`);
+
+* **mesh descriptors** recorded in checkpoint-manifest ``meta`` (schema
+  v2, :func:`mesh_descriptor` / :func:`manifest_mesh`): the axis sizes,
+  per-param partition specs, and the saving world size.  v1 manifests
+  (no descriptor) still load — the loader then has nothing to compare
+  and keeps the legacy behavior;
+
+* a **reshard planner** (:func:`plan_reshard`): validates that every
+  target spec divides its param's dims on the target mesh and returns
+  the per-param action list with byte accounting — infeasible targets
+  raise a descriptive :class:`~mxnet_tpu.base.MXNetError` BEFORE any
+  state is touched, so a failed reshape degrades to the old-mesh error
+  path with the live state intact;
+
+* **observability**: every reshape emits ``mxtpu_reshard_*`` metrics, a
+  ``reshard`` flight event, a JSONL event record (aggregated into the
+  ``mxtpu-run/1`` timeline), and — on a world-size change —
+  ``rank_join``/``rank_leave`` events plus the
+  ``mxtpu_elastic_resizes_total`` counter.  The fault seams
+  ``reshard.gather`` / ``reshard.scatter`` / ``elastic.rejoin``
+  (:data:`mxnet_tpu.resilience.KNOWN_SITES`) let ``tools/chaos_run.py``
+  chaos-test the new paths.
+
+Consumers: ``ShardedTrainer.save_checkpoint/load_checkpoint`` (reshard
+on mesh mismatch), ``DistKVStore.save_state/load_state`` (kvstore
+migration across world sizes), ``tools/reshard.py`` (offline converter)
+and ``tools/launch.py --elastic`` (rank leave/join supervision).  See
+``docs/api/reshard.md``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from ..base import MXNetError
+
+__all__ = [
+    "parse_rules", "env_rules", "match_partition_rules", "first_match",
+    "mesh_axes", "normalized_axes", "mesh_descriptor", "manifest_mesh",
+    "same_mesh", "spec_to_json", "specs_from_tp_rules", "plan_reshard",
+    "note_reshape", "note_world_change",
+]
+
+#: manifest meta schema version written by descriptor-carrying savers
+MESH_SCHEMA = 2
+
+
+# ------------------------------------------------------------- rule tables
+
+def parse_rules(spec):
+    """Parse a reshard rule table.
+
+    Two accepted forms:
+
+    * inline grammar — ``;``-separated ``regex=axis,axis,...`` entries
+      where each axis is a mesh axis name or ``None``/'' (replicated
+      dim), e.g. ``".*fc1_weight=model,None;.*_bias=None"``.  An entry
+      with no ``=`` (or an empty axis list) replicates every dim.
+    * ``@/path/to/rules.json`` — a JSON list of ``[regex, [axes...]]``
+      pairs (``null`` = replicated dim), the
+      ``match_partition_rules``-style table from SNIPPETS.md.
+
+    Returns a list of ``(compiled_regex, spec_tuple)``; first match
+    wins.  A malformed table raises :class:`MXNetError` naming the
+    offending entry — a typo'd rule must fail loudly, not silently
+    replicate a weight."""
+    if not spec:
+        return []
+    if spec.startswith("@"):
+        path = spec[1:]
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise MXNetError("reshard rule file %r is unreadable or not "
+                             "JSON: %s" % (path, e)) from e
+        if not isinstance(doc, list):
+            raise MXNetError("reshard rule file %r: expected a JSON "
+                             "list of [regex, [axes...]] pairs" % path)
+        out = []
+        for i, entry in enumerate(doc):
+            if (not isinstance(entry, (list, tuple)) or len(entry) != 2
+                    or not isinstance(entry[0], str)
+                    or not isinstance(entry[1], (list, tuple))):
+                raise MXNetError(
+                    "reshard rule file %r entry %d: expected "
+                    "[regex, [axes...]], got %r" % (path, i, entry))
+            out.append((_compile(entry[0]),
+                        tuple(None if a in (None, "", "None") else str(a)
+                              for a in entry[1])))
+        return out
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        pat, _, axes = part.partition("=")
+        pat = pat.strip()
+        if not pat:
+            raise MXNetError("reshard rule %r: empty pattern "
+                             "(grammar: regex=axis,axis,...;regex2=...)"
+                             % part)
+        dims = []
+        for a in axes.split(","):
+            a = a.strip()
+            if a in ("", "None", "null"):
+                dims.append(None)
+            else:
+                dims.append(a)
+        while dims and dims[-1] is None:
+            dims.pop()          # trailing replicated dims are implicit
+        out.append((_compile(pat), tuple(dims)))
+    return out
+
+
+def _compile(pat):
+    try:
+        return re.compile(pat)
+    except re.error as e:
+        raise MXNetError("reshard rule pattern %r is not a valid "
+                         "regex: %s" % (pat, e)) from e
+
+
+def env_rules():
+    """Rule table armed via ``MXNET_TPU_RESHARD_RULES`` (inline grammar
+    or ``@file``); empty list when unset."""
+    return parse_rules(os.environ.get("MXNET_TPU_RESHARD_RULES", ""))
+
+
+def first_match(rules, name):
+    """Spec tuple of the first rule matching ``name`` (re.search
+    semantics, the SNIPPETS.md convention), or None when nothing
+    matches."""
+    for pat, spec in rules:
+        if pat.search(name) is not None:
+            return spec
+    return None
+
+
+def match_partition_rules(rules, shapes, default=MXNetError):
+    """{name: spec tuple} for every entry of ``shapes`` ({name: shape}).
+
+    Scalar/one-element leaves are never partitioned (they get ``()``,
+    the SNIPPETS.md convention).  A name no rule matches raises
+    :class:`MXNetError` naming it — pass ``default=`` a spec tuple
+    (e.g. ``()``) to replicate unmatched params instead."""
+    out = {}
+    for name, shape in shapes.items():
+        shape = tuple(shape)
+        if len(shape) == 0 or _nelem(shape) == 1:
+            out[name] = ()
+            continue
+        spec = first_match(rules, name)
+        if spec is None:
+            if default is MXNetError:
+                raise MXNetError(
+                    "no reshard rule matches param %r (%d rule(s) "
+                    "tried); add a catch-all '.*=' entry to replicate "
+                    "unmatched params" % (name, len(rules)))
+            spec = tuple(default)
+        if len(spec) > len(shape):
+            raise MXNetError(
+                "reshard rule spec %r for param %r names %d dims but "
+                "the param has %d" % (spec, name, len(spec), len(shape)))
+        out[name] = tuple(spec)
+    return out
+
+
+def _nelem(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+# -------------------------------------------------------- mesh descriptors
+
+def mesh_axes(mesh):
+    """{axis name: size} of a ``jax.sharding.Mesh``."""
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
+def normalized_axes(axes):
+    """Axes dict with size-1 axes dropped: ``{data:4, model:1}`` and
+    ``{data:4}`` describe the same device grid, and a single device is
+    ``{}`` under any naming."""
+    return {k: int(v) for k, v in (axes or {}).items() if int(v) > 1}
+
+
+def spec_to_json(spec):
+    """PartitionSpec (or tuple) → JSON-able list, trailing replicated
+    dims trimmed.  Tuple-of-axes entries (multi-axis sharding) are kept
+    as lists."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (list, tuple)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    while out and out[-1] is None:
+        out.pop()
+    return out
+
+
+def specs_from_tp_rules(tp_rules, shapes):
+    """{name: spec tuple} from a ShardedTrainer ``tp_rules`` table
+    ({name: sharded dim index} over the 'model' axis)."""
+    out = {}
+    for name, shape in shapes.items():
+        spec = [None] * len(shape)
+        if name in tp_rules:
+            spec[tp_rules[name]] = "model"
+        out[name] = tuple(spec)
+    return out
+
+
+def mesh_descriptor(mesh, specs=None, world=None):
+    """JSON-able descriptor of a mesh + the param partition layout on
+    it, recorded in checkpoint-manifest ``meta["mesh"]`` (schema v2).
+
+    ``specs``: {param name: PartitionSpec/tuple}; ``world``: saving
+    process count (defaults to ``jax.process_count()`` best-effort)."""
+    if world is None:
+        try:
+            import jax
+            world = int(jax.process_count())
+        except Exception:  # mxlint: allow-broad-except(descriptor stays writable before/without a jax runtime; world then defaults to 1)
+            world = 1
+    doc = {"format": MESH_SCHEMA, "axes": mesh_axes(mesh),
+           "world": int(world)}
+    if specs is not None:
+        doc["specs"] = {n: spec_to_json(s) for n, s in specs.items()}
+    return doc
+
+
+def manifest_mesh(manifest):
+    """The mesh descriptor a checkpoint manifest carries, or None for
+    v1/legacy manifests (pre-elastic checkpoints load unchanged)."""
+    if not isinstance(manifest, dict):
+        return None
+    mesh = (manifest.get("meta") or {}).get("mesh")
+    return mesh if isinstance(mesh, dict) else None
+
+
+def same_mesh(a, b):
+    """True when two descriptors name the same device grid (size-1 axes
+    ignored — ``{data:4, model:1}`` == ``{data:4}`` == 4 devices on one
+    axis)."""
+    return normalized_axes((a or {}).get("axes")) == \
+        normalized_axes((b or {}).get("axes"))
+
+
+def describe_axes(desc):
+    """Human form of a descriptor's axes, e.g. ``{data:4, model:2}``
+    (``{1}`` for a single device)."""
+    axes = normalized_axes((desc or {}).get("axes"))
+    if not axes:
+        return "{1}"
+    return "{%s}" % ", ".join("%s:%d" % (k, axes[k]) for k in sorted(axes))
+
+
+# --------------------------------------------------------------- planning
+
+def plan_reshard(src_desc, dst_desc, shapes, dtype_bytes=4):
+    """Validate + account a mesh reshape for a set of named arrays.
+
+    ``src_desc``/``dst_desc``: mesh descriptors (src may be None —
+    legacy checkpoint, unknown source layout); ``shapes``: {name:
+    shape} of the arrays to move.  Returns a plan dict::
+
+        {"params": {name: {"src": [...], "dst": [...], "resharded":
+         bool}}, "n_params": N, "n_resharded": K, "bytes": B,
+         "src": "{data:4, model:2}", "dst": "{data:8}"}
+
+    where ``resharded`` marks names whose partition spec changes.
+    Every dst spec is validated against the dst axes: a spec naming a
+    missing axis, or sharding a dim the axis sizes do not divide,
+    raises :class:`MXNetError` listing every offender — the caller's
+    state is untouched, so the load degrades to the old-mesh error
+    path."""
+    src_specs = (src_desc or {}).get("specs") or {}
+    dst_axes = normalized_axes((dst_desc or {}).get("axes"))
+    # every axis NAME the target mesh declares, size-1 included: a
+    # size-1 axis legitimately shards nothing, but a spec naming an
+    # axis the mesh does not have at all is a typo'd rule table and
+    # must fail loudly (the parse_rules contract), not silently
+    # replicate the weight
+    known_axes = set((dst_desc or {}).get("axes") or {})
+    dst_specs = (dst_desc or {}).get("specs") or {}
+    problems = []
+    params = {}
+    total_bytes = 0
+    n_resharded = 0
+    for name in sorted(shapes):
+        shape = tuple(int(d) for d in shapes[name])
+        src = list(src_specs.get(name) or ())
+        dst = list(dst_specs.get(name) or ())
+        for d, entry in enumerate(dst):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, (list, tuple)) else [entry]
+            factor = 1
+            for ax in axes:
+                if str(ax) not in known_axes:
+                    problems.append(
+                        "%s: spec %r names axis %r which the target "
+                        "mesh does not have (axes: %s)"
+                        % (name, dst, ax, sorted(known_axes) or "{1}"))
+                    continue
+                factor *= dst_axes.get(str(ax), 1)
+            if d >= len(shape):
+                problems.append(
+                    "%s: spec %r names dim %d but the param is %d-d"
+                    % (name, dst, d, len(shape)))
+            elif factor > 1 and shape[d] % factor:
+                problems.append(
+                    "%s: dim %d of shape %s is not divisible by the %d "
+                    "shards of axis %r on the target mesh"
+                    % (name, d, shape, factor, entry))
+        resharded = _norm_spec(src) != _norm_spec(dst)
+        if resharded:
+            n_resharded += 1
+        total_bytes += _nelem(shape) * dtype_bytes
+        params[name] = {"src": src, "dst": dst, "resharded": resharded}
+    if problems:
+        raise MXNetError(
+            "cannot reshard %s -> %s: %s"
+            % (describe_axes(src_desc), describe_axes(dst_desc),
+               "; ".join(problems)))
+    return {"params": params, "n_params": len(params),
+            "n_resharded": n_resharded, "bytes": total_bytes,
+            "src": describe_axes(src_desc),
+            "dst": describe_axes(dst_desc)}
+
+
+def _norm_spec(spec):
+    out = [list(e) if isinstance(e, (list, tuple)) else e for e in spec]
+    while out and out[-1] is None:
+        out.pop()
+    return out
+
+
+# ----------------------------------------------------------- observability
+
+def note_reshape(kind, plan, seconds=None, epoch=None):
+    """Record one completed mesh reshape: ``mxtpu_reshard_*`` metrics,
+    a ``reshard`` flight event, and (when the step-log is on) a JSONL
+    event record the launch.py run aggregator passes through into the
+    ``mxtpu-run/1`` timeline."""
+    from .. import telemetry
+    from ..telemetry import flight as _flight
+    telemetry.counter("mxtpu_reshard_total").labels(kind=str(kind)).inc()
+    telemetry.counter("mxtpu_reshard_params_total").inc(
+        plan.get("n_params", 0))
+    telemetry.counter("mxtpu_reshard_bytes_total").inc(
+        plan.get("bytes", 0))
+    if seconds is not None:
+        telemetry.histogram("mxtpu_reshard_seconds").observe(seconds)
+    fields = {"reshard_kind": str(kind), "src": plan.get("src"),
+              "dst": plan.get("dst"),
+              "n_params": plan.get("n_params", 0),
+              "n_resharded": plan.get("n_resharded", 0),
+              "bytes": plan.get("bytes", 0)}
+    if epoch is not None:
+        fields["epoch"] = int(epoch)
+    if seconds is not None:
+        fields["seconds"] = round(seconds, 6)
+    _flight.record("reshard", **fields)
+    telemetry.jsonl_event("reshard", **fields)
+
+
+def note_world_change(old_world, new_world, kind="load"):
+    """Record a rank join/leave (world-size change across a resume):
+    ``rank_join``/``rank_leave`` flight + JSONL events and the
+    ``mxtpu_elastic_resizes_total`` counter.  No-op when the world is
+    unchanged.  Returns the event name, or None."""
+    old_world, new_world = int(old_world), int(new_world)
+    if old_world == new_world:
+        return None
+    event = "rank_join" if new_world > old_world else "rank_leave"
+    direction = "join" if new_world > old_world else "leave"
+    from .. import telemetry
+    from ..telemetry import flight as _flight
+    telemetry.counter("mxtpu_elastic_resizes_total").labels(
+        direction=direction).inc()
+    fields = {"from_world": old_world, "to_world": new_world,
+              "via": str(kind)}
+    _flight.record(event, **fields)
+    telemetry.jsonl_event(event, **fields)
+    return event

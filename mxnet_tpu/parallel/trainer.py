@@ -462,6 +462,56 @@ class ShardedTrainer:
                         "ShardedTrainer derived tp_rules (Megatron "
                         "pairing, tp=%d): %s", tp_size,
                         {k: tp_rules[k] for k in sorted(tp_rules)})
+        # reshard rule table (MXNET_TPU_RESHARD_RULES, parallel/reshard
+        # grammar): regex rules overriding the derived tp_rules per
+        # param — the operator's hand-written partition layout for the
+        # CURRENT mesh, the match_partition_rules pattern.  Entries may
+        # only name the 'model' axis (weights never shard over 'data');
+        # an all-replicated spec ("name=") un-shards a derived rule.
+        from . import reshard as _reshard
+        rrules = _reshard.env_rules()
+        if rrules:
+            tp_rules = dict(tp_rules)
+            for name in self._param_names:
+                spec = _reshard.first_match(rrules, name)
+                if spec is None:
+                    continue
+                dims = [d for d, ax in enumerate(spec) if ax is not None]
+                for d in dims:
+                    if str(spec[d]) != "model":
+                        raise MXNetError(
+                            "reshard rule for param %r names axis %r; "
+                            "trainer params shard only over 'model' "
+                            "(the 'data' axis carries batches)"
+                            % (name, spec[d]))
+                if len(dims) > 1:
+                    raise MXNetError(
+                        "reshard rule for param %r shards %d dims; the "
+                        "trainer supports one sharded dim per weight"
+                        % (name, len(dims)))
+                if not dims or tp_size <= 1:
+                    if dims:
+                        # a model-sharding rule on a mesh with no
+                        # model axis degenerates to replicated — loud
+                        # enough to notice, soft enough that one fleet
+                        # -wide rule file survives an elastic shrink
+                        # to a single device
+                        import logging
+                        logging.warning(
+                            "reshard rule for param %r requests "
+                            "'model' sharding but the mesh has no "
+                            "model axis (tp=1); the param stays "
+                            "replicated", name)
+                    tp_rules.pop(name, None)
+                    continue
+                d = dims[0]
+                shp = self._arg_shapes[name]
+                if d >= len(shp) or shp[d] % tp_size:
+                    raise MXNetError(
+                        "reshard rule for param %r cannot shard dim %d "
+                        "of shape %s over the %d-way 'model' axis"
+                        % (name, d, tuple(shp), tp_size))
+                tp_rules[name] = d
         self.tp_rules = tp_rules
 
         def param_spec(name):
@@ -1672,6 +1722,22 @@ class ShardedTrainer:
         return _costdb.summary(top=top)
 
     # ------------------------------------------------------- checkpoints
+    def mesh_descriptor(self):
+        """JSON-able descriptor of this trainer's mesh + per-param
+        partition layout (``parallel/reshard.py``): axis sizes, the
+        saving world size, and each param's spec in the REFERENCE
+        (OIHW) dim convention — native-layout HWIO storage is a device
+        detail the descriptor never sees, exactly like the checkpoint
+        files themselves.  Recorded in the checkpoint manifest's
+        ``meta["mesh"]`` (schema v2) so a later load can detect a mesh
+        reshape; see :meth:`load_checkpoint`."""
+        from . import multihost, reshard as _reshard
+        specs = _reshard.specs_from_tp_rules(
+            self.tp_rules,
+            {n: self._arg_shapes[n] for n in self._param_names})
+        return _reshard.mesh_descriptor(self.mesh, specs=specs,
+                                        world=multihost.world_size())
+
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """Write reference-format checkpoint files from the sharded
         state: ``prefix-symbol.json`` + ``prefix-%04d.params`` (arg:/aux:
@@ -1701,10 +1767,17 @@ class ShardedTrainer:
             # keep the reference OIHW so checkpoints stay interoperable
             return a.transpose(3, 2, 0, 1) if k in self._native_w else a
 
+        from .. import resilience
+        # gather-on-save streams ONE array at a time off the mesh (the
+        # host dict accumulates numpy copies; device memory never holds
+        # a second full param).  The reshard.gather seam fires per
+        # array — the chaos window of an elastic gather.
         host = {}
         for k, v in self.params.items():
+            resilience.fault_point("reshard.gather")
             host["arg:%s" % k] = to_ref(k, multihost.gather_to_host(v))
         for k, v in self.aux.items():
+            resilience.fault_point("reshard.gather")
             host["aux:%s" % k] = multihost.gather_to_host(v)
         st = None
         if save_optimizer_states:
@@ -1713,10 +1786,10 @@ class ShardedTrainer:
                 _np.int64)}
             for k, slots in self.opt_state.items():
                 for i, sl in enumerate(slots):
+                    resilience.fault_point("reshard.gather")
                     st["slot%d:%s" % (i, k)] = to_ref(
                         k, multihost.gather_to_host(sl))
         if not self._multiproc or jax.process_index() == 0:
-            from .. import resilience
             resilience.atomic_write("%s-symbol.json" % prefix,
                                     self.symbol.save)
             param_name = "%s-%04d.params" % (prefix, epoch)
@@ -1737,8 +1810,11 @@ class ShardedTrainer:
                 arrays.update(st)
             # the manifest commits the checkpoint: written LAST (itself
             # atomically), so a crash anywhere above leaves no epoch a
-            # verified loader would pick up
-            resilience.write_manifest(prefix, epoch, files, arrays=arrays)
+            # verified loader would pick up.  meta["mesh"] (schema v2)
+            # records the saving mesh so a later load on a different
+            # shape reshards instead of guessing (docs/api/reshard.md)
+            resilience.write_manifest(prefix, epoch, files, arrays=arrays,
+                                      meta={"mesh": self.mesh_descriptor()})
         if self._multiproc:
             multihost.process_barrier("sharded_trainer_ckpt_save")
 
@@ -1759,17 +1835,32 @@ class ShardedTrainer:
         Multi-host: every rank reads the files (``prefix`` must be on
         shared storage) and stages its own shards.
         Raises on any name mismatch — a silent partial load would look
-        like a resume while actually restarting from random init."""
+        like a resume while actually restarting from random init.
+
+        Elastic (docs/api/reshard.md): when the manifest's mesh
+        descriptor (schema v2) names a different device grid than this
+        trainer's mesh, the load RESHARDS instead of raising — every
+        array is validated against the target layout up front
+        (``reshard.plan_reshard``), then shard-on-load stages ONE array
+        at a time onto the new mesh (the ``reshard.scatter`` seam fires
+        per array) into a staged copy that only replaces the live state
+        once every array landed, so a mid-reshard failure degrades to a
+        descriptive MXNetError with the old-mesh state untouched.  A
+        world-size change additionally fires the ``elastic.rejoin``
+        seam and records ``rank_join``/``rank_leave`` events.  v1
+        manifests (no descriptor) keep the legacy behavior."""
+        import time as _time
         import jax
         import numpy as _np
         from .. import ndarray as _nd
         from .. import resilience
+        from . import reshard as _reshard
 
         resilience.fault_point("checkpoint.load")
         param_name = "%s-%04d.params" % (prefix, epoch)
         # manifest CRC verification first: a truncated/corrupt file must
         # surface as a named MXNetError, not an unpickle traceback
-        resilience.verify_manifest(prefix, epoch)
+        manifest = resilience.verify_manifest(prefix, epoch)
         try:
             loaded = _nd.load(param_name)
         except FileNotFoundError as e:
@@ -1793,61 +1884,127 @@ class ShardedTrainer:
             # files hold reference OIHW; native-layout state lives HWIO
             return a.transpose(2, 3, 1, 0) if name in self._native_w else a
 
-        with self.mesh:
-            for name, v in file_args.items():
-                self.params[name] = self._put_state(
-                    to_store(name, _np.asarray(v.asnumpy(), _np.float32)),
-                    self._state_target(self.params[name],
-                                       self._param_sharding[name]))
-            for name, v in file_aux.items():
-                self.aux[name] = self._put_state(
-                    _np.asarray(v.asnumpy(), _np.float32),
-                    self._state_target(self.aux[name],
-                                       self._aux_sharding[name]))
-            if load_optimizer_states:
-                states_name = "%s-%04d.states" % (prefix, epoch)
-                try:
-                    st = _nd.load(states_name)
-                except FileNotFoundError as e:
-                    raise MXNetError(
-                        "checkpoint states file %r is missing for epoch "
-                        "%d" % (states_name, epoch)) from e
-                except (ValueError, EOFError, _struct.error) as e:
-                    raise MXNetError(
-                        "checkpoint states file %r is corrupt: %s"
-                        % (states_name, e)) from e
-                slots_in_file = {}
-                for k in st:
-                    if k.startswith("slot"):
-                        slot, name = k.split(":", 1)
-                        i = int(slot[len("slot"):])
-                        slots_in_file[name] = max(
-                            slots_in_file.get(name, 0), i + 1)
-                for name, n in slots_in_file.items():
-                    if name not in self.opt_state or                             n != len(self.opt_state[name]):
-                        raise MXNetError(
-                            "optimizer state mismatch for %r: file has "
-                            "%d slots, trainer (%s) expects %d — resume "
-                            "with the optimizer the checkpoint was saved "
-                            "with" % (name, n,
-                                      type(self.optimizer).__name__,
-                                      self._n_slots))
-                for k, v in st.items():
-                    if k == "meta:num_update":
-                        self.optimizer.begin_num_update = int(
-                            v.asnumpy().astype(_np.int64)[0])
-                        self._step_count = 0
-                        continue
-                    slot, name = k.split(":", 1)
-                    i = int(slot[len("slot"):])
-                    self.opt_state[name][i] = self._put_state(
+        # ---- elastic detection: the manifest's mesh descriptor vs the
+        # mesh this trainer was built on.  The plan validates EVERY
+        # array against the target layout before any state moves.
+        saved_desc = _reshard.manifest_mesh(manifest)
+        cur_desc = self.mesh_descriptor()
+        reshaping = saved_desc is not None and \
+            not _reshard.same_mesh(saved_desc, cur_desc)
+        plan = None
+        if reshaping:
+            shapes = {n: self._arg_shapes[n] for n in file_args}
+            shapes.update({n: self._aux_shapes[n] for n in file_aux})
+            plan = _reshard.plan_reshard(saved_desc, cur_desc, shapes)
+        from . import multihost as _mh
+        saved_world = (saved_desc or {}).get("world")
+        world_changed = saved_world is not None and \
+            int(saved_world) != _mh.world_size()
+        if world_changed:
+            # the rank join/leave seam fires BEFORE any state moves: an
+            # injected rejoin fault leaves the old-mesh state intact
+            resilience.fault_point("elastic.rejoin")
+
+        t0 = _time.perf_counter()
+        # reshard loads stage into a copy and commit only once every
+        # array landed (transiently ~2x state, like any resume over
+        # random init); same-mesh loads keep the in-place replacement
+        target_params = {} if reshaping else self.params
+        target_aux = {} if reshaping else self.aux
+        target_slots = None
+        new_num_update = None
+        try:
+            with self.mesh:
+                for name, v in file_args.items():
+                    if reshaping:
+                        resilience.fault_point("reshard.scatter")
+                    target_params[name] = self._put_state(
                         to_store(name,
                                  _np.asarray(v.asnumpy(), _np.float32)),
-                        self._state_target(self.opt_state[name][i],
+                        self._state_target(self.params[name],
                                            self._param_sharding[name]))
+                for name, v in file_aux.items():
+                    if reshaping:
+                        resilience.fault_point("reshard.scatter")
+                    target_aux[name] = self._put_state(
+                        _np.asarray(v.asnumpy(), _np.float32),
+                        self._state_target(self.aux[name],
+                                           self._aux_sharding[name]))
+                if load_optimizer_states:
+                    states_name = "%s-%04d.states" % (prefix, epoch)
+                    try:
+                        st = _nd.load(states_name)
+                    except FileNotFoundError as e:
+                        raise MXNetError(
+                            "checkpoint states file %r is missing for "
+                            "epoch %d" % (states_name, epoch)) from e
+                    except (ValueError, EOFError, _struct.error) as e:
+                        raise MXNetError(
+                            "checkpoint states file %r is corrupt: %s"
+                            % (states_name, e)) from e
+                    slots_in_file = {}
+                    for k in st:
+                        if k.startswith("slot"):
+                            slot, name = k.split(":", 1)
+                            i = int(slot[len("slot"):])
+                            slots_in_file[name] = max(
+                                slots_in_file.get(name, 0), i + 1)
+                    for name, n in slots_in_file.items():
+                        if name not in self.opt_state or                                 n != len(self.opt_state[name]):
+                            raise MXNetError(
+                                "optimizer state mismatch for %r: file "
+                                "has %d slots, trainer (%s) expects %d "
+                                "— resume with the optimizer the "
+                                "checkpoint was saved with"
+                                % (name, n,
+                                   type(self.optimizer).__name__,
+                                   self._n_slots))
+                    target_slots = {n: list(s)
+                                    for n, s in self.opt_state.items()} \
+                        if reshaping else self.opt_state
+                    for k, v in st.items():
+                        if k == "meta:num_update":
+                            new_num_update = int(
+                                v.asnumpy().astype(_np.int64)[0])
+                            continue
+                        slot, name = k.split(":", 1)
+                        i = int(slot[len("slot"):])
+                        if reshaping:
+                            resilience.fault_point("reshard.scatter")
+                        target_slots[name][i] = self._put_state(
+                            to_store(name,
+                                     _np.asarray(v.asnumpy(),
+                                                 _np.float32)),
+                            self._state_target(
+                                self.opt_state[name][i],
+                                self._param_sharding[name]))
+        except (MXNetError, ValueError, RuntimeError, TypeError) as e:
+            if reshaping:
+                # degrade to the old-mesh error path: the live state
+                # was never touched (staged copies are dropped)
+                raise MXNetError(
+                    "resharding checkpoint %r epoch %d from mesh %s "
+                    "onto mesh %s failed: %s — trainer state left "
+                    "unchanged on the current mesh"
+                    % (prefix, epoch, plan["src"], plan["dst"], e)) \
+                    from e
+            raise
+        if reshaping:
+            self.params = target_params
+            self.aux = target_aux
+            if target_slots is not None:
+                self.opt_state = target_slots
+            _reshard.note_reshape("load", plan,
+                                  seconds=_time.perf_counter() - t0,
+                                  epoch=epoch)
+        if world_changed:
+            _reshard.note_world_change(saved_world, _mh.world_size(),
+                                       kind="load")
+        if new_num_update is not None:
+            self.optimizer.begin_num_update = new_num_update
         # the restored state IS the new baseline: steps counted before
         # this load no longer describe it (with optimizer states the
-        # meta branch above also restored begin_num_update)
+        # meta handling above also restored begin_num_update)
         self._resume_epoch = int(epoch)
         self._step_count = 0
 

@@ -67,6 +67,10 @@ KNOWN_SITES = (
     "recordio.read", "checkpoint.save", "checkpoint.load",
     "multihost.init", "multihost.barrier", "io.prefetch",
     "trainer.step",
+    # elastic training (parallel/reshard.py, docs/api/reshard.md):
+    # per-param gather/scatter of a mesh reshape, and the world-size
+    # change detection on a rank join/leave resume
+    "reshard.gather", "reshard.scatter", "elastic.rejoin",
 )
 
 
@@ -414,7 +418,12 @@ def write_manifest(prefix, epoch, files, arrays=None, meta=None):
     ``files``: paths covered by the checkpoint; each is recorded with
     its size and whole-file CRC32.  ``arrays``: {name: array} whose
     per-array CRC32/shape/dtype are recorded so a loader can verify
-    individual tensors.  Returns the manifest path."""
+    individual tensors.  ``meta``: JSON-able dict stored verbatim —
+    elastic savers record their mesh descriptor under ``meta["mesh"]``
+    (schema v2, ``parallel/reshard.py``; the manifest ``format`` bumps
+    to 2 when a mesh descriptor is present, and v1 manifests keep
+    loading — readers only consume the keys they know).  Returns the
+    manifest path."""
     entry_files = {}
     for p in files:
         entry_files[os.path.basename(p)] = {
@@ -430,12 +439,13 @@ def write_manifest(prefix, epoch, files, arrays=None, meta=None):
             "shape": list(a.shape),
             "dtype": str(a.dtype),
         }
+    meta = dict(meta or {})
     doc = {
-        "format": 1,
+        "format": 2 if meta.get("mesh") else 1,
         "epoch": int(epoch),
         "files": entry_files,
         "arrays": entry_arrays,
-        "meta": dict(meta or {}),
+        "meta": meta,
     }
     path = manifest_path(prefix, epoch)
     atomic_write(path, lambda tmp: _dump_json(tmp, doc))

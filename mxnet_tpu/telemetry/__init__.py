@@ -56,8 +56,9 @@ from . import flight
 from . import memory
 from . import distview
 from . import costdb
-from .exporters import (step_end, render_prom, report, start_http_server,
-                        jsonl_path, env_port, reset, reset_steps)
+from .exporters import (step_end, jsonl_event, render_prom, report,
+                        start_http_server, jsonl_path, env_port, reset,
+                        reset_steps)
 from . import compile as compile_events
 from .exporters import _init_env_state
 
@@ -66,8 +67,9 @@ __all__ = [
     "REGISTRY", "Registry", "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram",
     "span", "drain_step_spans", "step_span_totals",
-    "step_end", "render_prom", "report", "start_http_server",
-    "jsonl_path", "env_port", "reset", "reset_steps", "compile_events",
+    "step_end", "jsonl_event", "render_prom", "report",
+    "start_http_server", "jsonl_path", "env_port", "reset",
+    "reset_steps", "compile_events",
     "flight", "memory", "distview", "costdb",
 ]
 

@@ -151,6 +151,29 @@ CATALOG = {
                                     "(or triggered an inline search "
                                     "under MXNET_TPU_AUTOTUNE="
                                     "search)"),
+    # ---------------------------- elastic training (parallel.reshard)
+    "mxtpu_reshard_total": (COUNTER, ("kind",),
+                            "mesh reshapes performed (kind=load — a "
+                            "checkpoint restored onto a different mesh "
+                            "shape; offline — tools/reshard.py "
+                            "conversion; kvstore — DistKVStore state "
+                            "migration)"),
+    "mxtpu_reshard_params_total": (COUNTER, (),
+                                   "named arrays restaged across mesh "
+                                   "reshapes (params + aux; optimizer "
+                                   "slots ride their param's plan "
+                                   "entry)"),
+    "mxtpu_reshard_bytes_total": (COUNTER, (),
+                                  "bytes restaged across mesh "
+                                  "reshapes"),
+    "mxtpu_reshard_seconds": (HISTOGRAM, (),
+                              "wall time per mesh reshape (plan + "
+                              "per-param scatter onto the target "
+                              "mesh)"),
+    "mxtpu_elastic_resizes_total": (COUNTER, ("direction",),
+                                    "world-size changes observed "
+                                    "across a resume "
+                                    "(direction=join|leave)"),
     # ------------------------------------ cross-rank view (distview)
     "mxtpu_step_segment_seconds": (HISTOGRAM, ("segment",),
                                    "per-step host wall time split into "

@@ -397,6 +397,8 @@ class RunAggregator:
                     os.path.getsize(rank_jsonl_path(base_path, r)), "")
             except OSError:
                 pass              # not created yet: start at 0
+        self._all_ranks = self.n  # every rank ever launched (elastic
+                                  # shrinks self.n; streams stay tailed)
         self._pending = {}        # (attempt, step) -> {rank: record}
         self._emitted = set()     # (attempt, step) already written
         self._floor = -1          # steps <= this were pruned from
@@ -430,6 +432,17 @@ class RunAggregator:
             self._max_step = 0
             self._floor = -1
 
+    def set_num_ranks(self, n):
+        """Elastic resize (tools/launch.py --elastic): subsequent
+        attempts expect ``n`` ranks per step, so a shrunk fleet's steps
+        complete immediately instead of waiting out the partial-step
+        window for ranks that left.  Departed ranks' streams stay
+        tailed (``_all_ranks`` never shrinks) so their final buffered
+        lines still land in the timeline."""
+        with self._lock:
+            self.n = max(1, int(n))
+            self._all_ranks = max(self._all_ranks, self.n)
+
     # ------------------------------------------------------------ output
     def _write(self, rec):
         try:
@@ -450,9 +463,19 @@ class RunAggregator:
 
     # ------------------------------------------------------------- input
     def feed(self, r, rec):
-        """Ingest one parsed JSONL record from rank ``r``."""
+        """Ingest one parsed JSONL record from rank ``r``.  Step records
+        aggregate; worker EVENT records (``telemetry.jsonl_event`` —
+        reshard / rank_join / rank_leave breadcrumbs) pass through into
+        the timeline with the rank attached; anything else is
+        ignored."""
         step = rec.get("step")
         if not isinstance(step, (int, float)):
+            if isinstance(rec.get("event"), str):
+                ev = dict(rec)
+                ev.setdefault("rank", int(r))
+                ev["kind"] = "event"
+                with self._lock:
+                    self._write(ev)
             return
         step = int(step)
         compact = {"t_s": rec.get("step_time_s"),
@@ -525,7 +548,7 @@ class RunAggregator:
         dumps) and emit newly-complete steps.  Returns the number of
         records ingested this call."""
         fed = 0
-        for r in range(self.n):
+        for r in range(self._all_ranks):
             path = rank_jsonl_path(self.base, r)
             off, partial = self._offsets.get(r, (0, ""))
             try:

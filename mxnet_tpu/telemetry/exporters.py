@@ -31,8 +31,9 @@ from . import flight
 from . import memory as memory_mod
 from .spans import drain_step_spans
 
-__all__ = ["step_end", "render_prom", "report", "start_http_server",
-           "jsonl_path", "env_port", "reset", "reset_steps"]
+__all__ = ["step_end", "jsonl_event", "render_prom", "report",
+           "start_http_server", "jsonl_path", "env_port", "reset",
+           "reset_steps"]
 
 # retained step durations for percentiles (bounded: ~12h at 10 steps/s)
 _MAX_DURS = 500_000
@@ -164,6 +165,31 @@ def step_end(samples=None, step_time=None, extra=None, count=1):
             rec.update(extra)
         fh.write(json.dumps(rec) + "\n")
         fh.flush()
+
+
+def jsonl_event(event, **fields):
+    """Append one NON-step event record to this rank's JSONL step-log
+    (no-op returning False when the step-log is off).
+
+    The record is ``{"ts", "rank", "event": <name>, ...fields}`` — no
+    ``step`` key, so per-step consumers skip it, while the launch.py
+    run aggregator (``telemetry.distview.RunAggregator``) passes it
+    through into the ``mxtpu-run/1`` timeline as an ``event`` record.
+    Elastic training uses this for ``reshard`` / ``rank_join`` /
+    ``rank_leave`` breadcrumbs; fields must be JSON-serializable."""
+    with _lock:
+        fh = _jsonl_handle()
+        if fh is None:
+            return False
+        rec = {"ts": round(time.time(), 6), "rank": _proc_rank(),
+               "event": str(event)}
+        rec.update(fields)
+        try:
+            fh.write(json.dumps(rec, default=repr) + "\n")
+            fh.flush()
+        except (OSError, ValueError):
+            return False
+        return True
 
 
 # ------------------------------------------------------------- prometheus

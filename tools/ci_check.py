@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Nine stages, all of which must be clean:
+Ten stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -50,6 +50,14 @@ Nine stages, all of which must be clean:
    seeded pathological records must flag a pathological-block graph
    via MXG010.  (The stage-4 drift guard covers the new
    ``mxtpu_tune_cache_*`` metrics automatically.)
+10. **reshard gate** — ``tools/reshard.py --selfcheck`` on virtual CPU
+    devices: a checkpoint saved on a fake ``{data:2, model:2}`` mesh
+    must reshard-load on ``{data:4}`` AND on a single device with
+    bit-exact params/aux/optimizer state against a gather reference
+    (the trainer stepping afterwards on each target mesh), and the
+    offline converter's ``--verify`` roundtrip must be bit-identical.
+    (The stage-4 drift guard covers the new ``mxtpu_reshard_*`` /
+    ``mxtpu_elastic_*`` metrics automatically.)
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -85,7 +93,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/9] mxlint: %d finding(s) over %s"
+        say("ci_check[1/10] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -94,7 +102,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/9] registry selfcheck: %d problem(s)"
+        say("ci_check[2/10] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -108,14 +116,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/9] verify model %-22s %s" % (name, status))
+            say("ci_check[3/10] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/9] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/10] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -123,7 +131,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/9] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/10] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -131,7 +139,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/9] distview smoke: %d problem(s)"
+        say("ci_check[6/10] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -139,14 +147,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/9] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/10] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/9] perf ground truth: %d problem(s)"
+        say("ci_check[8/10] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -154,9 +162,18 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/9] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/10] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
+            say("  " + p)
+
+        # stage 10: elastic reshard gate (save on one mesh, bit-exact
+        # reshard-load on others, offline --verify roundtrip)
+        problems = reshard_check(repo_root)
+        say("ci_check[10/10] reshard gate: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("reshard: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -413,7 +430,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/9] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/10] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -802,6 +819,53 @@ def autotune_check(repo_root=_ROOT):
         problems.append("autotune dry-run timed out")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def reshard_check(repo_root=_ROOT):
+    """Elastic reshard gate (docs/api/reshard.md): run
+    ``tools/reshard.py --selfcheck`` in a subprocess with 8 virtual
+    CPU devices — a checkpoint saved on a fake ``{data:2, model:2}``
+    mesh must reshard-load bit-exactly (params + aux + optimizer
+    state vs a gather reference) on ``{data:4}`` and on a single
+    device, the resumed trainers must step, and the offline
+    converter's ``--verify`` roundtrip must be bit-identical.
+    Returns a list of problem strings (empty = clean)."""
+    import subprocess
+
+    problems = []
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the selfcheck builds 4-device meshes: force the virtual device
+    # count (it would default to 1 on a bare CPU host)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("MXNET_TPU_TELEMETRY_JSONL", None)
+    env.pop("MXNET_TPU_RESHARD_RULES", None)
+    env.pop("MXNET_TPU_FAULTS", None)
+    # TPU-tunnel site plugins (axon) must not hijack the CPU run
+    if "PYTHONPATH" in env:
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if "axon" not in p]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            env.pop("PYTHONPATH")
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "reshard.py"),
+             "--selfcheck"],
+            capture_output=True, text=True, timeout=300,
+            cwd=repo_root, env=env)
+    except subprocess.TimeoutExpired:
+        return ["reshard --selfcheck timed out"]
+    if res.returncode != 0:
+        problems.append("reshard --selfcheck exited %d: %s"
+                        % (res.returncode,
+                           (res.stdout + res.stderr)[-800:]))
+    elif "reshard selfcheck OK" not in res.stdout:
+        problems.append("reshard --selfcheck exited 0 without the OK "
+                        "marker: %s" % res.stdout[-400:])
     return problems
 
 

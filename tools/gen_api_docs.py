@@ -59,6 +59,8 @@ HAND_WRITTEN = [
     ("fusion (block-granularity fusion + layout planning)", "fusion.md"),
     ("autotune (Pallas autotuner, tuning cache, learned cost model)",
      "autotune.md"),
+    ("reshard (elastic training: checkpoint resharding, rank "
+     "join/leave)", "reshard.md"),
 ]
 
 # cross-links appended to generated pages (page key = module filename
@@ -87,7 +89,11 @@ SEE_ALSO = {
            "[telemetry](telemetry.md) — prefetch depth/stall gauges, "
            "records-read counters, the JSONL step-log"],
     "model": ["[resilience](resilience.md) — atomic checkpoint writes, "
-              "the manifest format, latest-checkpoint fallback"],
+              "the manifest format, latest-checkpoint fallback",
+              "[reshard](reshard.md) — manifest schema v2 mesh "
+              "descriptors, `find_latest_checkpoint` as the elastic "
+              "resume point, and the offline `tools/reshard.py` "
+              "converter"],
     "module": ["[resilience](resilience.md) — fault injection, "
                "preemption-safe training, chaos testing",
                "[analysis](analysis.md) — `Module.bind(..., "
@@ -115,7 +121,15 @@ SEE_ALSO = {
                  "(`telemetry.costdb`)",
                  "[fusion](fusion.md) — `ShardedTrainer(fuse_blocks=...)`"
                  ": block-granularity fusion + layout planning on the "
-                 "fused train step"],
+                 "fused train step",
+                 "[reshard](reshard.md) — elastic training: "
+                 "`ShardedTrainer.load_checkpoint` reshards across mesh "
+                 "shapes via the manifest mesh descriptor, "
+                 "`MXNET_TPU_RESHARD_RULES` rule tables override the "
+                 "derived tp_rules, `DistKVStore.save_state/load_state` "
+                 "migrate kvstore state across world sizes, and "
+                 "`tools/launch.py --elastic` restarts a fleet at the "
+                 "surviving size"],
     "symbol": ["[analysis](analysis.md) — `Symbol.verify()`, "
                "`bind(strict=True)`, the MXG0xx diagnostic catalog",
                "[fusion](fusion.md) — the block-granularity fusion "

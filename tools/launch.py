@@ -192,6 +192,16 @@ def _run_workers_once(opts, command, attempt, agg=None):
             pass
 
     code, failed_rank = 0, None
+    failed_ranks = []        # the elastic leave set: ONLY the rank whose
+                             # death started the teardown.  Peers that
+                             # also exit nonzero — whether torn down by
+                             # the supervisor or crashed on the dead
+                             # rank's aborted collectives before the
+                             # poll saw it — are collateral, not gone;
+                             # they rejoin the next attempt (a second
+                             # genuinely-dead host sheds on the NEXT
+                             # restart, which never over-shrinks
+                             # healthy capacity)
     live = dict(enumerate(procs))
 
     def relay_usr1(signum, frame):
@@ -213,6 +223,7 @@ def _run_workers_once(opts, command, attempt, agg=None):
                 del live[rank]
                 if rc != 0 and failed_rank is None:
                     failed_rank, code = rank, rc
+                    failed_ranks = [rank]
                     sys.stderr.write(
                         "launch.py: worker %d exited with code %d "
                         "(signal %s); aborting job — surviving workers "
@@ -246,7 +257,7 @@ def _run_workers_once(opts, command, attempt, agg=None):
         _note_worker_death(attempt, failed_rank, code,
                            sorted(_flight_dump_names() - flight_before),
                            agg)
-    return code
+    return code, failed_ranks
 
 
 def _flight_dump_names():
@@ -287,7 +298,22 @@ def launch_local(opts, command):
     (see ShardedTrainer.load_latest_checkpoint and
     MXNET_TPU_RESTART_COUNT) continue training where the dead attempt
     left off.  Budget 0 (default) keeps the previous fail-fast
-    behavior."""
+    behavior.
+
+    ``--elastic`` (or MXNET_TPU_ELASTIC=1) makes restarts SIZE-AWARE
+    (docs/api/reshard.md): a restart relaunches at the surviving size
+    — the configured size minus the ROOT-CAUSE dead rank (peers dying
+    on its aborted collectives are collateral and rejoin; a second
+    genuinely-dead host sheds on the next restart), floored at
+    ``--min-workers`` — instead of the fixed one.  Every
+    worker of the resized attempt sees the new
+    MXNET_TPU_NUM_PROCESSES, rejoins jax.distributed at that world
+    size, and resumes from the checkpoint (whose manifest mesh
+    descriptor makes the loader reshard).  The supervisor records one
+    ``rank_leave`` event per departed rank plus an ``elastic_resize``
+    event in its JSONL/run timeline.  Re-ADDING ranks is a relaunch at
+    the larger -n against the same checkpoint prefix: the loaders see
+    the smaller saved world and record ``rank_join``."""
     agg = _make_aggregator(opts)
     _sup_event({"event": "job_start", "pid": os.getpid(),
                 "num_workers": opts.num_workers,
@@ -302,7 +328,7 @@ def launch_local(opts, command):
     try:
         attempt = 0
         while True:
-            code = _run_workers_once(opts, command, attempt, agg)
+            code, failed = _run_workers_once(opts, command, attempt, agg)
             if code == 0:
                 if attempt:
                     sys.stderr.write(
@@ -317,6 +343,30 @@ def launch_local(opts, command):
                         % (opts.restart_budget, code))
                 return code
             attempt += 1
+            if getattr(opts, "elastic", False) and failed:
+                # rank leave: relaunch at the surviving size (floored)
+                # instead of the fixed one — the root-cause dead rank
+                # is GONE, not coming back this job; the resized
+                # workers reshard their checkpoint onto the smaller
+                # mesh on resume
+                new_n = max(int(getattr(opts, "min_workers", 1)),
+                            opts.num_workers - len(set(failed)))
+                if new_n != opts.num_workers:
+                    for r in sorted(set(failed)):
+                        _sup_event({"event": "rank_leave", "rank": r,
+                                    "attempt": attempt}, agg)
+                    _sup_event({"event": "elastic_resize",
+                                "from_workers": opts.num_workers,
+                                "to_workers": new_n,
+                                "attempt": attempt}, agg)
+                    sys.stderr.write(
+                        "launch.py: elastic resize %d -> %d worker(s) "
+                        "(rank(s) %s left)\n"
+                        % (opts.num_workers, new_n,
+                           ",".join(map(str, sorted(set(failed))))))
+                    opts.num_workers = new_n
+                    if agg is not None and hasattr(agg, "set_num_ranks"):
+                        agg.set_num_ranks(new_n)
             sys.stderr.write(
                 "launch.py: restarting job (attempt %d/%d) from the "
                 "last complete checkpoint\n"
@@ -499,6 +549,17 @@ def main():
                             "MXNET_TPU_HEARTBEAT_INTERVAL", "0.2")),
                         help="watchdog poll interval in seconds — a dead "
                              "rank is detected within one interval")
+    parser.add_argument("--elastic", action="store_true",
+                        default=os.environ.get("MXNET_TPU_ELASTIC",
+                                               "0") == "1",
+                        help="size-aware restarts: a failed attempt "
+                             "relaunches at the SURVIVING worker count "
+                             "(resumed workers reshard their checkpoint "
+                             "onto the smaller mesh; local launcher only)")
+    parser.add_argument("--min-workers", type=int,
+                        default=int(os.environ.get(
+                            "MXNET_TPU_MIN_WORKERS", "1")),
+                        help="floor for elastic shrinking (default 1)")
     parser.add_argument("command", nargs="+", help="command to launch")
     opts = parser.parse_args()
     command = " ".join(opts.command)
