@@ -276,6 +276,10 @@ def _emit(result, fusion=None):
     # every tuned config this run dispatched with, so bench_diff
     # trajectories can attribute a win to tuning (not just see it)
     result["autotune"] = autotune.summary()
+    # training-health numerics: sampling cadence, anomaly counts, and
+    # the last sampled grad norm — a bench run that tripped a numerics
+    # rule is suspect as a trajectory point even if it completed
+    result["numerics"] = telemetry.numerics.summary()
     result["telemetry"] = {
         "steps": rep["steps"],
         "step_time_s": rep["step_time_s"],

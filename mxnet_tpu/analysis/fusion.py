@@ -334,6 +334,7 @@ def apply_block(blk, vals, is_train):
         # cache veto must be visible in the ground truth, not the
         # planner's pre-veto choice
         _note_block_cost(blk, out, x, w, pallas=pallas)
+        _note_block_numerics(blk, out)
         return out, bn, [new_mm, new_mv]
     if blk.kind == "bn_act":
         bn = blk.bn
@@ -343,6 +344,7 @@ def apply_block(blk, vals, is_train):
             bn.attrs, ch, is_train, blk.act, x, val(bn, 1), val(bn, 2),
             val(bn, 3), val(bn, 4))
         _note_block_cost(blk, out, x, None)
+        _note_block_numerics(blk, out)
         return out, bn, [new_mm, new_mv]
     if blk.kind == "fc_act":
         fc = blk.fc
@@ -350,8 +352,17 @@ def apply_block(blk, vals, is_train):
         b = None if fc.attrs.get("no_bias") else val(fc, 2)
         out = _fused.fused_block_fc_act(fc.attrs, blk.act, x, w, b)
         _note_block_cost(blk, out, x, w)
+        _note_block_numerics(blk, out)
         return out, None, None
     raise ValueError("unknown fused block kind %r" % (blk.kind,))
+
+
+def _note_block_numerics(blk, out):
+    """Feed the block's output into an active numerics collection
+    window (telemetry.numerics.block_stats) — zero added trace work
+    outside the trainer's sampled stats variant."""
+    from ..telemetry import numerics as _numerics
+    _numerics.note_block(blk.name, out)
 
 
 def _tuned_pallas(blk, x, w):

@@ -212,6 +212,37 @@ _CATALOG = {
                              "measured-wall-wins so multi-host/multi-"
                              "run caches compose; tools/autotune.py "
                              "writes it"),
+    # training-health numerics (telemetry.numerics,
+    # docs/api/telemetry.md)
+    "MXNET_TPU_NUMERICS_EVERY": ("0", "honored",
+                                 "compute in-graph tensor stats "
+                                 "(param/grad/fused-block norms, "
+                                 "non-finite counts, value digests) "
+                                 "every Nth trainer step() inside the "
+                                 "jitted step; 0 disables; run_steps "
+                                 "chains warn once and stay "
+                                 "unsampled"),
+    "MXNET_TPU_NUMERICS_STRICT": ("0", "honored",
+                                  "a fired numerics anomaly rule dumps "
+                                  "the flight ring and raises a "
+                                  "descriptive MXNetError (naming step/"
+                                  "tensors + NaN provenance node) "
+                                  "instead of warning"),
+    "MXNET_TPU_NUMERICS_LEDGER": ("", "honored",
+                                  "append one mxtpu-numerics/1 record "
+                                  "per sampled step to this file — the "
+                                  "divergence ledger tools/numdiff.py "
+                                  "compares (one file per rank)"),
+    "MXNET_TPU_NUMERICS_SPIKE": ("10", "honored",
+                                 "grad_spike anomaly factor: fires "
+                                 "when the global grad norm exceeds "
+                                 "factor x its running EWMA; 0 "
+                                 "disables the rule"),
+    "MXNET_TPU_NUMERICS_DEAD": ("1.0", "honored",
+                                "dead_grad anomaly threshold on a "
+                                "gradient's exact-zero fraction "
+                                "(1.0 = only an entirely zero grad; "
+                                "0 disables the rule)"),
 }
 
 

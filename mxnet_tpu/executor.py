@@ -56,6 +56,14 @@ class Executor:
         self._group2ctx = group2ctx or {}
         self._monitor_callback = None
         self._monitor_all = False
+        # jit-safe stats monitor (telemetry.numerics): per matched node
+        # output, a small in-graph stat bundle returned as extra outputs
+        # of ONE compiled program — the default Monitor path; the eager
+        # per-node _forward_monitored route is opt-in (Monitor(eager=True))
+        self._stats_cb = None
+        self._stats_pattern = None
+        self._stats_active = None
+        self._stats_cache = {}
 
         # model-parallel placement: ctx_group attr -> device (reference
         # AssignContext + PlaceDevice, graph_executor.cc:249-341)
@@ -365,7 +373,7 @@ class Executor:
             return self._forward_backward(**kwargs)
 
     def _forward_backward(self, **kwargs):
-        if self._monitor_callback is not None:
+        if self._monitor_callback is not None or self._stats_active_now():
             self.forward(is_train=True, **kwargs)
             self.backward()
             return self._outputs
@@ -424,6 +432,8 @@ class Executor:
 
         if self._monitor_callback is not None:
             heads, aux_out = self._forward_monitored(is_train, key)
+        elif self._stats_active_now():
+            heads, aux_out = self._forward_stats(bool(is_train), key)
         else:
             fn = self._get_forward_fn(bool(is_train))
             heads, aux_out = self._dispatch(
@@ -433,6 +443,78 @@ class Executor:
                 self.aux_dict[n]._set_data(upd)
         self._outputs = [NDArray(h) for h in self._place_heads(heads)]
         return self._outputs
+
+    def _stats_active_now(self):
+        """True when the jit-safe stats monitor should run THIS call
+        (installed, and its activation gate — Monitor's interval —
+        says so)."""
+        return self._stats_cb is not None and \
+            (self._stats_active is None or self._stats_active())
+
+    def _get_forward_stats_fn(self, is_train):
+        """The jit-safe monitored forward: the same graph trace with a
+        per-matched-node stat bundle (telemetry.numerics.tensor_stats —
+        a handful of scalar reductions each) as extra outputs.  ONE
+        compiled program, no per-node host sync; the per-node monitor
+        trace path stays unfused, so every output is visible exactly as
+        in the eager route."""
+        pattern = self._stats_pattern
+        key_ = (bool(is_train), pattern.pattern)
+        hit = self._stats_cache.get(key_)
+        if hit is not None:
+            return hit
+        import jax
+        from .telemetry import numerics as _numerics
+        topo, entries = self._topo, self._symbol._entries
+        var_ids = self._var_ids()
+        # matched names in TRACE (graph/topo) order — jit returns the
+        # stats dict with pytree-sorted keys, but callbacks must fire
+        # in the same order the eager monitored route delivers them
+        order = []
+
+        def raw(vals, key):
+            stats = {}
+            order.clear()     # retrace (new shapes) rebuilds the order
+
+            def mon(name, val):
+                if pattern.match(str(name)):
+                    order.append(str(name))
+                    stats[str(name)] = _numerics.tensor_stats(val)
+
+            var_values = dict(zip(var_ids, vals))
+            bsz = vals[0].shape[0] if vals and vals[0].ndim else None
+            heads, aux_updates = eval_graph(
+                topo, entries, var_values, is_train=is_train,
+                key=key, monitor=mon, batch_size=bsz,
+                device_map=self._device_map)
+            n_args = len(self._arg_nodes)
+            aux_out = [aux_updates.get(id(n), vals[n_args + i])
+                       for i, n in enumerate(self._aux_nodes)]
+            return heads, aux_out, stats
+
+        hit = (self._compile(raw), order)
+        self._stats_cache[key_] = hit
+        return hit
+
+    def _forward_stats(self, is_train, key):
+        """Dispatch the stats-monitored forward and deliver each
+        matched tensor's host stat bundle to the installed callback
+        (one device fetch for ALL bundles, then per-name invocation in
+        topo order — non-finite anomalies feed telemetry.numerics)."""
+        import jax
+        fn, order = self._get_forward_stats_fn(is_train)
+        heads, aux_out, stats = self._dispatch(
+            "executor.forward_stats", fn, (self._gather_vals(), key))
+        host = jax.device_get(stats)
+        host = {n: {k: (int(v) if k == "nonfinite" else float(v))
+                    for k, v in st.items()}
+                for n, st in host.items()}
+        from .telemetry import numerics as _numerics
+        _numerics.note_monitored(host, program="executor.forward_stats")
+        cb = self._stats_cb
+        for name in order if len(order) == len(host) else sorted(host):
+            cb(name, host[name])
+        return heads, aux_out
 
     def _forward_monitored(self, is_train, key):
         """Eager per-node execution with the monitor callback installed
@@ -555,8 +637,28 @@ class Executor:
                         self._grad_req, new_aux, group2ctx=self._group2ctx)
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Install the EAGER per-node monitor (reference semantics:
+        ``_forward_monitored`` executes node-by-node with a host sync
+        per callback).  The jit-safe default is
+        :meth:`set_stats_monitor`."""
         self._monitor_callback = callback
         self._monitor_all = monitor_all
+
+    def set_stats_monitor(self, callback, pattern=".*", active=None):
+        """Install the jit-safe stats monitor: ``callback(name,
+        stats)`` fires per node output matching ``pattern`` with the
+        in-graph stat bundle (``l2``/``mean_abs``/``max_abs``/
+        ``nonfinite``/``zero_frac`` floats — telemetry.numerics), all
+        computed inside ONE compiled forward.  ``active``: optional
+        zero-arg gate (Monitor passes its interval latch) — when it
+        returns False the plain forward program runs untouched.
+        ``callback=None`` uninstalls."""
+        import re as _re
+        self._stats_cb = callback
+        self._stats_pattern = (pattern if hasattr(pattern, "match")
+                               else _re.compile(pattern))
+        self._stats_active = active
+        self._stats_cache = {}
 
     def debug_str(self):
         lines = ["Symbol Outputs:"]

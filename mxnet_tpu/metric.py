@@ -11,6 +11,7 @@ import numpy
 
 from .base import MXNetError
 from . import ndarray
+from . import telemetry as _telemetry
 from .ndarray import NDArray
 from . import registry as _registry_mod
 
@@ -44,9 +45,35 @@ class EvalMetric:
         else:
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
+        # non-finite batch values rejected by _accum since the last
+        # reset (also counted into mxtpu_nonfinite_total{tensor=
+        # "metric/<name>"} — telemetry.numerics surface)
+        self.num_nonfinite = 0
 
     def update(self, labels, preds):
         raise NotImplementedError()
+
+    def _accum(self, value, count=1):
+        """Fold one batch statistic into the running average — UNLESS
+        it is non-finite, in which case it is counted and surfaced
+        (``mxtpu_nonfinite_total{tensor="metric/<name>"}``) instead of
+        silently poisoning every later ``get()`` (one NaN batch used
+        to turn the whole epoch's metric into NaN)."""
+        value = float(value)
+        if not math.isfinite(value):
+            # getattr: a subclass overriding reset() without super()
+            # must not turn the guard itself into an AttributeError
+            self.num_nonfinite = getattr(self, "num_nonfinite", 0) + 1
+            _telemetry.counter("mxtpu_nonfinite_total").labels(
+                tensor="metric/%s" % self.name).inc()
+            import logging
+            logging.getLogger(__name__).warning(
+                "metric %r: dropping non-finite update value %r "
+                "(%d so far; see mxtpu_nonfinite_total)",
+                self.name, value, self.num_nonfinite)
+            return
+        self.sum_metric += value
+        self.num_inst += count
 
     def get(self):
         if self.num is None:
@@ -213,8 +240,7 @@ class F1(EvalMetric):
             f1_score = 0.0
             if precision + recall > 0:
                 f1_score = 2 * precision * recall / (precision + recall)
-            self.sum_metric += f1_score
-            self.num_inst += 1
+            self._accum(f1_score)
 
 
 @_register
@@ -243,8 +269,7 @@ class Perplexity(EvalMetric):
             num += lab.size
         # reference metric.py Perplexity accumulates raw (loss, count) and
         # applies exp once in get() — corpus perplexity over all tokens
-        self.sum_metric += loss
-        self.num_inst += num
+        self._accum(loss, num)
 
     def get(self):
         if self.num_inst == 0:
@@ -264,8 +289,7 @@ class MAE(EvalMetric):
             pred = pred.asnumpy()
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+            self._accum(numpy.abs(label - pred).mean())
 
 
 @_register
@@ -280,8 +304,7 @@ class MSE(EvalMetric):
             pred = pred.asnumpy()
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+            self._accum(((label - pred) ** 2.0).mean())
 
 
 @_register
@@ -296,8 +319,7 @@ class RMSE(EvalMetric):
             pred = pred.asnumpy()
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+            self._accum(numpy.sqrt(((label - pred) ** 2.0).mean()))
 
 
 @_register
@@ -315,8 +337,8 @@ class CrossEntropy(EvalMetric):
             label = label.ravel()
             assert label.shape[0] == pred.shape[0]
             prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+            self._accum((-numpy.log(prob + self.eps)).sum(),
+                        label.shape[0])
 
 
 @_register
@@ -328,8 +350,7 @@ class Loss(EvalMetric):
 
     def update(self, _, preds):
         for pred in preds:
-            self.sum_metric += ndarray.sum(pred).asnumpy().sum()
-            self.num_inst += pred.size
+            self._accum(ndarray.sum(pred).asnumpy().sum(), pred.size)
 
 
 @_register
@@ -366,11 +387,9 @@ class CustomMetric(EvalMetric):
             reval = self._feval(label, pred)
             if isinstance(reval, tuple):
                 (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+                self._accum(sum_metric, num_inst)
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                self._accum(reval)
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

@@ -2,8 +2,21 @@
 
 Reference: ``python/mxnet/monitor.py`` (Monitor.install hooks the executor
 monitor callback, :16-122; the reference disables bulk-exec segments for
-per-op visibility — here the executor switches to the eager per-node path
-while a callback is installed).
+per-op visibility).
+
+TPU-native default: the JIT-SAFE numerics path
+(:mod:`mxnet_tpu.telemetry.numerics` via
+``Executor.set_stats_monitor``) — each matched node output's stat
+bundle (l2 / mean-abs / max-abs / non-finite count / zero fraction) is
+computed as scalar reductions INSIDE one compiled forward, so an
+installed monitor costs one small device fetch per activated forward
+instead of a host sync per node (the MXL002 hazard; an activated
+``forward_backward`` runs as separate forward + backward programs,
+the same shape the eager route always had).  The reference's
+eager per-node route (``_forward_monitored``) remains available as
+``Monitor(..., eager=True)`` and is selected automatically when a
+custom ``stat_func`` is supplied — an arbitrary python stat needs the
+full array on the host.
 """
 from __future__ import annotations
 
@@ -19,9 +32,22 @@ __all__ = ["Monitor"]
 
 _STAT_GAUGE = telemetry.gauge("mxtpu_monitor_stat")
 
+#: the in-graph stat reported as THE monitor value on the jit-safe
+#: path; matches the default ``asum_stat`` (mean absolute value)
+_DEFAULT_STAT = "mean_abs"
+
 
 class Monitor:
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 eager=None):
+        """``interval``: activate every Nth ``tic()``.  ``stat_func``:
+        custom array->stat callable — implies the EAGER path (the full
+        array must reach the host).  ``eager``: force the reference's
+        host-sync-per-node route (default: eager only when a custom
+        ``stat_func`` demands it)."""
+        if eager is None:
+            eager = stat_func is not None
+        self.eager = bool(eager)
         if stat_func is None:
             def asum_stat(x):
                 return x.abs().mean() if hasattr(x, "abs") else \
@@ -42,9 +68,24 @@ class Monitor:
             self.queue.append((self.step, name, self.stat_func(array)))
         self.stat_helper = stat_helper
 
+        def stats_helper(name, stats):
+            # jit-safe route: the executor already pattern-filtered at
+            # trace time and delivers host floats — no device traffic here
+            if not self.activated:
+                return
+            self.queue.append((self.step, name, stats))
+        self.stats_helper = stats_helper
+
     def install(self, exe):
-        """Hook an executor (reference Monitor.install)."""
-        exe.set_monitor_callback(self.stat_helper)
+        """Hook an executor (reference Monitor.install).  Default:
+        the jit-safe stats route; ``eager=True``: the reference
+        per-node callback route."""
+        if self.eager:
+            exe.set_monitor_callback(self.stat_helper)
+        else:
+            exe.set_stats_monitor(self.stats_helper,
+                                  pattern=self.re_prog,
+                                  active=lambda: self.activated)
         self.exes.append(exe)
 
     def tic(self):
@@ -65,6 +106,20 @@ class Monitor:
         if self.sort:
             queue = sorted(queue, key=lambda x: x[1])
         for n, k, v_list in queue:
+            if isinstance(v_list, dict):
+                # jit-safe stat bundle: the reported value is the
+                # default stat (mean |x|); the bundle rides the repr so
+                # log lines keep the non-finite/zero-fraction signal
+                val = v_list.get(_DEFAULT_STAT, 0.0)
+                s = "%g" % val
+                if v_list.get("nonfinite"):
+                    s += "\tnonfinite=%d" % v_list["nonfinite"]
+                res.append((n, k, s))
+                try:
+                    _STAT_GAUGE.labels(tensor=str(k)).set(float(val))
+                except (TypeError, ValueError):
+                    pass
+                continue
             if isinstance(v_list, NDArray):
                 v_list = [v_list]
             if not isinstance(v_list, list):

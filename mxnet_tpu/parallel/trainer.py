@@ -554,6 +554,11 @@ class ShardedTrainer:
             self.opt_state = self._device_zero_slots()
 
         self._step_fn = self._build_step()
+        # the numerics variant (telemetry.numerics): the same step with
+        # an in-graph stat tree as a fifth output, compiled lazily on
+        # the first SAMPLED step (MXNET_TPU_NUMERICS_EVERY) so runs with
+        # numerics off never pay the extra compile
+        self._stats_step_fn = None
         self._scan_fns = {}
         # AOT executables dispatched in place of the jit wrappers, keyed
         # (program, id(fn)): the memory plan comes from the SAME compile
@@ -769,7 +774,7 @@ class ShardedTrainer:
         jax.eval_shape(absfwd)
         return shapes
 
-    def _build_pipeline_step(self):
+    def _build_pipeline_step(self, collect_stats=False):
         """GPipe step: the graph cut into ``pipeline_stages`` segments,
         each stage's packed params resident on its 'pipe'-axis device,
         microbatches streamed stage-to-stage over ICI (ppermute), all
@@ -1038,9 +1043,19 @@ class ShardedTrainer:
                 new_params[k], new_state[k] = rule(
                     w, g, opt_state[k], lr * lr_mult, wd_eff, t)
             new_aux = {n.name: aux[n.name] for n in self._aux_nodes}
-            return new_params, new_state, new_aux, loss_sum / label_rows
+            loss = loss_sum / label_rows
+            if collect_stats:
+                # param/grad numerics on the pipelined step (fused-block
+                # stats don't apply: seeded partial graphs never fuse)
+                from ..telemetry import numerics as _numerics
+                stats = _numerics.step_stats(params, grads, loss=loss)
+                return new_params, new_state, new_aux, loss, stats
+            return new_params, new_state, new_aux, loss
 
-        self._py_step = step
+        if collect_stats:
+            self._py_step_stats = step
+        else:
+            self._py_step = step
         state_sharding = {n: [self._param_sharding[n]] * self._n_slots
                           for n in self._param_names}
         in_shardings = (self._param_sharding, state_sharding,
@@ -1048,15 +1063,24 @@ class ShardedTrainer:
                         None, None, None)
         out_shardings = (self._param_sharding, state_sharding,
                          self._aux_sharding, None)
+        if collect_stats:
+            out_shardings = out_shardings + (None,)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings,
                        donate_argnums=(0, 1, 2))
 
-    def _build_step(self):
+    def _build_step(self, collect_stats=False):
+        """Build the jitted train step.  ``collect_stats=True`` builds
+        the NUMERICS VARIANT (telemetry.numerics): the same step with a
+        fifth output — the in-graph tensor-stat tree over params, grads,
+        and (when block fusion is active) fused-block outputs.  It is a
+        SEPARATE compile dispatched only on sampled steps, so unsampled
+        steps run the unmodified program (the jaxpr equation count is
+        bit-for-bit the no-numerics one)."""
         import jax
         import jax.numpy as jnp
         if self._pp > 1:
-            return self._build_pipeline_step()
+            return self._build_pipeline_step(collect_stats=collect_stats)
 
         topo, entries = self._topo, self.symbol._entries
         head_is_loss = [bool(n.op is not None and n.op.is_loss)
@@ -1067,6 +1091,7 @@ class ShardedTrainer:
         hyper = {k: self._per_param_hyper(k) for k in self._param_names}
 
         def step(params, opt_state, aux, batch, key, lr, t):
+            from ..telemetry import numerics as _numerics
             bsz = next(iter(batch.values())).shape[0]
 
             def fwd(p32):
@@ -1090,14 +1115,21 @@ class ShardedTrainer:
                             self._input_names
                             if self._elide_input_grads else ()):
                     var_values = self._node_value_map(p, batch, aux)
-                    heads, aux_upd = eval_graph(topo, entries, var_values,
-                                                is_train=True, key=key,
-                                                batch_size=bsz)
-                return heads, aux_upd
+                    # fused-block output stats ride the stats variant
+                    # only: the collection window is open while
+                    # analysis.fusion.apply_block evaluates each block,
+                    # and the stat scalars leave the vjp trace as part
+                    # of fwd's auxiliary output (capturing the raw
+                    # block tracers in a side dict would leak them)
+                    with _numerics.block_stats(collect_stats) as sink:
+                        heads, aux_upd = eval_graph(
+                            topo, entries, var_values, is_train=True,
+                            key=key, batch_size=bsz)
+                return heads, (aux_upd, dict(sink) if sink else {})
 
             from ..ops.nn import maybe_mirror
-            heads, vjp, aux_upd = jax.vjp(maybe_mirror(fwd),
-                                          params, has_aux=True)
+            heads, vjp, (aux_upd, blk_stats) = jax.vjp(
+                maybe_mirror(fwd), params, has_aux=True)
             cot = [jnp.ones_like(h) if il else jnp.zeros_like(h)
                    for h, il in zip(heads, head_is_loss)]
             (grads,) = vjp(list(cot))
@@ -1135,9 +1167,18 @@ class ShardedTrainer:
                         probs.astype(jnp.float32), idx, axis=1,
                         mode="clip")[:, 0]
                     loss = -jnp.mean(jnp.log(jnp.maximum(p, 1e-10)))
+            if collect_stats:
+                stats = _numerics.step_stats(params, grads,
+                                             blocks=blk_stats,
+                                             loss=loss)
+                return new_params, new_state, new_aux, loss, stats
             return new_params, new_state, new_aux, loss
 
-        self._py_step = step
+        if collect_stats:
+            self._py_step_stats = step
+        else:
+            # the scan chain (_build_multi_step) composes the PLAIN step
+            self._py_step = step
         state_sharding = {n: [self._param_sharding[n]] * self._n_slots
                           for n in self._param_names}
         if self._auto_layouts:
@@ -1147,6 +1188,8 @@ class ShardedTrainer:
                         None, None, None)
         out_shardings = (self._param_sharding, state_sharding,
                          self._aux_sharding, None)
+        if collect_stats:
+            out_shardings = out_shardings + (None,)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings,
                        donate_argnums=(0, 1, 2))
@@ -1304,6 +1347,7 @@ class ShardedTrainer:
             with self.mesh:
                 self.opt_state = self._device_zero_slots()
         self._step_fn = self._build_step()
+        self._stats_step_fn = None
         self._scan_fns = {}
         self._aot_exes = {}
         # retire the old costdb dispatch scope (see __init__): the new
@@ -1482,6 +1526,27 @@ class ShardedTrainer:
         if sk is not None:
             extra["skew_s"] = round(sk["skew_s"], 6)
             extra["slowest_rank"] = sk["slowest_rank"]
+        num = seg.get("numerics")
+        if num is not None:
+            import math as _math
+            from ..telemetry import numerics as _numerics
+            # the compact numerics pair rides the step's JSONL record so
+            # the run aggregator can surface cross-rank grad-norm skew
+            # and digest drift next to the time skew (tools/run_top.py);
+            # a non-finite grad norm stays out (the nonfinite rule
+            # already carries it, and the step-log must stay strict JSON)
+            gn = num.get("grad_norm")
+            if isinstance(gn, float) and _math.isfinite(gn):
+                extra["grad_norm"] = gn
+            if num.get("digest") is not None:
+                extra["digest"] = num["digest"]
+            if _numerics.ledger_path() is None:
+                # no dedicated ledger file: the step-log itself is the
+                # ledger — the full record rides under "numerics", so
+                # tools/numdiff.py accepts MXNET_TPU_TELEMETRY_JSONL
+                # directly (read_ledger's inline carrier form)
+                extra["numerics"] = _numerics.json_safe(
+                    {k: v for k, v in num.items() if k != "anomalies"})
         return extra
 
     def _batch_samples(self, batch):
@@ -1568,6 +1633,7 @@ class ShardedTrainer:
         import jax
         import jax.numpy as jnp
         from .. import resilience
+        from ..telemetry import numerics as _numerics
         resilience.fault_point("trainer.step")
         self._key, sub = jax.random.split(self._key)
         dev_batch = self._stage_timed(batch)
@@ -1580,13 +1646,138 @@ class ShardedTrainer:
                              + self._step_count)
         lr = (opt.lr_scheduler(opt.num_update)
               if opt.lr_scheduler is not None else opt.lr)
-        self._ensure_state_formats(self._step_fn)
+        sampled = self._numerics_sampled()
+        if sampled:
+            # the numerics.nonfinite seam is evaluated ONLY on sampled
+            # steps: an injected NaN must land where detection runs —
+            # poisoning an unsampled (or auto_layouts-gated) step would
+            # corrupt the run with zero anomaly signal
+            dev_batch = self._maybe_poison_batch(dev_batch)
+        fn = self._step_fn
+        if sampled:
+            if self._stats_step_fn is None:
+                self._stats_step_fn = self._build_step(collect_stats=True)
+            fn = self._stats_step_fn
+        self._ensure_state_formats(fn)
         args = (self.params, self.opt_state, self.aux, dev_batch, sub,
                 jnp.float32(lr), jnp.float32(opt.num_update))
         self._measure_collective_entry("trainer.step")
-        self.params, self.opt_state, self.aux, loss = \
-            self._dispatch_planned("trainer.step", self._step_fn, args)
+        if sampled:
+            program = "trainer.step_stats"
+            self.params, self.opt_state, self.aux, loss, stats = \
+                self._dispatch_planned(program, fn, args)
+            # the stats fetch is the ONLY host sync numerics adds, and
+            # only on sampled steps; every rank samples the same step
+            # numbers, so a multi-process fleet syncs symmetrically
+            payload = _numerics.process_step(
+                stats, step=self._resume_epoch + self._step_count,
+                program="trainer.step",
+                provenance_fn=lambda: self._numerics_provenance(
+                    dev_batch, sub),
+                # instance-unique EWMA scope (rotated on rebuild): two
+                # trainers in one process must not share a grad_spike
+                # baseline — model A's small norms would false-trip B
+                scope=("trainer.step", self._costdb_scope))
+            if payload is not None:
+                self._seg["numerics"] = payload
+        else:
+            self.params, self.opt_state, self.aux, loss = \
+                self._dispatch_planned("trainer.step", fn, args)
         return loss
+
+    def _numerics_sampled(self):
+        """Whether THIS step dispatches the numerics stats variant.
+        The cadence is phased on the GLOBAL step (resume epoch + local
+        count — the number the ledger records carry), so a resumed run
+        samples the same step numbers as a from-scratch one and the
+        pre- vs post-resume ledgers stay numdiff-comparable.
+        auto_layouts is excluded: the stats variant would need its own
+        AOT layout choice and a state migration per sampled step."""
+        from ..telemetry import numerics as _numerics
+        if not _numerics.sampled(self._resume_epoch + self._step_count):
+            return False
+        if self._auto_layouts:
+            if not getattr(self, "_numerics_warned", False):
+                self._numerics_warned = True
+                import logging
+                logging.warning(
+                    "MXNET_TPU_NUMERICS_EVERY is set but auto_layouts "
+                    "is active; numerics sampling is disabled for this "
+                    "trainer (the stats variant would re-migrate the "
+                    "state's XLA-chosen layouts on every sampled step)")
+            return False
+        return True
+
+    def _maybe_poison_batch(self, dev_batch):
+        """The ``numerics.nonfinite`` chaos seam: when armed
+        (MXNET_TPU_FAULTS), the injected hazard is a NUMERIC one — the
+        first float data input is poisoned with NaNs instead of raising,
+        so the detection/provenance path is what gets exercised
+        (tools/ci_check.py stage 11).  Called only on SAMPLED steps
+        (see ``_step_impl``), so the injection is always detectable."""
+        from .. import resilience
+        try:
+            resilience.fault_point("numerics.nonfinite")
+            return dev_batch
+        except resilience.FaultInjected:
+            import jax.numpy as jnp
+            import numpy as _np
+            out = dict(dev_batch)
+            for name in self._data_names:
+                v = out[name]
+                if _np.dtype(v.dtype).kind == "f" \
+                        and name not in self._int_inputs:
+                    out[name] = v * jnp.asarray(float("nan"), v.dtype)
+                    return out
+            # no float data input to poison: fall back to a param (the
+            # provenance then names its first consumer)
+            name = self._param_names[0]
+            self.params = dict(self.params)
+            self.params[name] = self.params[name] * jnp.float32(
+                float("nan"))
+            return dev_batch
+
+    def _numerics_provenance(self, dev_batch, key):
+        """NaN/Inf provenance: replay the step's forward EAGERLY (no
+        jit) through ``eval_graph``'s per-node monitor hook — the
+        executor's ``_forward_monitored`` path — and name the FIRST
+        node producing a non-finite output.  Host-syncs per node, which
+        is fine: it runs once, on a step already known to be anomalous.
+
+        The replay binds the CURRENT (post-update) params — the step's
+        input params were donated — so when the corruption entered
+        through the update itself, the named node is the first to
+        CONSUME a non-finite param rather than the backward op that
+        produced it; either way it localizes the blast radius.  Batch-
+        borne NaNs (the seeded-injection case) replay exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        found = {}
+        order = [0]
+
+        def mon(name, val):
+            order[0] += 1
+            if found:
+                return
+            try:
+                bad = int(jax.device_get(jnp.sum(
+                    ~jnp.isfinite(jnp.asarray(val).astype(jnp.float32)))))
+            except (TypeError, ValueError):
+                return
+            if bad:
+                found.update(node=str(name), nonfinite=bad,
+                             position=order[0])
+
+        compute_dtype = jnp.dtype(self.dtype)
+        p = self._compute_view(self.params, compute_dtype)
+        bsz = next(iter(dev_batch.values())).shape[0]
+        with image_layout(self._layout):
+            var_values = self._node_value_map(p, dev_batch, self.aux)
+            eval_graph(self._topo, self.symbol._entries, var_values,
+                       is_train=True, key=key, monitor=mon,
+                       batch_size=bsz)
+        return dict(found) if found else None
 
     def run_steps(self, batch, num_steps):
         """``num_steps`` fused training steps in ONE device program.
@@ -1630,7 +1821,19 @@ class ShardedTrainer:
         import jax
         import jax.numpy as jnp
         import numpy as _np
+        from ..telemetry import numerics as _numerics
 
+        if _numerics.enabled() and \
+                not getattr(self, "_numerics_scan_warned", False):
+            # the scan chain is one opaque program; numerics samples the
+            # step() path only — say so ONCE instead of silently leaving
+            # the ledger empty while the knob claims every Nth step
+            self._numerics_scan_warned = True
+            import logging
+            logging.warning(
+                "MXNET_TPU_NUMERICS_EVERY is set but run_steps chains "
+                "are not sampled (the lax.scan chain is one opaque "
+                "program); use step() where numerics coverage matters")
         dev_batch = self._stage_timed(batch)
         self._maybe_rebuild()
         fn = self._scan_fns.get(num_steps)

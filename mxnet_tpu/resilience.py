@@ -71,6 +71,10 @@ KNOWN_SITES = (
     # per-param gather/scatter of a mesh reshape, and the world-size
     # change detection on a rank join/leave resume
     "reshard.gather", "reshard.scatter", "elastic.rejoin",
+    # training-health numerics (telemetry/numerics.py): armed, the
+    # trainer poisons a data input with NaNs instead of raising — the
+    # numerics detection + provenance path is the thing under test
+    "numerics.nonfinite",
 )
 
 

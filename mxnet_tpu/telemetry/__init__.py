@@ -36,7 +36,13 @@ Three engines:
   records (``MXNET_TPU_COSTDB``, schema ``mxtpu-costdb/1``) joining
   measured wall time, flops/bytes, and fused-block identity into
   MFU/roofline attribution; ``tools/perf_top.py`` ranks the worst
-  blocks, ``tools/bench_diff.py`` guards the BENCH trajectory.
+  blocks, ``tools/bench_diff.py`` guards the BENCH trajectory;
+* **training-health numerics** (:mod:`.numerics`) — jit-safe in-graph
+  tensor stats sampled every ``MXNET_TPU_NUMERICS_EVERY`` steps
+  (param/grad/fused-block norms, non-finite counts, value digests,
+  global grad norm), anomaly rules with NaN/Inf provenance and a
+  strict-mode stop, and the per-step divergence ledger
+  ``tools/numdiff.py`` bisects.
 
 Compile events come from ``jax.monitoring`` listeners where available
 (:mod:`.compile`), else a first-call-vs-steady-state heuristic.
@@ -56,6 +62,7 @@ from . import flight
 from . import memory
 from . import distview
 from . import costdb
+from . import numerics
 from .exporters import (step_end, jsonl_event, render_prom, report,
                         start_http_server, jsonl_path, env_port, reset,
                         reset_steps)
@@ -70,7 +77,7 @@ __all__ = [
     "step_end", "jsonl_event", "render_prom", "report",
     "start_http_server", "jsonl_path", "env_port", "reset",
     "reset_steps", "compile_events",
-    "flight", "memory", "distview", "costdb",
+    "flight", "memory", "distview", "costdb", "numerics",
 ]
 
 # best-effort process-wide init: compile listener (jax.monitoring) and

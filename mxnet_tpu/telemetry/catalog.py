@@ -174,6 +174,26 @@ CATALOG = {
                                     "world-size changes observed "
                                     "across a resume "
                                     "(direction=join|leave)"),
+    # --------------------------- training-health numerics (numerics)
+    "mxtpu_tensor_norm": (GAUGE, ("tensor", "kind"),
+                          "latest sampled l2 norm per named tensor "
+                          "(kind=param|grad|block|node; sampled every "
+                          "MXNET_TPU_NUMERICS_EVERY steps inside the "
+                          "jitted step)"),
+    "mxtpu_grad_global_norm": (GAUGE, (),
+                               "latest sampled global gradient l2 "
+                               "norm (the grad_spike EWMA input)"),
+    "mxtpu_nonfinite_total": (COUNTER, ("tensor",),
+                              "non-finite (NaN/Inf) values detected "
+                              "per watched tensor (grad/param/block "
+                              "stats, monitored node outputs, and "
+                              "metric/<name> update values)"),
+    "mxtpu_numerics_anomalies_total": (COUNTER, ("rule",),
+                                       "numerics anomaly rules fired "
+                                       "(rule=nonfinite|grad_spike|"
+                                       "dead_grad); each firing also "
+                                       "leaves a numerics_anomaly "
+                                       "flight event"),
     # ------------------------------------ cross-rank view (distview)
     "mxtpu_step_segment_seconds": (HISTOGRAM, ("segment",),
                                    "per-step host wall time split into "

@@ -372,8 +372,10 @@ class RunAggregator:
     appended to ``out_path``:
 
     ``{"kind": "step", "step": N, "n_ranks": k, "p50_s", "max_s",
-    "min_s", "worst_rank", "skew_s", "ranks": {rank: {"t_s",
-    "segments", "skew_s"}}}``
+    "min_s", "worst_rank", "skew_s", "grad_skew", "digest_mismatch",
+    "ranks": {rank: {"t_s", "segments", "skew_s", "grad_norm",
+    "digest"}}}`` (the numeric fields appear on steps the
+    training-health numerics layer sampled — telemetry.numerics)
 
     plus ``run_begin`` (the schema header), passthrough ``event``
     records (worker start/death, watchdog restarts, flight dumps), and
@@ -488,6 +490,13 @@ class RunAggregator:
             compact["slowest_rank"] = rec["slowest_rank"]
         if rec.get("count"):
             compact["count"] = rec["count"]
+        # training-health numerics (telemetry.numerics): the sampled
+        # step's global grad norm + state digest ride the step record
+        # so cross-rank numeric skew is visible next to the time skew
+        if isinstance(rec.get("grad_norm"), (int, float)):
+            compact["grad_norm"] = rec["grad_norm"]
+        if isinstance(rec.get("digest"), int):
+            compact["digest"] = rec["digest"]
         with self._lock:
             key = (self._attempt, step)
             # _floor covers keys pruned from _emitted: a rank lagging
@@ -540,6 +549,17 @@ class RunAggregator:
                      if isinstance(v.get("skew_s"), (int, float))]
             if skews:
                 rec["skew_s"] = round(max(skews), 6)
+            gnorms = [v.get("grad_norm") for v in ranks.values()
+                      if isinstance(v.get("grad_norm"), (int, float))]
+            if len(gnorms) >= 2:
+                # cross-rank grad-norm spread: nonzero means the ranks
+                # are not stepping the same numbers — the divergence
+                # signal tools/numdiff.py then localizes per tensor
+                rec["grad_skew"] = round(max(gnorms) - min(gnorms), 9)
+            digests = {v.get("digest") for v in ranks.values()
+                       if isinstance(v.get("digest"), int)}
+            if len(digests) > 1:
+                rec["digest_mismatch"] = True
             self._write(rec)
 
     # -------------------------------------------------------------- poll
@@ -665,7 +685,9 @@ def read_run_timeline(path):
 def summarize_run(records):
     """Postmortem roll-up of a timeline: step counts, cross-rank
     step-time stats, the straggler (most-frequent worst rank), peak
-    skew, per-rank segment totals, and the event list.  Input is
+    skew, per-rank segment totals, the numerics columns (per-rank last
+    grad norm/digest, peak cross-rank grad-norm skew, digest-mismatch
+    step count), and the event list.  Input is
     :func:`read_run_timeline` output; the result is plain JSON-able —
     ``tools/run_top.py --summarize`` prints it."""
     steps = [r for r in records if r.get("kind") == "step"]
@@ -676,6 +698,9 @@ def summarize_run(records):
     rank_times = {}
     skew_max = 0.0
     skew_last = None
+    grad_skew_max = None
+    digest_mismatch_steps = 0
+    rank_numerics = {}
     for s in steps:
         w = s.get("worst_rank")
         if w is not None:
@@ -683,7 +708,18 @@ def summarize_run(records):
         if isinstance(s.get("skew_s"), (int, float)):
             skew_max = max(skew_max, s["skew_s"])
             skew_last = s["skew_s"]
+        if isinstance(s.get("grad_skew"), (int, float)):
+            grad_skew_max = max(grad_skew_max or 0.0, s["grad_skew"])
+        if s.get("digest_mismatch"):
+            digest_mismatch_steps += 1
         for r, v in (s.get("ranks") or {}).items():
+            if isinstance(v.get("grad_norm"), (int, float)):
+                rn = rank_numerics.setdefault(r, {})
+                rn["grad_norm_last"] = v["grad_norm"]
+                rn["grad_norm_steps"] = rn.get("grad_norm_steps", 0) + 1
+            if isinstance(v.get("digest"), int):
+                rank_numerics.setdefault(r, {})["digest_last"] = \
+                    v["digest"]
             if isinstance(v.get("t_s"), (int, float)):
                 # a run_steps chain reports the per-step AVERAGE with a
                 # count; carry the count so totals match the segment
@@ -707,6 +743,8 @@ def summarize_run(records):
         if r in seg_totals:
             per_rank[r]["segments_s"] = {
                 k: round(v, 6) for k, v in sorted(seg_totals[r].items())}
+    for r, rn in rank_numerics.items():
+        per_rank.setdefault(r, {}).update(rn)
     straggler = max(worst, key=worst.get) if worst else None
     return {
         "schema": head.get("schema"),
@@ -718,6 +756,8 @@ def summarize_run(records):
         "worst_rank_counts": {k: worst[k] for k in sorted(worst)},
         "skew_max_s": round(skew_max, 6),
         "skew_last_s": skew_last,
+        "grad_skew_max": grad_skew_max,
+        "digest_mismatch_steps": digest_mismatch_steps,
         "per_rank": per_rank,
         "events": [{k: e.get(k) for k in ("ts", "event", "rank", "pid",
                                           "attempt", "exit_code", "path",

@@ -445,6 +445,8 @@ def reset():
     memory_mod.clear_plans()
     from . import costdb as costdb_mod
     costdb_mod.reset()
+    from . import numerics as numerics_mod
+    numerics_mod.reset()
     with _lock:
         _step_durs.clear()
         _last_counters.clear()

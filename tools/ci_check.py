@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Ten stages, all of which must be clean:
+Eleven stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -58,6 +58,19 @@ Ten stages, all of which must be clean:
     offline converter's ``--verify`` roundtrip must be bit-identical.
     (The stage-4 drift guard covers the new ``mxtpu_reshard_*`` /
     ``mxtpu_elastic_*`` metrics automatically.)
+11. **numerics gate** — training-health numerics end to end
+    (``mxnet_tpu/telemetry/numerics.py``, docs/api/telemetry.md): a
+    strict-mode dry run with a NaN injected through the
+    ``numerics.nonfinite`` resilience seam must stop with an
+    MXNetError naming the tensors AND leave a flight dump whose
+    ``numerics_anomaly`` event carries provenance naming the seeded
+    node; two further dry-run ledgers — an identical twin and one
+    seeded with a mid-run single-tensor divergence — must make
+    ``tools/numdiff.py`` exit 0 (bit-clean) and exit nonzero naming
+    the first diverging step, respectively.  (The stage-4 drift guard
+    covers the new ``mxtpu_tensor_norm`` / ``mxtpu_grad_global_norm``
+    / ``mxtpu_nonfinite_total`` / ``mxtpu_numerics_anomalies_total``
+    metrics automatically.)
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -93,7 +106,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/10] mxlint: %d finding(s) over %s"
+        say("ci_check[1/11] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -102,7 +115,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/10] registry selfcheck: %d problem(s)"
+        say("ci_check[2/11] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -116,14 +129,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/10] verify model %-22s %s" % (name, status))
+            say("ci_check[3/11] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/10] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/11] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -131,7 +144,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/10] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/11] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -139,7 +152,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/10] distview smoke: %d problem(s)"
+        say("ci_check[6/11] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -147,14 +160,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/10] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/11] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/10] perf ground truth: %d problem(s)"
+        say("ci_check[8/11] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -162,7 +175,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/10] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/11] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -170,10 +183,19 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/10] reshard gate: %d problem(s)"
+        say("ci_check[10/11] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
+            say("  " + p)
+
+        # stage 11: training-health numerics gate (seeded NaN ->
+        # strict stop + provenance; ledger twin/divergence -> numdiff)
+        problems = numerics_check(repo_root)
+        say("ci_check[11/11] numerics gate: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("numerics: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -430,7 +452,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/10] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/11] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -866,6 +888,149 @@ def reshard_check(repo_root=_ROOT):
     elif "reshard selfcheck OK" not in res.stdout:
         problems.append("reshard --selfcheck exited 0 without the OK "
                         "marker: %s" % res.stdout[-400:])
+    return problems
+
+
+def numerics_check(repo_root=_ROOT):
+    """Training-health numerics gate (stage 11).  Three legs, all on a
+    tiny ShardedTrainer with per-step sampling:
+
+    1. **strict NaN stop + provenance** — arm the ``numerics.nonfinite``
+       resilience seam (the trainer poisons a data input with NaNs
+       instead of raising); the next sampled step must stop with an
+       MXNetError naming non-finite tensors, and the flight dump's
+       ``numerics_anomaly`` event must carry provenance naming the
+       first producing node of the seeded NaN.
+    2. **ledger twin** — two identical dry runs must produce ledgers
+       ``tools/numdiff.py`` calls bit-clean (exit 0).
+    3. **seeded divergence** — a third run with one param perturbed
+       before step 3 must make numdiff exit nonzero naming step 3.
+
+    Returns a list of problem strings (empty = clean)."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_numerics_gate_")
+    saved = {k: os.environ.get(k)
+             for k in ("MXNET_TPU_FLIGHT_DIR", "MXNET_TPU_FAULTS",
+                       "MXNET_TPU_NUMERICS_EVERY",
+                       "MXNET_TPU_NUMERICS_STRICT",
+                       "MXNET_TPU_NUMERICS_LEDGER")}
+    from mxnet_tpu import models, resilience, telemetry
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+
+    def dry_run(ledger, steps=4, perturb_at=None):
+        """One deterministic tiny-MLP run appending to ``ledger``."""
+        os.environ["MXNET_TPU_NUMERICS_LEDGER"] = ledger
+        telemetry.numerics.reset()
+        np.random.seed(11)      # Xavier init draws from numpy's RNG
+        net = models.get_model("mlp", num_classes=10)
+        trainer = ShardedTrainer(
+            net, build_mesh(tp=1), data_shapes={"data": (8, 64)},
+            label_shapes={"softmax_label": (8,)}, dtype="float32",
+            seed=0)
+        rng = np.random.RandomState(3)
+        batch = {"data": rng.uniform(-1, 1, (8, 64)).astype(np.float32),
+                 "softmax_label": rng.randint(0, 10, 8)
+                 .astype(np.float32)}
+        for i in range(steps):
+            if perturb_at == i + 1:
+                import jax.numpy as jnp
+                name = sorted(trainer.params)[0]
+                trainer.params[name] = trainer.params[name] * \
+                    jnp.float32(3.0)
+            trainer.step(batch)
+        return trainer
+
+    try:
+        os.environ["MXNET_TPU_FLIGHT_DIR"] = tmpdir
+        os.environ["MXNET_TPU_NUMERICS_EVERY"] = "1"
+        os.environ["MXNET_TPU_NUMERICS_STRICT"] = "1"
+        os.environ.pop("MXNET_TPU_FAULTS", None)
+        resilience.clear_faults()
+
+        # ---- leg 1: seeded NaN -> strict stop with provenance
+        trainer = dry_run(os.path.join(tmpdir, "warm.ledger"), steps=2)
+        os.environ["MXNET_TPU_FAULTS"] = "numerics.nonfinite:n=1"
+        rng = np.random.RandomState(3)
+        batch = {"data": rng.uniform(-1, 1, (8, 64)).astype(np.float32),
+                 "softmax_label": rng.randint(0, 10, 8)
+                 .astype(np.float32)}
+        try:
+            trainer.step(batch)
+            problems.append("seeded NaN did not stop the strict-mode "
+                            "run")
+        except MXNetError as e:
+            if "non" not in str(e) or "finite" not in str(e):
+                problems.append("strict-mode error does not describe "
+                                "the non-finite anomaly: %s"
+                                % str(e)[:200])
+        os.environ.pop("MXNET_TPU_FAULTS", None)
+        resilience.clear_faults()
+        dumps = sorted(f for f in os.listdir(tmpdir)
+                       if f.startswith("flight-")
+                       and f.endswith(".json"))
+        if not dumps:
+            problems.append("strict NaN stop left no flight dump")
+        else:
+            prov_nodes = []
+            for name in dumps:
+                with open(os.path.join(tmpdir, name)) as f:
+                    doc = json.load(f)
+                for ev in doc.get("events", ()):
+                    if ev.get("kind") == "numerics_anomaly" and \
+                            ev.get("provenance"):
+                        prov_nodes.append(ev["provenance"].get("node"))
+            if not any(prov_nodes):
+                problems.append("no numerics_anomaly flight event "
+                                "carries provenance naming the seeded "
+                                "node (dumps: %s)" % dumps)
+
+        # ---- legs 2+3: ledger twin + seeded divergence -> numdiff
+        os.environ["MXNET_TPU_NUMERICS_STRICT"] = "0"
+        led_a = os.path.join(tmpdir, "a.ledger")
+        led_b = os.path.join(tmpdir, "b.ledger")
+        led_c = os.path.join(tmpdir, "c.ledger")
+        dry_run(led_a)
+        dry_run(led_b)
+        dry_run(led_c, perturb_at=3)
+        numdiff = os.path.join(repo_root, "tools", "numdiff.py")
+
+        res = subprocess.run([sys.executable, numdiff, led_a, led_b],
+                             capture_output=True, text=True, timeout=60)
+        if res.returncode != 0:
+            problems.append("numdiff over twin ledgers exited %d: %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-300:]))
+        elif "bit-clean" not in res.stdout:
+            problems.append("twin ledgers not reported bit-clean: %s"
+                            % res.stdout[-300:])
+
+        res = subprocess.run([sys.executable, numdiff, led_a, led_c],
+                             capture_output=True, text=True, timeout=60)
+        if res.returncode != 1:
+            problems.append("numdiff over the seeded divergence exited "
+                            "%d (want 1): %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-300:]))
+        elif "step 3" not in res.stdout:
+            problems.append("numdiff did not name the seeded first "
+                            "diverging step 3: %s" % res.stdout[-300:])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resilience.clear_faults()
+        telemetry.numerics.reset()
+        shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 
 

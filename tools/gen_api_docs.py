@@ -42,6 +42,7 @@ MODULES = [
     ("operator (CustomOp)", "mxnet_tpu.operator"),
     ("rnn", "mxnet_tpu.rnn"),
     ("parallel", "mxnet_tpu.parallel"),
+    ("monitor", "mxnet_tpu.monitor"),
     ("profiler", "mxnet_tpu.profiler"),
     ("visualization", "mxnet_tpu.visualization"),
     ("callback", "mxnet_tpu.callback"),
@@ -83,7 +84,13 @@ SEE_ALSO = {
                  "[autotune](autotune.md) — the persistent tuning "
                  "cache the Pallas kernels and fused regions consult "
                  "at trace time (`MXNET_TPU_TUNE_CACHE`; "
-                 "`tools/autotune.py` searches it)"],
+                 "`tools/autotune.py` searches it)",
+                 "[telemetry](telemetry.md) training-health numerics "
+                 "(`telemetry.numerics`): `set_stats_monitor` computes "
+                 "per-node stat bundles INSIDE one compiled forward — "
+                 "the jit-safe default Monitor path; the eager "
+                 "`_forward_monitored` route is the NaN/Inf provenance "
+                 "replay"],
     "io": ["[resilience](resilience.md) — bad-record quotas, the "
            "io.prefetch/recordio.read fault seams, retry/backoff",
            "[telemetry](telemetry.md) — prefetch depth/stall gauges, "
@@ -129,7 +136,26 @@ SEE_ALSO = {
                  "derived tp_rules, `DistKVStore.save_state/load_state` "
                  "migrate kvstore state across world sizes, and "
                  "`tools/launch.py --elastic` restarts a fleet at the "
-                 "surviving size"],
+                 "surviving size",
+                 "[telemetry](telemetry.md) training-health numerics "
+                 "(`telemetry.numerics`): `MXNET_TPU_NUMERICS_EVERY` "
+                 "samples in-graph param/grad/fused-block stats inside "
+                 "the jitted step, anomaly rules stop a strict run with "
+                 "NaN provenance, and the per-step ledger feeds "
+                 "`tools/numdiff.py` divergence bisection"],
+    "monitor": ["[telemetry](telemetry.md) — training-health numerics "
+                "(`telemetry.numerics`): the jit-safe stat machinery "
+                "the default Monitor path rides (`mxtpu_monitor_stat"
+                "{tensor}` gauges, `mxtpu_nonfinite_total` counting, "
+                "strict-mode anomaly stops)",
+                "[executor](executor.md) — `set_stats_monitor` (one "
+                "compiled forward with per-node stat outputs) vs the "
+                "eager `set_monitor_callback` route "
+                "(`Monitor(eager=True)`)"],
+    "metric": ["[telemetry](telemetry.md) — non-finite update values "
+               "are rejected from the running average and counted into "
+               "`mxtpu_nonfinite_total{tensor=\"metric/<name>\"}` "
+               "(training-health numerics)"],
     "symbol": ["[analysis](analysis.md) — `Symbol.verify()`, "
                "`bind(strict=True)`, the MXG0xx diagnostic catalog",
                "[fusion](fusion.md) — the block-granularity fusion "

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""numdiff — compare two training-numerics ledgers (``mxtpu-numerics/1``).
+
+The bisection half of the training-health numerics stack
+(``mxnet_tpu/telemetry/numerics.py``): each sampled step appends one
+ledger record per rank — per-tensor l2/mean/max stats, bit-level value
+digests, and the global grad norm — and this tool walks two such
+ledgers step by step and names the FIRST diverging (step, tensor) with
+magnitude.  Typical comparisons:
+
+* fused vs unfused — did the block-fusion lowering drift numerically?
+* pre- vs post-reshard resume — did the mesh reshape stay bit-exact?
+* rank vs rank — is the multi-controller program deterministic?
+* run vs run — did a code change alter the trajectory, and where?
+
+Verdicts:
+
+* **bit-clean** — every common tensor's digest matches at every common
+  step (exit 0);
+* **within tolerance** — digests differ (an unfused-vs-fused pair
+  rarely stays bit-identical) but every stat agrees within ``--rtol``;
+  the first bit divergence is reported for reference (exit 0, or 1
+  under ``--strict-bits``);
+* **DIVERGED** — a stat differs beyond ``--rtol``: the first
+  (step, tensor, stat, a, b, relative error) is printed and the exit
+  code is 1 — that step/tensor is where to start bisecting.
+
+Stdlib-only (the ledger reader half of numerics.py is loaded by file
+path), so it runs on a supervisor host with no jax installed.
+
+Usage::
+
+    python tools/numdiff.py RUN_A.ledger RUN_B.ledger
+    python tools/numdiff.py a.ledger b.ledger --rtol 1e-6 --json
+    python tools/numdiff.py a.ledger b.ledger --strict-bits
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def load_numerics():
+    """Load the ledger reader half of telemetry/numerics.py by file
+    path (no framework import — the distview reader pattern)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "telemetry",
+                        "numerics.py")
+    spec = importlib.util.spec_from_file_location("mxtpu_numerics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def format_report(result, path_a, path_b):
+    """The comparison verdict as printable lines."""
+    lines = []
+    lines.append("numdiff: %s  vs  %s" % (path_a, path_b))
+    lines.append(
+        "  steps compared:   %d   tensors compared: %d"
+        % (result["steps_compared"], result["tensors_compared"]))
+    if result["only_a"] or result["only_b"]:
+        lines.append(
+            "  uncompared:       %d tensor(s) only in A, %d only in B "
+            "(e.g. block/* entries a fused run adds)"
+            % (result["only_a"], result["only_b"]))
+    div = result["divergence"]
+    if div is not None:
+        lines.append(
+            "  DIVERGED at step %d, tensor %r: %s A=%g B=%g "
+            "(relative error %g)"
+            % (div["step"], div["tensor"], div["stat"], div["a"],
+               div["b"], div["rel"]))
+        return lines
+    if result["bit_clean"]:
+        lines.append("  verdict:          bit-clean (every common "
+                     "tensor digest identical)")
+        return lines
+    fb = result["first_bit_divergence"]
+    lines.append(
+        "  verdict:          within tolerance; first bit divergence "
+        "at step %d, tensor %r" % (fb["step"], fb["tensor"]))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="numdiff")
+    ap.add_argument("ledger_a", help="numerics ledger A "
+                    "(MXNET_TPU_NUMERICS_LEDGER output, or a telemetry "
+                    "JSONL carrying inline numerics records)")
+    ap.add_argument("ledger_b", help="numerics ledger B")
+    ap.add_argument("--rtol", type=float, default=1e-4,
+                    help="relative stat tolerance before a tensor "
+                         "counts as diverged (default 1e-4)")
+    ap.add_argument("--atol", type=float, default=1e-9,
+                    help="absolute floor for the relative comparison")
+    ap.add_argument("--strict-bits", action="store_true",
+                    help="exit 1 on ANY digest mismatch, even within "
+                         "tolerance (reshard/determinism audits)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison dict as JSON")
+    args = ap.parse_args(argv)
+
+    num = load_numerics()
+    try:
+        recs_a = num.read_ledger(args.ledger_a)
+        recs_b = num.read_ledger(args.ledger_b)
+    except ValueError as e:
+        print("numdiff: %s" % e, file=sys.stderr)
+        return 2
+    result = num.compare_ledgers(recs_a, recs_b, rtol=args.rtol,
+                                 atol=args.atol)
+    if result["steps_compared"] == 0:
+        print("numdiff: the ledgers share no step numbers (A: %d "
+              "record(s), B: %d) — nothing to compare"
+              % (len(recs_a), len(recs_b)), file=sys.stderr)
+        return 2
+    if args.json:
+        result = dict(result, rtol=args.rtol, atol=args.atol,
+                      ledger_a=args.ledger_a, ledger_b=args.ledger_b)
+        print(json.dumps(result, indent=1, sort_keys=True))
+    else:
+        print("\n".join(format_report(result, args.ledger_a,
+                                      args.ledger_b)))
+    if result["divergence"] is not None:
+        return 1
+    if args.strict_bits and not result["bit_clean"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

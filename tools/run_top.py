@@ -80,6 +80,15 @@ def format_dashboard(records, summary, steps_shown=12):
                    str(summary["straggler"]), 0),
                summary.get("steps", 0),
                1e3 * summary.get("skew_max_s", 0.0)))
+    if summary.get("grad_skew_max") is not None or \
+            summary.get("digest_mismatch_steps"):
+        lines.append(
+            "numerics: peak cross-rank grad-norm skew %s%s"
+            % ("%g" % summary["grad_skew_max"]
+               if summary.get("grad_skew_max") is not None else "-",
+               "  [DIGEST MISMATCH in %d step(s)]"
+               % summary["digest_mismatch_steps"]
+               if summary.get("digest_mismatch_steps") else ""))
     lines.append("")
     lines.append("  step  p50 ms   max ms  worst  skew ms  ranks")
     for s in steps[-steps_shown:]:
@@ -94,15 +103,29 @@ def format_dashboard(records, summary, steps_shown=12):
                s.get("n_ranks", "?")))
     per_rank = summary.get("per_rank") or {}
     if per_rank:
+        # digest_last alone still shows the columns: an all-NaN run
+        # omits its (non-finite) grad norms from the step records but
+        # the digests — the evidence that ranks disagree — remain
+        has_num = any(pr.get("grad_norm_last") is not None
+                      or pr.get("digest_last") is not None
+                      for pr in per_rank.values())
         lines.append("")
         lines.append("  rank   p50 ms  total s  segments "
-                     "(#=compute i=input c=collective)")
+                     "(#=compute i=input c=collective)"
+                     + ("  grad norm    digest" if has_num else ""))
         for r in sorted(per_rank, key=lambda x: int(x)):
             pr = per_rank[r]
             seg = pr.get("segments_s") or {}
-            lines.append("  %4s %8.1f %8.2f  [%s]"
-                         % (r, 1e3 * pr.get("p50_s", 0.0),
-                            pr.get("total_s", 0.0), _bar(seg)))
+            line = ("  %4s %8.1f %8.2f  [%s]"
+                    % (r, 1e3 * pr.get("p50_s", 0.0),
+                       pr.get("total_s", 0.0), _bar(seg)))
+            if has_num:
+                gn = pr.get("grad_norm_last")
+                dg = pr.get("digest_last")
+                line += "  %9s %9s" % (
+                    "%.4g" % gn if gn is not None else "-",
+                    "%08x" % dg if dg is not None else "-")
+            lines.append(line)
     if events:
         lines.append("")
         lines.append("events:")
@@ -133,12 +156,26 @@ def format_summary(summary):
         lines.append("  straggler:      none identified")
     lines.append("  peak skew:      %.3f ms"
                  % (1e3 * summary.get("skew_max_s", 0.0)))
+    if summary.get("grad_skew_max") is not None or \
+            summary.get("digest_mismatch_steps"):
+        lines.append("  grad-norm skew: %s peak across ranks%s"
+                     % ("%g" % summary["grad_skew_max"]
+                        if summary.get("grad_skew_max") is not None
+                        else "-",
+                        "  [DIGEST MISMATCH in %d step(s)]"
+                        % summary["digest_mismatch_steps"]
+                        if summary.get("digest_mismatch_steps") else
+                        ""))
     for r in sorted(summary.get("per_rank") or {}, key=int):
         pr = summary["per_rank"][r]
         seg = pr.get("segments_s") or {}
         seg_txt = "  ".join("%s=%.3fs" % (k, seg[k])
                             for k in ("compute", "input_wait",
                                       "collective_wait") if k in seg)
+        if pr.get("grad_norm_last") is not None:
+            seg_txt += "  grad_norm=%.4g" % pr["grad_norm_last"]
+        if pr.get("digest_last") is not None:
+            seg_txt += "  digest=%08x" % pr["digest_last"]
         lines.append("  rank %-3s p50=%.1fms max=%.1fms total=%.2fs  %s"
                      % (r, 1e3 * pr.get("p50_s", 0.0),
                         1e3 * pr.get("max_s", 0.0),
