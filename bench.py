@@ -144,6 +144,15 @@ def main():
         fusion_info["summary"] = trainer.fusion_summary()
         fusion_info["step_program_eqns"] = _step_program_eqns(
             trainer, batch_dict)
+        if fuse_blocks:
+            # plan-search A/B (analysis.plansearch): search the whole-
+            # graph fusion/layout plan under a tiny budget, measure the
+            # searched winner against greedy for real (same step fn,
+            # same inputs), commit it to the tuning cache, and embed
+            # the searched-vs-greedy step-wall A/B.  A pre-committed
+            # entry reports as a pure cache hit (zero search).
+            fusion_info["plansearch"] = _plansearch_ab(
+                models, batch)
         _emit({
             "metric": "dryrun_mlp_train_samples_per_sec_per_chip",
             "value": round(steps * batch / dt / n_dev, 2),
@@ -235,6 +244,27 @@ def main():
         "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_S, 3),
     }, fusion={"enabled": fuse_blocks,
                "summary": trainer.fusion_summary()})
+
+
+def _plansearch_ab(models, batch):
+    """The dry-run plan-search leg: tiny-budget whole-graph plan search
+    on the dry-run MLP with the searched-vs-greedy predicted AND
+    measured step walls — the BENCH JSON A/B evidence for ROADMAP
+    item 3 (the committed winner is never worse than greedy on the
+    measured run by construction; see analysis.plansearch).  Never
+    raises — a search failure reports as an error field."""
+    try:
+        from mxnet_tpu.analysis import plansearch
+        doc = plansearch.search_and_commit(
+            models.get_model("mlp", num_classes=10),
+            {"data": (batch, 64), "softmax_label": (batch,)},
+            layout="NCHW", budget=12, beam=4, topk=2, repeats=2)
+        return {k: doc.get(k) for k in (
+            "graph", "plan_id", "cached", "searched", "measured",
+            "predicted_s", "greedy_predicted_s", "wall_s",
+            "greedy_wall_s", "candidates")}
+    except Exception as e:  # mxlint: allow-broad-except(the plan-search leg is bench evidence, not the benchmark; a failure must not kill the artifact)
+        return {"error": str(e)[:200]}
 
 
 def _step_program_eqns(trainer, batch_dict):

@@ -24,13 +24,15 @@ from .verifier import (Diagnostic, Report, verify_symbol, verify_json,
                        verify_model, infer_node_shapes)
 from . import fusion
 from . import perf
+from . import plansearch
 from .fusion import plan_block_fusion, last_plan_summary
 from .perf import check_predicted_slow
 
 __all__ = ["Diagnostic", "Report", "verify_symbol", "verify_json",
            "verify_model", "infer_node_shapes", "load_mxlint",
-           "registry_selfcheck", "fusion", "perf", "plan_block_fusion",
-           "last_plan_summary", "check_predicted_slow"]
+           "registry_selfcheck", "fusion", "perf", "plansearch",
+           "plan_block_fusion", "last_plan_summary",
+           "check_predicted_slow"]
 
 
 def registry_selfcheck():

@@ -9,6 +9,11 @@ Usage::
     python -m mxnet_tpu.analysis --model resnet50 --model mlp [--tp 8]
     python -m mxnet_tpu.analysis --model all
 
+    # MXG010 for the COMMITTED fusion/layout plan (plansearch cache
+    # entry under MXNET_TPU_TUNE_CACHE; greedy on miss)
+    python -m mxnet_tpu.analysis --model resnet50 --cost-model m.json \
+        --plan [--layout NHWC]
+
     # run the TPU-hazard source linter (tools/mxlint.py rules)
     python -m mxnet_tpu.analysis --lint mxnet_tpu/ tools/ examples/
 
@@ -63,7 +68,20 @@ def main(argv=None):
                     help="MXG010 threshold: flag nodes predicted "
                          "slower than this multiple of their "
                          "roofline-attainable time (default 3.0)")
+    ap.add_argument("--plan", action="store_true",
+                    help="MXG010 plan mode (needs --cost-model): "
+                         "predict the COMMITTED fusion/layout plan — "
+                         "the graph_plan tuning-cache entry under "
+                         "MXNET_TPU_TUNE_CACHE, greedy on miss — "
+                         "instead of the default per-node lowering")
+    ap.add_argument("--layout", default="NCHW",
+                    choices=("NCHW", "NHWC"),
+                    help="trace layout the --plan lookup is keyed by "
+                         "(default NCHW)")
     args = ap.parse_args(argv)
+
+    if args.plan and not args.cost_model:
+        ap.error("--plan needs --cost-model (the MXG010 predictor)")
 
     if not (args.json or args.model or args.registry
             or args.lint is not None):
@@ -90,7 +108,9 @@ def main(argv=None):
         _net, report = verify_model(name, batch=args.batch,
                                     tp_size=args.tp,
                                     cost_model=args.cost_model,
-                                    slow_factor=args.slow_factor)
+                                    slow_factor=args.slow_factor,
+                                    plan=args.plan,
+                                    plan_layout=args.layout)
         print("model %-20s %s" % (name, report))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)
@@ -106,7 +126,8 @@ def main(argv=None):
                                        else (shapes["data"][0],))
         report = verify_json(js, shapes=shapes or None, tp_size=args.tp,
                              cost_model=args.cost_model,
-                             slow_factor=args.slow_factor)
+                             slow_factor=args.slow_factor,
+                             plan=args.plan, plan_layout=args.layout)
         print("%s: %s" % (path, report))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)

@@ -47,24 +47,104 @@ active it keeps its claims; this pass fuses everything else.
 """
 from __future__ import annotations
 
+import hashlib
+import json
+
 __all__ = ["FusedBlock", "FusionPlan", "plan_block_fusion",
-           "apply_block", "last_plan_summary", "FC_FUSABLE_ACTS"]
+           "apply_block", "last_plan_summary", "FC_FUSABLE_ACTS",
+           "graph_digest", "decisions_id", "plan_decisions",
+           "active_decisions", "CHAIN_CHOICES"]
 
 FC_FUSABLE_ACTS = ("relu", "sigmoid", "tanh")
+
+#: per-chain-kind decision alternatives the plan search explores
+#: (analysis.plansearch).  "fuse" is the greedy behavior; "conv_bn" /
+#: "bn_act" split a conv_bn_act chain at its BN boundary; "off" leaves
+#: the whole chain unfused.
+CHAIN_CHOICES = {
+    "conv_bn_act": ("fuse", "conv_bn", "bn_act", "off"),
+    "conv_bn": ("fuse", "off"),
+    "bn_act": ("fuse", "off"),
+    "fc_act": ("fuse", "off"),
+}
 
 # summary of the most recent recorded plan (bench.py / fit.py surface
 # it; plans are computed at trace time inside jit, so a module-level
 # snapshot is the only host-side handle)
 _LAST_SUMMARY = None
 
+# the active plan-decision overrides (analysis.plansearch): tri-state
+# like ops.fused's trace flags — None means "greedy", a dict is the
+# searched decision vector a committed graph_plan cache entry carries.
+# Executor/ShardedTrainer enter the context around every eval_graph
+# trace so forward, backward, and the fused step lower identically.
+_DECISIONS = {"v": None}
+
+
+class plan_decisions:
+    """Context manager activating a plan-decision vector for the traces
+    inside it (``None``/``{}`` -> the greedy plan).  See
+    docs/api/plansearch.md for the decision schema."""
+
+    def __init__(self, decisions):
+        self.decisions = decisions
+
+    def __enter__(self):
+        self._prev = _DECISIONS["v"]
+        _DECISIONS["v"] = self.decisions
+        return self
+
+    def __exit__(self, *exc):
+        _DECISIONS["v"] = self._prev
+
+
+def active_decisions():
+    """The decision vector the current trace context activated, or
+    None (greedy)."""
+    return _DECISIONS["v"]
+
+
+def graph_digest(topo, entries):
+    """Stable 12-hex identity of the graph STRUCTURE — op names, attrs,
+    input wiring, and head entries; node *names* excluded, so two
+    processes (or two builds in one process, whose auto-naming counters
+    differ) constructing the same architecture share one digest.  The
+    plan-search tuning-cache entries (``analysis.plansearch``) are
+    keyed by it, together with mesh + backend."""
+    idx = {id(n): i for i, n in enumerate(topo)}
+    items = []
+    for n in topo:
+        if n.is_variable:
+            items.append("var")
+            continue
+        items.append([
+            n.op.name,
+            sorted((str(k), repr(v)) for k, v in n.attrs.items()),
+            [[idx[id(src)], int(i)] for (src, i) in n.inputs],
+        ])
+    items.append([[idx[id(n)], int(i)] for (n, i) in entries])
+    blob = json.dumps(items, sort_keys=True, default=repr)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def decisions_id(decisions):
+    """Short identity of one decision vector ("greedy" for the empty /
+    absent one) — the plan identity costdb records and flight events
+    carry."""
+    if not decisions:
+        return "greedy"
+    blob = json.dumps(decisions, sort_keys=True, default=repr)
+    return "plan-" + hashlib.sha1(blob.encode("utf-8")).hexdigest()[:10]
+
 
 class FusedBlock:
     """One matched chain: the member nodes and how to emit them."""
     __slots__ = ("kind", "terminal", "conv", "bn", "fc", "act", "pallas",
-                 "layout")
+                 "layout", "chain", "graph", "plan_id")
 
     def __init__(self, kind, terminal, conv=None, bn=None, fc=None,
-                 act=None, pallas=False, layout="NCHW"):
+                 act=None, pallas=False, layout="NCHW", chain=None,
+                 graph=None, plan_id=None):
         self.kind = kind
         self.terminal = terminal      # the node whose value the region yields
         self.conv = conv
@@ -73,6 +153,9 @@ class FusedBlock:
         self.act = act                # act_type string or None
         self.pallas = bool(pallas)
         self.layout = layout
+        self.chain = chain            # stable chain id (greedy-terminal
+        self.graph = graph            # topo index), graph digest, and
+        self.plan_id = plan_id        # plan identity, for costdb/cache
 
     @property
     def name(self):
@@ -89,25 +172,40 @@ class FusionPlan:
     """The pass output: blocks keyed by terminal node id, the interior
     node-id skip set, fallback records, and the layout plan."""
 
-    def __init__(self, layout, is_train):
+    def __init__(self, layout, is_train, decisions=None, graph=None):
         self.layout = layout
         self.is_train = bool(is_train)
+        self.decisions = decisions    # plan-search overrides (or None)
+        self.graph = graph            # graph digest (None when unhashed)
+        self.plan_id = decisions_id(decisions)
         self.blocks = {}          # id(terminal) -> FusedBlock
         self.skip = set()         # interior node ids
         self.fallbacks = []       # (node_name, reason)
         self.interior_edges = 0   # relayout boundaries removed in-block
         self.adjacent_edges = 0   # same-layout block-to-block boundaries
+        self.relayout_edges_added = 0  # explicit boundary transposes a
+        self.overrides = 0             # layout override inserts (2/block)
 
     @property
     def relayouts_eliminated(self):
         return self.interior_edges + self.adjacent_edges
 
     def add(self, block):
+        block.graph = self.graph
+        block.plan_id = self.plan_id
         self.blocks[id(block.terminal)] = block
         interior = block.interior()
         for n in interior:
             self.skip.add(id(n))
         self.interior_edges += len(interior)
+        if block.kind != "fc_act" and block.layout != self.layout:
+            # an overridden-layout region transposes its input in and
+            # its output back out (apply_block) — 2 explicit relayouts.
+            # Plan-time accounting is shape-free, so this is an upper
+            # bound: a non-4d activation transposes nothing (the
+            # search never offers layout moves for those — plansearch.
+            # chain_moves filters on the inferred shapes)
+            self.relayout_edges_added += 2
 
     def fallback(self, node, reason):
         self.fallbacks.append((node.name, reason))
@@ -127,7 +225,12 @@ class FusionPlan:
             "pallas_blocks": sum(1 for b in self.blocks.values()
                                  if b.pallas),
             "relayouts_eliminated": self.relayouts_eliminated,
+            "relayout_edges_added": self.relayout_edges_added,
             "fallbacks": reasons,
+            "graph": self.graph,
+            "plan_id": self.plan_id,
+            "searched": bool(self.decisions),
+            "overrides": self.overrides,
         }
 
 
@@ -181,18 +284,84 @@ def _conv_fusable(conv, layout, plan, claimed):
     return True
 
 
+def _pallas_eligible(blk, is_train):
+    """Pallas eligibility of a (possibly decision-transformed) block:
+    the matmul-with-stats kernel needs an eligible 1x1 conv head, NHWC
+    region layout, and train-mode BN statistics."""
+    if blk.conv is None or blk.bn is None \
+            or blk.kind not in ("conv_bn", "conv_bn_act"):
+        return False
+    from ..ops import fused as _fused
+    return bool(_fused._conv_eligible(blk.conv) and blk.layout == "NHWC"
+                and is_train
+                and not blk.bn.attrs.get("use_global_stats"))
+
+
+def _apply_decision(blk, cid, decisions, plan, is_train):
+    """Transform one greedy-matched block by the plan-search decision
+    vector (``decisions``): per-chain fuse/split/off, per-region
+    layout, and a per-block Pallas veto.  ``cid`` is the chain's
+    stable id (the GREEDY terminal's topo index, as a string) — the
+    key every committed ``graph_plan`` cache entry uses.  Returns the
+    block to plan (possibly a shorter chain) or None (chain unfused).
+    Unknown/ineligible choices read as "fuse" — a stale entry must
+    degrade, never break a trace."""
+    if not decisions:
+        blk.chain = cid
+        return blk
+    choice = str((decisions.get("chains") or {}).get(cid, "fuse"))
+    if choice not in CHAIN_CHOICES.get(blk.kind, ("fuse",)):
+        choice = "fuse"
+    if choice == "off":
+        plan.overrides += 1
+        return None
+    if choice == "conv_bn" and blk.kind == "conv_bn_act":
+        blk = FusedBlock("conv_bn", terminal=blk.bn, conv=blk.conv,
+                         bn=blk.bn, act=None, layout=blk.layout)
+        # the split block keeps the Pallas leg a naturally-matched
+        # conv_bn chain would get — a split must not silently lose
+        # the kernel that is its main perf lever
+        blk.pallas = _pallas_eligible(blk, is_train)
+        plan.overrides += 1
+    elif choice == "bn_act" and blk.kind == "conv_bn_act":
+        blk = FusedBlock("bn_act", terminal=blk.terminal, bn=blk.bn,
+                         act=blk.act, layout=blk.layout)
+        plan.overrides += 1
+    layout = (decisions.get("layouts") or {}).get(cid)
+    if layout in ("NCHW", "NHWC") and layout != blk.layout \
+            and blk.kind != "fc_act":
+        blk.layout = layout
+        plan.overrides += 1
+        # eligibility follows the REGION layout (an NHWC override in
+        # an NCHW trace can open the Pallas leg; the reverse closes it)
+        blk.pallas = _pallas_eligible(blk, is_train)
+    veto = (decisions.get("pallas") or {}).get(cid)
+    if veto is not None and not veto and blk.pallas:
+        blk.pallas = False
+        plan.overrides += 1
+    blk.chain = cid
+    return blk
+
+
 def plan_block_fusion(topo, entries, layout="NCHW", is_train=True,
-                      exclude=(), record=True):
+                      exclude=(), record=True, decisions=None):
     """Match fusable chains over ``topo`` and return a
     :class:`FusionPlan`.  ``exclude``: node ids already claimed by
     another trace-time pass (conv1x1+BN, stem s2d, dX elision) — chains
     touching them fall back.  ``record`` emits the ``mxtpu_fusion_*``
-    metrics and a ``fusion_plan`` flight event (one per trace)."""
-    plan = FusionPlan(layout, is_train)
+    metrics and a ``fusion_plan`` flight event (one per trace).
+    ``decisions``: plan-search overrides (analysis.plansearch; default:
+    the :class:`plan_decisions` context, i.e. the committed cache
+    entry Executor/ShardedTrainer activated — None means greedy)."""
+    if decisions is None:
+        decisions = active_decisions()
+    digest = graph_digest(topo, entries) if (record or decisions) \
+        else None
+    plan = FusionPlan(layout, is_train, decisions=decisions,
+                      graph=digest)
     consumers = _consumers(topo, entries)
     claimed = set(exclude)
-
-    from ..ops import fused as _fused
+    topo_index = {id(n): i for i, n in enumerate(topo)}
 
     def conv_chain(bn, act_node, act_type):
         """Try conv->bn(->act); returns the block or None."""
@@ -205,14 +374,13 @@ def plan_block_fusion(topo, entries, layout="NCHW", is_train=True,
             return None
         if not _conv_fusable(src, layout, plan, claimed):
             return None
-        pallas = (_fused._conv_eligible(src) and layout == "NHWC"
-                  and is_train and not bn.attrs.get("use_global_stats"))
-        return FusedBlock("conv_bn_act" if act_node is not None
-                          else "conv_bn",
-                          terminal=act_node if act_node is not None
-                          else bn,
-                          conv=src, bn=bn, act=act_type, pallas=pallas,
-                          layout=layout)
+        blk = FusedBlock("conv_bn_act" if act_node is not None
+                         else "conv_bn",
+                         terminal=act_node if act_node is not None
+                         else bn,
+                         conv=src, bn=bn, act=act_type, layout=layout)
+        blk.pallas = _pallas_eligible(blk, is_train)
+        return blk
 
     for node in topo:
         if node.is_variable or node.op is None or id(node) in claimed:
@@ -255,6 +423,12 @@ def plan_block_fusion(topo, entries, layout="NCHW", is_train=True,
                 continue
             blk = conv_chain(node, None, None)
         if blk is not None:
+            # the chain id is the GREEDY terminal's topo position, so a
+            # committed decision vector survives rebuilds whose auto-
+            # generated node names differ
+            blk = _apply_decision(blk, str(topo_index[id(node)]),
+                                  decisions, plan, is_train)
+        if blk is not None:
             # a block's members must not collide with earlier claims
             members = blk.interior() + [blk.terminal]
             if any(id(m) in plan.skip or id(m) in plan.blocks
@@ -262,13 +436,27 @@ def plan_block_fusion(topo, entries, layout="NCHW", is_train=True,
                 continue
             plan.add(blk)
 
-    # layout plan: adjacent fused regions sharing a boundary keep one
-    # pinned layout — no relayout between them
-    terminal_layout = {tid: b.layout for tid, b in plan.blocks.items()}
+    # layout plan: adjacent fused regions sharing an IMAGE-layout
+    # boundary keep one pinned layout — no relayout between them.  The
+    # credit needs image activations on BOTH sides: an fc_act block
+    # neither carries an image layout out (its terminal is a 2-d
+    # activation) nor reads one in (FullyConnected flattens its input,
+    # paying that materialization regardless of any pinning), so FC
+    # boundaries never count — crediting them overstated the
+    # mxtpu_fusion_relayouts_eliminated_total metric.  Both sides must
+    # also sit in the AMBIENT layout: an overridden-layout region
+    # round-trips through the ambient layout at every boundary
+    # (apply_block), so two adjacent NHWC-overridden regions in an
+    # NCHW trace still pay their transposes — their boundary
+    # eliminates nothing (relayout_edges_added counts what they pay).
+    image_terminal = {tid: b.layout for tid, b in plan.blocks.items()
+                      if b.kind != "fc_act"}
     for blk in plan.blocks.values():
-        first = blk.conv or blk.fc or blk.bn
+        if blk.fc is not None or blk.layout != plan.layout:
+            continue
+        first = blk.conv or blk.bn
         src, _idx = first.inputs[0]
-        if terminal_layout.get(id(src)) == blk.layout:
+        if image_terminal.get(id(src)) == plan.layout:
             plan.adjacent_edges += 1
 
     if record:
@@ -309,16 +497,37 @@ def last_plan_summary():
     return _LAST_SUMMARY
 
 
+def _relayout(x, dst_layout):
+    """Explicit boundary transpose into ``dst_layout`` for a 4-d image
+    activation (the relayout edge an overridden-layout region pays —
+    plan.relayout_edges_added counts them, and the plan-search
+    objective costs them at peak bandwidth)."""
+    if x is None or getattr(x, "ndim", 0) != 4:
+        return x
+    import jax.numpy as jnp
+    return jnp.transpose(x, (0, 2, 3, 1) if dst_layout == "NHWC"
+                         else (0, 3, 1, 2))
+
+
 def apply_block(blk, vals, is_train):
     """Evaluate one planned block from the eval_graph value map.
     Returns (out, bn_node_or_None, [new_mm, new_mv] or None); the
     caller threads the BN aux updates exactly as the unfused op would.
+
+    A block whose ``layout`` differs from the ambient trace layout (a
+    plan-search per-region override) transposes its image activation
+    into the region layout on entry and back on exit — the weight path
+    is layout-independent (reference OIHW, dimension numbers derived
+    inside the region).
     """
     from ..ops import fused as _fused
+    from ..ops.nn import current_image_layout
 
     def val(node, slot):
         src, idx = node.inputs[slot]
         return vals[id(src)][idx]
+
+    ambient = current_image_layout()
 
     if blk.kind in ("conv_bn_act", "conv_bn"):
         conv, bn = blk.conv, blk.bn
@@ -326,6 +535,8 @@ def apply_block(blk, vals, is_train):
         b = None if conv.attrs.get("no_bias") else val(conv, 2)
         gamma, beta = val(bn, 1), val(bn, 2)
         mm, mv = val(bn, 3), val(bn, 4)
+        if blk.layout != ambient:
+            x = _relayout(x, blk.layout)
         pallas = _tuned_pallas(blk, x, w)
         out, new_mm, new_mv = _fused.fused_block_conv_bn_act(
             conv.attrs, bn.attrs, blk.layout, is_train, blk.act,
@@ -335,16 +546,22 @@ def apply_block(blk, vals, is_train):
         # planner's pre-veto choice
         _note_block_cost(blk, out, x, w, pallas=pallas)
         _note_block_numerics(blk, out)
+        if blk.layout != ambient:
+            out = _relayout(out, ambient)
         return out, bn, [new_mm, new_mv]
     if blk.kind == "bn_act":
         bn = blk.bn
         x = val(bn, 0)
+        if blk.layout != ambient:
+            x = _relayout(x, blk.layout)
         ch = 3 if (blk.layout == "NHWC" and x.ndim == 4) else 1
         out, new_mm, new_mv = _fused.fused_block_bn_act(
             bn.attrs, ch, is_train, blk.act, x, val(bn, 1), val(bn, 2),
             val(bn, 3), val(bn, 4))
         _note_block_cost(blk, out, x, None)
         _note_block_numerics(blk, out)
+        if blk.layout != ambient:
+            out = _relayout(out, ambient)
         return out, bn, [new_mm, new_mv]
     if blk.kind == "fc_act":
         fc = blk.fc
@@ -434,7 +651,7 @@ def _note_block_cost(blk, out, x, w, pallas=None):
         costdb.note_block(
             blk.name, blk.kind, shapes, dtypes, flops=flops,
             bytes_accessed=bytes_, layout=blk.layout,
-            pallas=pallas)
+            pallas=pallas, graph=blk.graph, plan=blk.plan_id)
     except MemoryError:  # pragma: no cover - never mask resource exhaustion
         raise
     except Exception:  # mxlint: allow-broad-except(cost-signature capture is observability inside a jit trace; any failure must not fail the compile)

@@ -21,7 +21,8 @@ walls; fit and check against the same peak table
 """
 from __future__ import annotations
 
-__all__ = ["node_cost_estimate", "check_predicted_slow"]
+__all__ = ["node_cost_estimate", "check_predicted_slow",
+           "check_predicted_plan"]
 
 #: ops the analytic estimator covers; everything else is skipped (an
 #: elementwise op's wall is noise next to the convs/GEMMs MXG010 hunts)
@@ -130,4 +131,57 @@ def check_predicted_slow(topo, structs, cost_model, factor=3.0,
                 % (predicted * 1e3, attainable * 1e3,
                    predicted / attainable, factor),
                 node=node.name, op=node.op.name)
+    return report
+
+
+def check_predicted_plan(topo, entries, structs, cost_model, factor=3.0,
+                         report=None, layout="NCHW", mesh=None):
+    """MXG010 ``--plan`` mode: predictions for the **committed** plan
+    rather than the default lowering.  The graph's ``graph_plan``
+    tuning-cache entry (``analysis.plansearch``; greedy plan on miss)
+    is built exactly as bind time would dispatch it, every costed
+    unit — fused blocks with their analytic flops/bytes, unfused
+    heavies, explicit boundary relayouts of overridden-layout regions
+    — is predicted with ``cost_model``, and units whose predicted wall
+    exceeds ``factor`` x their roofline-attainable time are reported
+    with the plan identity alongside, so a slow prediction names the
+    plan that owns it."""
+    from ..autotune import model as _model
+    from . import fusion as _fusion
+    from . import plansearch as _plansearch
+    from .verifier import Report
+
+    report = report if report is not None else Report()
+    model = _model.load_model(cost_model)
+    factor = float(factor)
+    decisions = _plansearch.committed_decisions(topo, entries, layout,
+                                                mesh=mesh)
+    plan = _fusion.plan_block_fusion(topo, entries, layout=layout,
+                                     record=False,
+                                     decisions=dict(decisions)
+                                     if decisions else {})
+    node_shapes = {}
+    for nid, sts in structs.items():
+        if sts is None:
+            continue
+        node_shapes[nid] = tuple(tuple(int(d) for d in st.shape)
+                                 for st in sts)
+    _total, units = _plansearch.predict_plan_wall(
+        topo, entries, plan, node_shapes, model=model)
+    source = "searched" if decisions else "greedy"
+    for u in units:
+        att = u["attainable_s"]
+        predicted = (u["predicted_s"] or 0.0) + (u["relayout_s"] or 0.0)
+        if not att or not predicted:
+            continue
+        if predicted > factor * att:
+            report.add(
+                "MXG010", "warning",
+                "committed plan %s (%s): cost model predicts %.3g ms "
+                "against a roofline-attainable %.3g ms (%.1fx > the "
+                "%.1fx budget) for this %s — candidate for plan "
+                "re-search (tools/plan_search.py) or kernel tuning"
+                % (plan.plan_id, source, predicted * 1e3, att * 1e3,
+                   predicted / att, factor, u["unit"]),
+                node=u["name"], op=u["kind"])
     return report

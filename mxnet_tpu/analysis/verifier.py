@@ -461,7 +461,7 @@ def _registry_diagnostics(report):
 
 def verify_symbol(sym, shapes=None, types=None, tp_size=1,
                   check_registry=False, report=None, cost_model=None,
-                  slow_factor=3.0):
+                  slow_factor=3.0, plan=False, plan_layout="NCHW"):
     """Verify a Symbol graph; returns a :class:`Report`.
 
     ``shapes``: {input_name: shape} (same keys as ``infer_shape`` kwargs;
@@ -473,7 +473,10 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
     ``mxnet_tpu.autotune.CostModel`` or a saved-model path)
     additionally runs MXG010: nodes whose predicted wall exceeds their
     roofline-attainable time by more than ``slow_factor`` are named
-    before any compile (:mod:`.perf`).
+    before any compile (:mod:`.perf`).  ``plan=True`` switches MXG010
+    to plan mode: predictions for the COMMITTED fusion/layout plan
+    (the ``graph_plan`` tuning-cache entry at ``plan_layout``; greedy
+    on miss) instead of the default per-node lowering.
     """
     report = report if report is not None else Report()
     shapes = dict(shapes or {})
@@ -503,9 +506,15 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
     if tp_size and tp_size > 1:
         _check_tp_coverage(topo, arg_shapes, tp_size, report)
     if cost_model is not None:
-        from .perf import check_predicted_slow
-        check_predicted_slow(topo, structs, cost_model,
-                             factor=slow_factor, report=report)
+        if plan:
+            from .perf import check_predicted_plan
+            check_predicted_plan(topo, sym._entries, structs,
+                                 cost_model, factor=slow_factor,
+                                 report=report, layout=plan_layout)
+        else:
+            from .perf import check_predicted_slow
+            check_predicted_slow(topo, structs, cost_model,
+                                 factor=slow_factor, report=report)
     return report
 
 
@@ -530,7 +539,7 @@ def infer_node_shapes(sym, shapes=None, types=None):
 
 def verify_json(json_str, shapes=None, types=None, tp_size=1,
                 check_registry=False, cost_model=None,
-                slow_factor=3.0):
+                slow_factor=3.0, plan=False, plan_layout="NCHW"):
     """Verify a serialized symbol (the reference JSON graph layout).
 
     Runs every :func:`verify_symbol` check *plus* true dead-node
@@ -579,7 +588,8 @@ def verify_json(json_str, shapes=None, types=None, tp_size=1,
         return report
     return verify_symbol(sym, shapes=shapes, types=types, tp_size=tp_size,
                          check_registry=check_registry, report=report,
-                         cost_model=cost_model, slow_factor=slow_factor)
+                         cost_model=cost_model, slow_factor=slow_factor,
+                         plan=plan, plan_layout=plan_layout)
 
 
 # default verification inputs per model-zoo entry: (data kwargs)
@@ -591,10 +601,12 @@ _DEFAULT_IMAGE = {"data": (2, 3, 224, 224)}
 
 
 def verify_model(name, batch=2, tp_size=1, num_classes=10,
-                 cost_model=None, slow_factor=3.0, **model_kwargs):
+                 cost_model=None, slow_factor=3.0, plan=False,
+                 plan_layout="NCHW", **model_kwargs):
     """Build a model-zoo symbol and verify it with its canonical input
     shape.  Returns (symbol, Report).  ``cost_model`` additionally
-    runs the MXG010 predicted-slow check (:mod:`.perf`)."""
+    runs the MXG010 predicted-slow check (:mod:`.perf`); ``plan=True``
+    switches it to committed-plan mode."""
     from .. import models
     net = models.get_model(name, num_classes=num_classes, **model_kwargs)
     shapes = dict(_MODEL_SHAPES.get(name, _DEFAULT_IMAGE))
@@ -602,4 +614,5 @@ def verify_model(name, batch=2, tp_size=1, num_classes=10,
     shapes["softmax_label"] = (batch,)
     return net, verify_symbol(net, shapes=shapes, tp_size=tp_size,
                               cost_model=cost_model,
-                              slow_factor=slow_factor)
+                              slow_factor=slow_factor, plan=plan,
+                              plan_layout=plan_layout)

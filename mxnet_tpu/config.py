@@ -212,6 +212,23 @@ _CATALOG = {
                              "measured-wall-wins so multi-host/multi-"
                              "run caches compose; tools/autotune.py "
                              "writes it"),
+    # whole-graph plan search (analysis.plansearch,
+    # docs/api/plansearch.md)
+    "MXNET_TPU_PLAN_SEARCH": ("cache", "honored",
+                              "bind-time graph_plan tuning-cache "
+                              "consult mode for Executor/"
+                              "ShardedTrainer: cache (committed "
+                              "searched plan wins, greedy fusion plan "
+                              "on miss — the default) or off (no "
+                              "lookup at all); searching itself is "
+                              "always explicit (tools/plan_search.py, "
+                              "ci_check stage 12, bench dry-run)"),
+    "MXNET_TPU_PLAN_BUDGET": ("64", "honored",
+                              "max candidate whole-graph plans the "
+                              "beam search scores with the learned "
+                              "cost model per search"),
+    "MXNET_TPU_PLAN_BEAM": ("8", "honored",
+                            "beam width of the plan search"),
     # training-health numerics (telemetry.numerics,
     # docs/api/telemetry.md)
     "MXNET_TPU_NUMERICS_EVERY": ("0", "honored",

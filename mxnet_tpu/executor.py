@@ -134,6 +134,21 @@ class Executor:
         # train path all lower through the same plan.
         from .ops import fused as _fused_mod
         self._block_fusion = _fused_mod.block_fusion_enabled()
+        # plan-search decisions (analysis.plansearch): an ambient
+        # plan_decisions context is captured like the fusion flag;
+        # otherwise the committed graph_plan tuning-cache entry for
+        # this graph (keyed by structural digest + trace layout +
+        # backend) is consulted ONCE here — a hit activates the
+        # searched plan around every trace below, a miss stays greedy
+        # with zero per-trace cost (MXNET_TPU_PLAN_SEARCH=off skips
+        # the lookup entirely).
+        from .analysis import fusion as _fusion_mod
+        self._plan_decisions = _fusion_mod.active_decisions()
+        if self._plan_decisions is None and self._block_fusion:
+            from .analysis import plansearch as _plansearch
+            from .ops.nn import current_image_layout
+            self._plan_decisions = _plansearch.committed_decisions(
+                self._topo, symbol._entries, current_image_layout())
 
         self._outputs = None
         self._last_key = None
@@ -195,11 +210,13 @@ class Executor:
         var_ids = self._var_ids()
 
         from .ops.fused import block_fusion
+        from .analysis.fusion import plan_decisions
 
         def raw(vals, key):
             var_values = dict(zip(var_ids, vals))
             bsz = vals[0].shape[0] if vals and vals[0].ndim else None
-            with block_fusion(self._block_fusion):
+            with block_fusion(self._block_fusion), \
+                    plan_decisions(self._plan_decisions):
                 heads, aux_updates = eval_graph(
                     topo, entries, var_values, is_train=is_train,
                     key=key, batch_size=bsz,
@@ -260,6 +277,7 @@ class Executor:
         head_is_loss = self._head_is_loss
 
         from .ops.fused import block_fusion
+        from .analysis.fusion import plan_decisions
 
         def raw(vals, key, out_grads):
             diff_vals = tuple(vals[i] for i in diff_idx)
@@ -270,7 +288,8 @@ class Executor:
                     full[i] = diff[j]
                 var_values = dict(zip(var_ids, full))
                 bsz = full[0].shape[0] if full and full[0].ndim else None
-                with block_fusion(self._block_fusion):
+                with block_fusion(self._block_fusion), \
+                        plan_decisions(self._plan_decisions):
                     heads, _aux = eval_graph(topo, entries, var_values,
                                              is_train=True, key=key,
                                              batch_size=bsz,
@@ -308,6 +327,7 @@ class Executor:
         n_args = len(self._arg_nodes)
 
         from .ops.fused import block_fusion
+        from .analysis.fusion import plan_decisions
 
         def raw(vals, key):
             diff_vals = tuple(vals[i] for i in diff_idx)
@@ -318,7 +338,8 @@ class Executor:
                     full[i] = diff[j]
                 var_values = dict(zip(var_ids, full))
                 bsz = full[0].shape[0] if full and full[0].ndim else None
-                with block_fusion(self._block_fusion):
+                with block_fusion(self._block_fusion), \
+                        plan_decisions(self._plan_decisions):
                     heads, aux_upd = eval_graph(
                         topo, entries, var_values, is_train=True,
                         key=key, batch_size=bsz,

@@ -309,6 +309,22 @@ class ShardedTrainer:
         self._topo = symbol._topo()
         if self._layout == "NHWC":
             self._check_nhwc_safe()
+        # plan-search decisions (analysis.plansearch): an ambient
+        # plan_decisions context wins; otherwise consult the committed
+        # graph_plan tuning-cache entry ONCE at construction — keyed by
+        # the graph's structural digest + trace layout + THIS mesh's
+        # axis sizes + backend — and activate it around every step
+        # trace, so a tuned plan is dispatched with zero search cost
+        # (greedy on miss, like kernel configs).  Pipeline stages never
+        # fuse (seeded partial topos), so the lookup is skipped there.
+        from ..analysis import fusion as _fusion_mod
+        self._plan_decisions = _fusion_mod.active_decisions()
+        if self._plan_decisions is None and self._fuse_blocks \
+                and self._pp <= 1:
+            from ..analysis import plansearch as _plansearch
+            self._plan_decisions = _plansearch.committed_decisions(
+                self._topo, symbol._entries, self._layout,
+                mesh=self._mesh_axis_sizes())
         arg_nodes, aux_nodes = _classify_vars(self._topo)
         self._arg_nodes, self._aux_nodes = arg_nodes, aux_nodes
         arg_names = [n.name for n in arg_nodes]
@@ -1101,11 +1117,13 @@ class ShardedTrainer:
                 from ..ops.fused import (conv_bn_fusion, stem_s2d,
                                          elide_input_grads, phase_bwd,
                                          conv1x1_dot, block_fusion)
+                from ..analysis.fusion import plan_decisions
                 from .sequence import sequence_parallel as seq_ctx
                 p = self._compute_view(p32, compute_dtype)
                 with image_layout(layout), \
                         conv_bn_fusion(self._fuse_conv_bn), \
                         block_fusion(self._fuse_blocks), \
+                        plan_decisions(self._plan_decisions), \
                         stem_s2d(self._stem_s2d), \
                         phase_bwd(self._phase_bwd), \
                         conv1x1_dot(self._conv1x1_dot), \
@@ -1871,6 +1889,7 @@ class ShardedTrainer:
 
             def fwd(params, aux, batch):
                 from ..ops.fused import block_fusion
+                from ..analysis.fusion import plan_decisions
                 from .sequence import sequence_parallel as seq_ctx
                 p = self._compute_view(params, compute_dtype)
                 bsz = next(iter(batch.values())).shape[0]
@@ -1885,6 +1904,7 @@ class ShardedTrainer:
                 # the region, so inference lowers through the same plan
                 with image_layout(layout), \
                         block_fusion(self._fuse_blocks), \
+                        plan_decisions(self._plan_decisions), \
                         seq_ctx(self.mesh if self._seq_parallel
                                 else None):
                     var_values = self._node_value_map(p, full, aux)

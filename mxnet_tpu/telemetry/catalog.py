@@ -151,6 +151,17 @@ CATALOG = {
                                     "(or triggered an inline search "
                                     "under MXNET_TPU_AUTOTUNE="
                                     "search)"),
+    # ------------------------------- plan search (analysis.plansearch)
+    "mxtpu_plan_cache_hit_total": (COUNTER, (),
+                                   "bind-time graph_plan tuning-cache "
+                                   "lookups answered by a committed "
+                                   "plan entry (analysis.plansearch; "
+                                   "the traces activate the searched "
+                                   "decision vector)"),
+    "mxtpu_plan_cache_miss_total": (COUNTER, (),
+                                    "bind-time graph_plan lookups that "
+                                    "fell back to the greedy fusion "
+                                    "plan (untuned graph/mesh/layout)"),
     # ---------------------------- elastic training (parallel.reshard)
     "mxtpu_reshard_total": (COUNTER, ("kind",),
                             "mesh reshapes performed (kind=load — a "

@@ -257,11 +257,15 @@ class CostDB:
     # ------------------------------------------------ trace-time notes
     def note_block(self, name, block_kind, shapes, dtypes, flops=None,
                    bytes_accessed=None, block_config=None, layout=None,
-                   pallas=False):
+                   pallas=False, graph=None, plan=None):
         """Register a fused block traced right now (pending until the
         surrounding program's dispatch binds it).  Called from
-        ``analysis.fusion.apply_block`` with trace-time shapes.  Never
-        raises — it runs inside a jit trace, which must not pay for
+        ``analysis.fusion.apply_block`` with trace-time shapes.
+        ``graph``/``plan``: the owning graph's structural digest and
+        the dispatched plan identity (``greedy`` or a searched
+        ``plan-*`` id) — ``tools/perf_top.py --suggest`` joins them
+        against the ``graph_plan`` tuning-cache entries.  Never raises
+        — it runs inside a jit trace, which must not pay for
         observability."""
         try:
             self._note({
@@ -275,6 +279,7 @@ class CostDB:
                 "block_config": dict(block_config) if block_config
                 else None,
                 "layout": layout, "pallas": bool(pallas),
+                "graph": graph, "plan": plan,
             })
         except MemoryError:  # pragma: no cover - never mask resource exhaustion
             raise
@@ -440,6 +445,7 @@ class CostDB:
                 block_kind=sig["block_kind"],
                 block_config=sig["block_config"],
                 layout=sig["layout"], pallas=sig["pallas"],
+                graph=sig.get("graph"), plan=sig.get("plan"),
                 source="span+roofline-attribution")
 
     # ------------------------------------------------------- records
@@ -448,13 +454,17 @@ class CostDB:
                leaves_digest=None,
                mesh=None, backend=None, program=None, block_kind=None,
                block_config=None, layout=None, pallas=None,
+               graph=None, plan=None,
                source="span"):
         """Upsert one aggregate record.  The record key is (kind, name,
         signature-hash of shapes/dtypes/mesh/backend/block config) —
         re-observations of the same key aggregate (count, min/mean
         wall) and the roofline fields are re-derived from the *minimum*
         observed wall (the least-noise estimate, the convention
-        benchmarking uses)."""
+        benchmarking uses).  ``graph``/``plan`` (block records) name
+        the owning graph digest and the dispatched fusion-plan
+        identity; the latest observation wins — they annotate, and do
+        not split, the record key."""
         backend = backend or backend_name()
         key_payload = {
             "shapes": [list(s) for s in shapes],
@@ -481,6 +491,7 @@ class CostDB:
                     "n_leaves": n_leaves,
                     "leaves_digest": leaves_digest,
                     "mesh": mesh, "backend": backend,
+                    "graph": graph, "plan": plan,
                     "count": 0, "wall_s": None, "mean_wall_s": None,
                     "total_wall_s": 0.0,
                     "flops": None, "bytes_accessed": None,
@@ -491,6 +502,10 @@ class CostDB:
                 rec["flops"] = float(flops)
             if bytes_accessed is not None:
                 rec["bytes_accessed"] = float(bytes_accessed)
+            if graph is not None:
+                rec["graph"] = graph
+            if plan is not None:
+                rec["plan"] = plan
             if program is not None:
                 rec["program"] = program
             rec["ts"] = round(time.time(), 6)

@@ -64,7 +64,7 @@ def numeric_grad(f, inputs, eps=1e-3):
 
 def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
                            atol=1e-3, eps=1e-3, sum_output=True,
-                           wrt=None):
+                           wrt=None, weighted=False):
     """Backward (autograd tape over the op) vs finite differences.
 
     Reference: test_utils.check_numeric_gradient — the primary operator test
@@ -74,11 +74,28 @@ def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
     all).  Index-like inputs (take/Embedding/gather indices) must be
     excluded — perturbing 2.0 by eps flips the truncated integer index,
     so their central difference is meaningless.
+
+    ``weighted``: use a fixed elementwise-weighted sum of the output as
+    the scalar loss instead of the plain sum.  Normalization ops
+    (InstanceNorm-style: mean subtracted over the reduced axes) have an
+    IDENTICALLY ZERO data/gamma gradient under a plain sum — every
+    output element shifts together — so the check degenerates to
+    comparing float32 forward noise against ~0 right at the tolerance
+    boundary.  Deterministic weights break the symmetry and make both
+    sides O(1).
     """
     from . import ops
     attrs = attrs or {}
     inputs = [np.asarray(a, np.float64) for a in input_arrays]
     wrt = list(range(len(inputs))) if wrt is None else list(wrt)
+    _weights = {}
+
+    def _weight_for(shape):
+        w = _weights.get(tuple(shape))
+        if w is None:
+            wr = np.random.RandomState(5)
+            w = _weights[tuple(shape)] = wr.uniform(0.5, 1.5, shape)
+        return w
 
     def f(xs):
         arrs = [nd.array(x.astype("float32")) for x in xs]
@@ -86,7 +103,10 @@ def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
             out = ops.imperative_invoke(op_name, *arrs, **attrs)
         if isinstance(out, list):
             out = out[0]
-        return float(out.asnumpy().astype(np.float64).sum())
+        out_np = out.asnumpy().astype(np.float64)
+        if weighted:
+            out_np = out_np * _weight_for(out_np.shape)
+        return float(out_np.sum())
 
     expected = {i: numeric_grad_one(f, inputs, i, eps) for i in wrt}
 
@@ -97,7 +117,11 @@ def check_numeric_gradient(op_name, input_arrays, attrs=None, rtol=1e-2,
         out = ops.imperative_invoke(op_name, *arrs, **attrs)
         if isinstance(out, list):
             out = out[0]
-        loss = out.sum()
+        if weighted:
+            w = nd.array(_weight_for(out.shape).astype("float32"))
+            loss = (out * w).sum()
+        else:
+            loss = out.sum()
     autograd.backward([loss])
     for i in wrt:
         np.testing.assert_allclose(grads[i].asnumpy(), expected[i],

@@ -127,6 +127,97 @@ def test_plan_respects_exclusions():
     assert s["fallbacks"] == {"claimed_by_other_pass": 1}
 
 
+# ------------------------------------ relayout accounting (the matrix)
+# Adjacent-same-layout credit across ALL FOUR chain kinds: a boundary
+# only counts as an eliminated relayout when an image activation sits
+# on both sides — fc_act blocks neither carry an image layout out nor
+# read one in (FullyConnected flattens), so FC boundaries never credit.
+
+def _conv_bn(data, i, act=False):
+    n = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                           no_bias=True, name="c%d" % i)
+    n = mx.sym.BatchNorm(n, name="b%d" % i, fix_gamma=False)
+    if act:
+        n = mx.sym.Activation(n, act_type="relu", name="r%d" % i)
+    return n
+
+
+def _bn_act(data, i):
+    n = mx.sym.BatchNorm(data, name="nb%d" % i, fix_gamma=False)
+    return mx.sym.Activation(n, act_type="relu", name="nr%d" % i)
+
+
+def _fc_act(data, i):
+    n = mx.sym.FullyConnected(data, num_hidden=8, name="f%d" % i)
+    return mx.sym.Activation(n, act_type="relu", name="fa%d" % i)
+
+
+def _counts(sym):
+    p = _plan(sym)
+    return (p.summary()["kinds"], p.interior_edges, p.adjacent_edges,
+            p.relayouts_eliminated)
+
+
+def test_relayout_adjacent_conv_bn_act_chain():
+    d = mx.sym.Variable("data")
+    sym = _conv_bn(_conv_bn(d, 0, act=True), 1, act=True)
+    kinds, interior, adjacent, total = _counts(sym)
+    assert kinds == {"conv_bn_act": 2}
+    assert (interior, adjacent, total) == (4, 1, 5)
+
+
+def test_relayout_adjacent_conv_bn_chain():
+    d = mx.sym.Variable("data")
+    sym = _conv_bn(_conv_bn(d, 0), 1)
+    kinds, interior, adjacent, total = _counts(sym)
+    assert kinds == {"conv_bn": 2}
+    assert (interior, adjacent, total) == (2, 1, 3)
+
+
+def test_relayout_adjacent_bn_act_chain():
+    d = mx.sym.Variable("data")
+    sym = _bn_act(_bn_act(d, 0), 1)
+    kinds, interior, adjacent, total = _counts(sym)
+    assert kinds == {"bn_act": 2}
+    assert (interior, adjacent, total) == (2, 1, 3)
+
+
+def test_relayout_adjacent_conv_into_bn_act():
+    d = mx.sym.Variable("data")
+    sym = _bn_act(_conv_bn(d, 0, act=True), 1)
+    kinds, interior, adjacent, total = _counts(sym)
+    assert kinds == {"conv_bn_act": 1, "bn_act": 1}
+    assert (interior, adjacent, total) == (3, 1, 4)
+
+
+def test_relayout_fc_chain_never_credits_adjacency():
+    """fc_act -> fc_act: both boundary tensors are 2-d — no image
+    relayout exists to eliminate (the credit used to overcount)."""
+    d = mx.sym.Variable("data")
+    sym = _fc_act(_fc_act(d, 0), 1)
+    kinds, interior, adjacent, total = _counts(sym)
+    assert kinds == {"fc_act": 2}
+    assert (interior, adjacent, total) == (2, 0, 2)
+
+
+def test_relayout_conv_into_fc_never_credits_adjacency():
+    """conv_bn_act -> fc_act (direct, FC flatten=True): the FC flattens
+    the image activation, paying that materialization regardless of
+    any layout pinning — no credit (used to overcount)."""
+    d = mx.sym.Variable("data")
+    sym = _fc_act(_conv_bn(d, 0, act=True), 1)
+    kinds, interior, adjacent, total = _counts(sym)
+    assert kinds == {"conv_bn_act": 1, "fc_act": 1}
+    assert (interior, adjacent, total) == (3, 0, 3)
+
+
+def test_relayout_flatten_between_blocks_no_credit():
+    d = mx.sym.Variable("data")
+    sym = _fc_act(mx.sym.Flatten(_conv_bn(d, 0, act=True)), 1)
+    _kinds, _interior, adjacent, _total = _counts(sym)
+    assert adjacent == 0
+
+
 # the zoo: every net with a fusable pattern must plan >= 1 block.
 # googlenet is the documented zero: convs without BN and an FC head
 # with no trailing activation offer nothing to fuse.

@@ -174,7 +174,11 @@ CASES = {
     "Pooling": ([_x(1, 2, 4, 4)],
                 {"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"}),
     "LayerNorm": ([_x(2, 6), _pos(6), _x(6)], {}),
-    "InstanceNorm": ([_x(1, 2, 4, 4), _pos(2), _x(2)], {}),
+    # weighted: under a plain sum loss the instance-norm data/gamma
+    # gradients are IDENTICALLY zero (mean subtraction), so the plain
+    # check compares f32 forward noise to ~0 at the tolerance boundary
+    "InstanceNorm": ([_x(1, 2, 4, 4), _pos(2), _x(2)], {},
+                     {"weighted": True}),
     "L2Normalization": ([_x(2, 6)], {}),
     "LRN": ([_x(1, 3, 4, 4)], {"nsize": 3}),
     "UpSampling": ([_x(1, 2, 3, 3)],
@@ -204,6 +208,10 @@ CASES = {
     "_contrib_count_sketch": ([_x(2, 6), np.array([0., 3., 1., 2., 5., 4.]),
                                np.array([1., -1., 1., 1., -1., 1.])],
                               {"out_dim": 4}, {"wrt": (0,)}),
+    # appended entries (keep them LAST: the _x/_pos/_unit helpers share
+    # one RNG stream in dict-literal order, so inserting mid-dict would
+    # silently reroll every later case's data)
+    "squeeze": ([_x(2, 1, 5)], {"axis": 1}),
 }
 
 # every other registered op must appear here, with the reason it has no
@@ -278,7 +286,11 @@ SKIP = {
 
 def test_registry_fully_classified():
     """Every registered op has a gradient case or an explicit skip."""
-    ops = set(registry.list_ops())
+    # sibling suites register `_test_*` probe ops into the process-wide
+    # registry (test_analysis duplicate/shape-rule probes) and leave
+    # them behind; they are not product ops, and counting them made
+    # this sweep fail run-order-dependently in the full tier-1 run
+    ops = {o for o in registry.list_ops() if not o.startswith("_test_")}
     classified = set(CASES) | set(SKIP)
     missing = ops - classified
     stale = classified - ops

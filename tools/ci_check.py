@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Eleven stages, all of which must be clean:
+Twelve stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-005; pragmas with reasons are the only
@@ -71,6 +71,18 @@ Eleven stages, all of which must be clean:
     covers the new ``mxtpu_tensor_norm`` / ``mxtpu_grad_global_norm``
     / ``mxtpu_nonfinite_total`` / ``mxtpu_numerics_anomalies_total``
     metrics automatically.)
+12. **plan-search gate** — the cost-model-guided whole-graph plan
+    search (``mxnet_tpu.analysis.plansearch``, docs/api/
+    plansearch.md): ``tools/plan_search.py --model mlp`` under a tiny
+    budget (interpret-mode CPU measurement) must commit a
+    ``graph_plan`` tuning-cache entry whose predicted wall is <= the
+    greedy plan's and whose measured wall is <= the measured greedy
+    wall; a SECOND identical run must be a pure cache hit with zero
+    search; and an Executor lowered through a decision-transformed
+    plan (chain split + per-region layout override) must match the
+    greedy executor's outputs and gradients numerically.  (The
+    stage-4 drift guard covers the new ``mxtpu_plan_cache_*`` metrics
+    automatically.)
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -106,7 +118,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/11] mxlint: %d finding(s) over %s"
+        say("ci_check[1/12] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -115,7 +127,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/11] registry selfcheck: %d problem(s)"
+        say("ci_check[2/12] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -129,14 +141,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/11] verify model %-22s %s" % (name, status))
+            say("ci_check[3/12] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/11] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/12] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -144,7 +156,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/11] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/12] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -152,7 +164,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/11] distview smoke: %d problem(s)"
+        say("ci_check[6/12] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -160,14 +172,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/11] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/12] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/11] perf ground truth: %d problem(s)"
+        say("ci_check[8/12] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -175,7 +187,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/11] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/12] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -183,7 +195,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/11] reshard gate: %d problem(s)"
+        say("ci_check[10/12] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -192,10 +204,19 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/11] numerics gate: %d problem(s)"
+        say("ci_check[11/12] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
+            say("  " + p)
+
+        # stage 12: plan-search gate (tiny-budget search + commit;
+        # second run a pure cache hit; searched-vs-greedy parity)
+        problems = plansearch_check(repo_root)
+        say("ci_check[12/12] plan search: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("plansearch: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -452,7 +473,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/11] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/12] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -1030,6 +1051,151 @@ def numerics_check(repo_root=_ROOT):
                 os.environ[k] = v
         resilience.clear_faults()
         telemetry.numerics.reset()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def plansearch_check(repo_root=_ROOT):
+    """Plan-search gate (stage 12).  Three legs:
+
+    1. **search + commit** — ``tools/plan_search.py --model mlp`` under
+       a tiny budget (interpret/CPU measurement) must commit a
+       ``graph_plan`` entry whose predicted wall is <= the greedy
+       plan's AND whose measured wall is <= the measured greedy wall
+       (greedy is always in the measured set);
+    2. **pure cache hit** — a second identical run must answer from
+       the cache with ZERO search (``cached`` true, ``searched`` 0);
+    3. **output parity** — an Executor forward+backward lowered
+       through a decision-transformed plan (chain split + per-region
+       layout override) must match the greedy executor numerically.
+
+    Returns a list of problem strings (empty = clean)."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_plansearch_gate_")
+    cache = os.path.join(tmpdir, "cache")
+    script = os.path.join(repo_root, "tools", "plan_search.py")
+    cmd = [sys.executable, script, "--model", "mlp", "--budget", "8",
+           "--beam", "4", "--topk", "2", "--repeats", "1",
+           "--cache", cache, "--json"]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("MXNET_TPU_TUNE_CACHE", None)
+
+    def run_driver():
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=600, env=env)
+        if res.returncode != 0:
+            return None, "plan_search exited %d: %s" % (
+                res.returncode, (res.stdout + res.stderr)[-300:])
+        try:
+            return json.loads(res.stdout.strip().splitlines()[-1]), None
+        except (ValueError, IndexError) as e:
+            return None, "plan_search emitted no JSON doc: %s (%s)" % (
+                e, res.stdout[-200:])
+
+    try:
+        # ---- leg 1: search under a tiny budget, commit the winner
+        doc, err = run_driver()
+        if err:
+            problems.append(err)
+        else:
+            if doc.get("error"):
+                problems.append("search run errored: %s" % doc["error"])
+            gp = doc.get("greedy_predicted_s")
+            if doc.get("predicted_s") is None or gp is None or \
+                    doc["predicted_s"] > gp * (1 + 1e-9):
+                problems.append(
+                    "committed plan's predicted wall %r is not <= the "
+                    "greedy plan's %r" % (doc.get("predicted_s"), gp))
+            gw = doc.get("greedy_wall_s")
+            if doc.get("wall_s") is None or gw is None or \
+                    doc["wall_s"] > gw * (1 + 1e-9):
+                problems.append(
+                    "committed winner's measured wall %r is worse than "
+                    "the measured greedy %r" % (doc.get("wall_s"), gw))
+            if not doc.get("measured"):
+                problems.append("no candidate plan was measured")
+            if not os.path.isdir(cache) or not any(
+                    f.startswith("tunecache") and f.endswith(".jsonl")
+                    for f in os.listdir(cache)):
+                problems.append("no tunecache*.jsonl persisted under "
+                                "the --cache directory")
+
+        # ---- leg 2: second run = pure cache hit, zero search
+        doc2, err = run_driver()
+        if err:
+            problems.append(err)
+        elif not (doc2.get("cached") and doc2.get("searched") == 0):
+            problems.append(
+                "second run was not a pure cache hit (cached=%r, "
+                "searched=%r)" % (doc2.get("cached"),
+                                  doc2.get("searched")))
+
+        # ---- leg 3: searched-vs-greedy executor output parity
+        import mxnet_tpu as mx
+        from mxnet_tpu.analysis import fusion as _fusion
+        from mxnet_tpu.ops.fused import block_fusion
+
+        data = mx.sym.Variable("data")
+        net = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=8, no_bias=True, name="c0")
+        net = mx.sym.BatchNorm(net, name="b0", fix_gamma=False)
+        net = mx.sym.Activation(net, act_type="relu", name="r0")
+        net = mx.sym.Convolution(net, kernel=(1, 1), num_filter=8,
+                                 no_bias=True, name="c1")
+        net = mx.sym.BatchNorm(net, name="b1", fix_gamma=False)
+        net = mx.sym.Activation(net, act_type="relu", name="r1")
+        net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                    name="fc")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        topo = sym._topo()
+        plan = _fusion.plan_block_fusion(topo, sym._entries,
+                                         record=False, decisions={})
+        chains = sorted(b.chain for b in plan.blocks.values()
+                        if b.kind == "conv_bn_act")
+        decisions = {"chains": {chains[0]: "conv_bn"},
+                     "layouts": {chains[1]: "NHWC"}}
+
+        def run_exec(dec):
+            with block_fusion(True), _fusion.plan_decisions(dec):
+                ex = sym.simple_bind(mx.cpu(), data=(4, 3, 8, 8),
+                                     softmax_label=(4,))
+            rng = np.random.RandomState(0)
+            for name, arr in ex.arg_dict.items():
+                arr[:] = (rng.randint(0, 10, arr.shape)
+                          if name == "softmax_label"
+                          else rng.uniform(-0.5, 0.5, arr.shape)) \
+                    .astype(np.float32)
+            ex.forward(is_train=True)
+            out = ex.outputs[0].asnumpy()
+            ex.backward()
+            return out, {k: v.asnumpy()
+                         for k, v in ex.grad_dict.items()
+                         if v is not None}
+
+        # {} pins the reference to EXPLICIT greedy: with None the bind
+        # would consult any ambient MXNET_TPU_TUNE_CACHE and could
+        # silently compare a committed plan against itself
+        o_ref, g_ref = run_exec({})
+        o_alt, g_alt = run_exec(decisions)
+        if not np.allclose(o_ref, o_alt, rtol=2e-5, atol=2e-6):
+            problems.append("searched-plan executor outputs diverge "
+                            "from greedy (max |d|=%.3g)"
+                            % float(np.max(np.abs(o_ref - o_alt))))
+        for k in g_ref:
+            if not np.allclose(g_ref[k], g_alt[k], rtol=2e-4,
+                               atol=2e-5):
+                problems.append("searched-plan gradient %r diverges "
+                                "from greedy" % k)
+                break
+    finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 

@@ -60,6 +60,8 @@ HAND_WRITTEN = [
     ("fusion (block-granularity fusion + layout planning)", "fusion.md"),
     ("autotune (Pallas autotuner, tuning cache, learned cost model)",
      "autotune.md"),
+    ("plansearch (cost-model-guided whole-graph plan search)",
+     "plansearch.md"),
     ("reshard (elastic training: checkpoint resharding, rank "
      "join/leave)", "reshard.md"),
 ]
@@ -85,6 +87,12 @@ SEE_ALSO = {
                  "cache the Pallas kernels and fused regions consult "
                  "at trace time (`MXNET_TPU_TUNE_CACHE`; "
                  "`tools/autotune.py` searches it)",
+                 "[plansearch](plansearch.md) — the committed "
+                 "whole-graph fusion/layout plan (`graph_plan` tuning-"
+                 "cache entry) consulted ONCE at bind and activated "
+                 "around every trace; greedy on miss "
+                 "(`MXNET_TPU_PLAN_SEARCH`; `tools/plan_search.py` "
+                 "searches it)",
                  "[telemetry](telemetry.md) training-health numerics "
                  "(`telemetry.numerics`): `set_stats_monitor` computes "
                  "per-node stat bundles INSIDE one compiled forward — "
@@ -129,6 +137,9 @@ SEE_ALSO = {
                  "[fusion](fusion.md) — `ShardedTrainer(fuse_blocks=...)`"
                  ": block-granularity fusion + layout planning on the "
                  "fused train step",
+                 "[plansearch](plansearch.md) — the searched whole-"
+                 "graph plan the trainer consults at construction, "
+                 "keyed per (graph digest, layout, mesh, backend)",
                  "[reshard](reshard.md) — elastic training: "
                  "`ShardedTrainer.load_checkpoint` reshards across mesh "
                  "shapes via the manifest mesh descriptor, "

@@ -25,8 +25,14 @@ entry names the single worst-MFU block — what ci_check stage 8 parses.
 (``--cache`` or ``MXNET_TPU_TUNE_CACHE``, ``mxnet_tpu.autotune``): for
 each worst-MFU block/kernel it reports whether the cache holds a
 better-measured config for its key and the expected delta vs the
-heuristic — the "what would tuning buy here" view.  Exit codes: 0 ok,
-2 no readable records.
+heuristic — the "what would tuning buy here" view.  Worst-MFU block
+records additionally surface a ``plan`` suggestion row when their
+graph's whole-plan ``graph_plan`` entry (analysis.plansearch) is
+missing ("plan-untuned") or names a different plan than the run
+dispatched ("plan-stale") — ``tools/plan_search.py`` is the fix.
+A ``--cache`` (or env) path that does not exist or holds no readable
+entry is a usage error, not an empty suggestion table.  Exit codes:
+0 ok, 2 no readable records / bad --cache.
 """
 from __future__ import annotations
 
@@ -91,13 +97,33 @@ def render(ranked, top):
 
 
 def _cache_entries(cache_path):
-    """Tuning-cache entries merged from ``cache_path`` (or the
-    ``MXNET_TPU_TUNE_CACHE`` env), [] when absent/unreadable."""
+    """Tuning-cache entries for --suggest.  An EXPLICIT ``--cache``
+    path that does not exist or yields zero readable entries raises
+    :class:`ValueError` (the usage-error contract — silently rendering
+    zero suggestions used to hide a typo'd path).  The ambient
+    ``MXNET_TPU_TUNE_CACHE`` env stays lenient: the directory is
+    created lazily by the first tune write, so a fresh not-yet-tuned
+    machine reads as all-untuned (with a stderr note), not as a tool
+    failure.  No path at all returns []."""
     from mxnet_tpu import autotune
+    explicit = bool(cache_path)
     path = cache_path or os.environ.get("MXNET_TPU_TUNE_CACHE")
-    if not path or not os.path.exists(path):
+    if not path:
         return []
-    entries, _skipped = autotune.read_entries(path)
+    if not os.path.exists(path):
+        if explicit:
+            raise ValueError("--suggest cache %r does not exist" % path)
+        print("perf_top: note: MXNET_TPU_TUNE_CACHE=%r does not exist "
+              "yet (nothing tuned) — every row reads untuned" % path,
+              file=sys.stderr)
+        return []
+    entries, skipped = autotune.read_entries(path)
+    if not entries and explicit:
+        raise ValueError(
+            "--suggest cache %r holds no readable mxtpu-tunecache/1 "
+            "entry%s" % (path,
+                         " (%d corrupt/foreign line(s) skipped)"
+                         % skipped if skipped else ""))
     return entries
 
 
@@ -121,12 +147,73 @@ def _match_entry(rec, entries):
     return None
 
 
+def _plan_rows(ranked, entries):
+    """One ``plan`` suggestion row per graph that owns worst-MFU block
+    records but whose whole-plan ``graph_plan`` cache entry
+    (analysis.plansearch, keyed by graph digest + mesh) is missing
+    ("plan-untuned") or names a different plan than the run actually
+    dispatched ("plan-stale").  Rows carry the graph's worst block as
+    evidence."""
+    plan_entries = [e for e in entries if e.get("op") == "graph_plan"
+                    and isinstance(e.get("extra"), dict)]
+
+    def _entry_for(rec):
+        """The graph_plan entry matching this block record's FULL key:
+        graph digest + mesh + (when the record carries one) the trace
+        layout — an entry committed at a different layout must read as
+        untuned for this record, not as stale."""
+        graph = rec.get("graph")
+        mesh = json.dumps(rec.get("mesh"), sort_keys=True)
+        layout = rec.get("layout")
+        for e in plan_entries:
+            if e["extra"].get("graph") != graph:
+                continue
+            if json.dumps(e.get("mesh"), sort_keys=True) != mesh:
+                continue
+            if layout and e["extra"].get("layout") not in (None, layout):
+                continue
+            return e
+        return None
+
+    rows, seen = [], set()
+    for r in ranked:
+        graph = r.get("graph")
+        if r.get("kind") != "block" or not graph:
+            continue
+        key = (graph, json.dumps(r.get("mesh"), sort_keys=True),
+               r.get("layout"))
+        if key in seen:
+            continue
+        seen.add(key)
+        e = _entry_for(r)
+        if e is None:
+            rows.append({
+                "kind": "plan", "name": graph, "mfu": r["mfu"],
+                "worst_block": r["name"], "status": "plan-untuned",
+                "hint": "no graph_plan entry for this graph/mesh — "
+                        "tools/plan_search.py can search it"})
+            continue
+        committed = (e.get("config") or {}).get("plan_id")
+        dispatched = r.get("plan")
+        if dispatched and committed and dispatched != committed:
+            rows.append({
+                "kind": "plan", "name": graph, "mfu": r["mfu"],
+                "worst_block": r["name"], "status": "plan-stale",
+                "committed_plan": committed,
+                "dispatched_plan": dispatched,
+                "hint": "run dispatched %s but the cache commits %s — "
+                        "re-run with the cache armed or re-search"
+                        % (dispatched, committed)})
+    return rows
+
+
 def suggest(ranked, entries):
     """For each worst-MFU block/kernel record: does the tuning cache
     hold a better-measured config for its key, and what delta did it
-    measure vs the heuristic?  Returns one row per record."""
+    measure vs the heuristic?  Returns one row per record, plus the
+    graph-level ``plan`` rows (:func:`_plan_rows`)."""
     from mxnet_tpu.autotune import same_config
-    rows = []
+    rows = _plan_rows(ranked, entries)
     for r in ranked:
         if r.get("kind") not in ("block", "kernel"):
             continue
@@ -163,10 +250,15 @@ def render_suggestions(rows):
         if r.get("expected_delta_frac") is not None:
             exp = "%+.1f%% vs heuristic" \
                 % (100.0 * r["expected_delta_frac"])
+        elif r.get("hint"):
+            exp = r["hint"]
+        current = _fmt_cfg(r.get("current_config"))
+        if r["kind"] == "plan":
+            current = "worst: %s" % r.get("worst_block")
         lines.append("%-28s %-8s %6.2f  %-16s %-24s %-24s %s"
                      % (r["name"][:28], r["kind"],
                         100.0 * r["mfu"], r["status"],
-                        _fmt_cfg(r.get("current_config"))[:24],
+                        current[:24],
                         _fmt_cfg(r.get("tuned_config"))[:24], exp))
     return "\n".join(lines)
 
@@ -242,7 +334,12 @@ def main(argv=None):
     ranked = rank(records, kind=args.kind, min_count=args.min_count)
     sugg = None
     if args.suggest:
-        sugg = suggest(ranked[:args.top], _cache_entries(args.cache))
+        try:
+            entries = _cache_entries(args.cache)
+        except ValueError as e:
+            print("perf_top: %s" % e, file=sys.stderr)
+            return 2
+        sugg = suggest(ranked[:args.top], entries)
     if args.as_json:
         doc = _doc(ranked, records, skipped, args.top)
         if sugg is not None:

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""plan_search — search the whole-graph fusion/layout plan offline and
+commit the measured winner to the tuning cache.
+
+The driver for :mod:`mxnet_tpu.analysis.plansearch` (ROADMAP item 3):
+beam-search the per-chain fuse/split, per-region layout, and per-block
+Pallas decisions of a model's fusion plan with the learned cost model
+(arXiv:2008.01040) as the objective, measure the top-k candidates (plus
+greedy, always) for real on a traced forward+backward step via
+``autotune.measure`` (interpret mode off-TPU), and commit the winner as
+a ``graph_plan`` entry in the ``mxtpu-tunecache/1`` cache — keyed by
+graph digest + layout + mesh + backend, picked up by every later
+``Executor``/``ShardedTrainer`` bind with zero search cost.
+
+Usage::
+
+    python tools/plan_search.py --model resnet50 --budget 64
+    python tools/plan_search.py --model inception_resnet_v2 \
+        --cost-model costmodel.json --cache /path/to/cache
+    python tools/plan_search.py --model mlp --no-measure   # predict only
+
+Keys already cached are a pure hit (zero search — the CI contract)
+unless ``--force``.  Exit codes: 0 ok, 1 search/measure failed, 2
+usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="plan_search",
+        description="search + measure + commit a whole-graph "
+                    "fusion/layout plan")
+    ap.add_argument("--model", required=True,
+                    help="model-zoo entry (mxnet_tpu.models)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--layout", default="NHWC",
+                    choices=("NCHW", "NHWC"),
+                    help="trace layout the plan is searched (and "
+                         "keyed) at")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="max candidate plans scored by the cost model "
+                         "(default MXNET_TPU_PLAN_BUDGET or 64)")
+    ap.add_argument("--beam", type=int, default=None,
+                    help="beam width (default MXNET_TPU_PLAN_BEAM "
+                         "or 8)")
+    ap.add_argument("--topk", type=int, default=3,
+                    help="predicted-best candidates measured for real "
+                         "(greedy is always measured alongside)")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="min-of-N timing repeats per measured "
+                         "candidate")
+    ap.add_argument("--no-measure", action="store_true",
+                    help="commit the predicted-best without measuring "
+                         "(objective-only mode)")
+    ap.add_argument("--cost-model", default=None, metavar="PATH",
+                    help="fitted mxtpu-costmodel/1 JSON; default: fit "
+                         "fresh on the costdb records when available, "
+                         "else the roofline-attainable objective")
+    ap.add_argument("--cache", default=None,
+                    help="tuning-cache directory (sets "
+                         "MXNET_TPU_TUNE_CACHE for this run)")
+    ap.add_argument("--costdb", default=None,
+                    help="cost-database directory (sets "
+                         "MXNET_TPU_COSTDB for this run)")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh axis sizes the entry is keyed by, e.g. "
+                         "'data=8,model=2' (default: unkeyed — the "
+                         "single-device Executor key)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-search a graph already in the cache")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.cache:
+        os.environ["MXNET_TPU_TUNE_CACHE"] = args.cache
+    if args.costdb:
+        os.environ["MXNET_TPU_COSTDB"] = args.costdb
+
+    mesh = None
+    if args.mesh:
+        try:
+            mesh = {k: int(v) for k, v in
+                    (kv.split("=") for kv in args.mesh.split(","))}
+        except ValueError:
+            ap.error("--mesh must look like 'data=8,model=2'")
+
+    say = (lambda s: None) if args.as_json \
+        else (lambda s: print(s, file=sys.stderr))
+
+    from mxnet_tpu import autotune, models
+    from mxnet_tpu.analysis import plansearch
+    from mxnet_tpu.telemetry import costdb as costdb_mod
+    autotune.reload_cache()
+
+    try:
+        net = models.get_model(args.model,
+                               num_classes=args.num_classes)
+    except ValueError as e:
+        print("plan_search: %s" % e, file=sys.stderr)
+        return 2
+    data_shape = {"mlp": (args.batch, 784),
+                  "lenet": (args.batch, 1, 28, 28)}.get(
+        args.model, (args.batch, 3, 224, 224))
+    data_shapes = {"data": data_shape,
+                   "softmax_label": (args.batch,)}
+
+    model = None
+    if args.cost_model:
+        try:
+            model = autotune.load_model(args.cost_model)
+        except (OSError, ValueError) as e:
+            print("plan_search: cannot load --cost-model: %s" % e,
+                  file=sys.stderr)
+            return 2
+    else:
+        db = args.costdb or costdb_mod.db_dir()
+        if db and os.path.exists(db):
+            try:
+                records, _sk = costdb_mod.read_records(db)
+                model = autotune.fit_cost_model(records=records)
+                say("plan_search: cost model fit on %d costdb "
+                    "record(s), r2=%.3f"
+                    % (model.stats.get("n", 0),
+                       model.stats.get("r2", float("nan"))))
+            except ValueError as e:
+                say("plan_search: no cost model (%s) — roofline-"
+                    "attainable objective" % e)
+
+    doc = plansearch.search_and_commit(
+        net, data_shapes, layout=args.layout, model=model,
+        budget=args.budget, beam=args.beam, topk=args.topk,
+        repeats=args.repeats, mesh=mesh, force=args.force,
+        measure=not args.no_measure, say=say)
+    doc["model"] = args.model
+    if args.as_json:
+        print(json.dumps(doc, sort_keys=True, default=repr))
+    elif not doc.get("cached"):
+        ab = ""
+        if doc.get("wall_s") and doc.get("greedy_wall_s"):
+            ab = "  (measured %+.1f%% vs greedy)" % (
+                100.0 * (doc["wall_s"] - doc["greedy_wall_s"])
+                / doc["greedy_wall_s"])
+        say("plan_search: %s -> %s  predicted %.3g ms (greedy %.3g "
+            "ms)%s" % (args.model, doc.get("plan_id"),
+                       1e3 * (doc.get("predicted_s") or 0),
+                       1e3 * (doc.get("greedy_predicted_s") or 0), ab))
+    return 1 if doc.get("error") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
