@@ -12,8 +12,18 @@ Two engines (ISSUE 2; see docs/api/analysis.md for the full catalog):
 * the **TPU-hazard source linter** (``tools/mxlint.py``, stdlib-only so
   it runs without jax installed): broad excepts, host syncs inside
   jitted code, jit recompile hazards, captured-state mutation under
-  ``@jit``, missing ``donate_argnums`` on train steps.  Re-exported
-  here via :func:`load_mxlint` for tests and ``tools/ci_check.py``.
+  ``@jit``, missing ``donate_argnums`` on train steps, collectives
+  under rank-conditioned branches (MXL006).  Re-exported here via
+  :func:`load_mxlint` for tests and ``tools/ci_check.py``.
+* the **distributed-correctness pass** (:mod:`.spmd`, MXG011-016):
+  abstract interpretation of the composed parallel step (pipeline x
+  tensor x sequence x MoE x kvstore) against a mesh descriptor —
+  cross-rank collective matching, rank-divergent control flow,
+  pipeline partition validity, sharding-spec composition,
+  donation/aliasing audit, and forward/backward collective duality.
+  Exposed as ``verify_symbol(mesh=..., parallel=...)``,
+  ``ShardedTrainer(strict=True)`` / ``MXNET_TPU_STRICT_BIND=1`` and
+  the CLI's ``--mesh/--pipeline/--sequence`` flags.
 """
 from __future__ import annotations
 
@@ -25,14 +35,16 @@ from .verifier import (Diagnostic, Report, verify_symbol, verify_json,
 from . import fusion
 from . import perf
 from . import plansearch
+from . import spmd
 from .fusion import plan_block_fusion, last_plan_summary
 from .perf import check_predicted_slow
+from .spmd import verify_spmd, build_config
 
 __all__ = ["Diagnostic", "Report", "verify_symbol", "verify_json",
            "verify_model", "infer_node_shapes", "load_mxlint",
            "registry_selfcheck", "fusion", "perf", "plansearch",
-           "plan_block_fusion", "last_plan_summary",
-           "check_predicted_slow"]
+           "spmd", "plan_block_fusion", "last_plan_summary",
+           "check_predicted_slow", "verify_spmd", "build_config"]
 
 
 def registry_selfcheck():

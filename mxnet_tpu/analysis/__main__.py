@@ -17,6 +17,13 @@ Usage::
     # run the TPU-hazard source linter (tools/mxlint.py rules)
     python -m mxnet_tpu.analysis --lint mxnet_tpu/ tools/ examples/
 
+    # distributed-correctness pass (MXG011-016) for a composed
+    # parallel configuration
+    python -m mxnet_tpu.analysis --model mlp --mesh data=2,pipe=2 \
+        --pipeline 2 [--microbatches 4]
+    python -m mxnet_tpu.analysis --model mlp --mesh data=2,model=4 \
+        --sequence [--seq-axis model] [--kv-push]
+
     # registry self-check only
     python -m mxnet_tpu.analysis --registry
 
@@ -51,8 +58,11 @@ def main(argv=None):
     ap.add_argument("--tp", type=int, default=1,
                     help="verify tensor-parallel sharding coverage for "
                          "this model-axis size")
-    ap.add_argument("--batch", type=int, default=2,
-                    help="batch size for --model verification")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="batch size for --model verification "
+                         "(default 2, rounded up to dp x microbatches "
+                         "under --pipeline; an EXPLICIT value is "
+                         "verified as given)")
     ap.add_argument("--registry", action="store_true",
                     help="run the op-registry self-check")
     ap.add_argument("--lint", nargs="*", metavar="PATH", default=None,
@@ -78,18 +88,66 @@ def main(argv=None):
                     choices=("NCHW", "NHWC"),
                     help="trace layout the --plan lookup is keyed by "
                          "(default NCHW)")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="mesh descriptor 'axis=size,axis=size' (e.g. "
+                         "data=2,pipe=2); enables the distributed-"
+                         "correctness pass (MXG011-016)")
+    ap.add_argument("--pipeline", type=int, default=1, metavar="N",
+                    help="verify an N-stage pipeline partition of the "
+                         "graph (needs --mesh with a pipe axis)")
+    ap.add_argument("--microbatches", type=int, default=None,
+                    help="pipeline microbatch count (default 2x stages)")
+    ap.add_argument("--sequence", action="store_true",
+                    help="verify the sequence-parallel (ring attention) "
+                         "composition over --seq-axis")
+    ap.add_argument("--seq-axis", default="model",
+                    help="mesh axis carrying sequence shards "
+                         "(default model)")
+    ap.add_argument("--kv-push", action="store_true",
+                    help="include the DistKVStore push collective in "
+                         "the verified schedule")
     args = ap.parse_args(argv)
 
     if args.plan and not args.cost_model:
         ap.error("--plan needs --cost-model (the MXG010 predictor)")
+    if (args.pipeline > 1 or args.sequence or args.kv_push) \
+            and not args.mesh:
+        ap.error("--pipeline/--sequence/--kv-push need --mesh "
+                 "(the distributed pass verifies against a mesh "
+                 "descriptor)")
 
     if not (args.json or args.model or args.registry
             or args.lint is not None):
         ap.error("nothing to do: give JSON files, --model, --registry "
                  "or --lint")
 
-    from . import (Report, load_mxlint, registry_selfcheck, verify_json,
-                   verify_model)
+    from . import (Report, build_config, load_mxlint,
+                   registry_selfcheck, verify_json, verify_model)
+
+    mesh_axes = None
+    parallel_cfg = None
+    if args.mesh:
+        from ..parallel.reshard import parse_axes
+        try:
+            mesh_axes = parse_axes(args.mesh)
+        except ValueError as e:
+            ap.error(str(e))
+        parallel_cfg = build_config(
+            pipeline_stages=args.pipeline,
+            pipeline_microbatches=args.microbatches,
+            sequence_parallel=args.sequence, seq_axis=args.seq_axis,
+            kv_push=args.kv_push, tp_size=mesh_axes.get("model", 1))
+
+    batch = args.batch if args.batch is not None else 2
+    if args.batch is None and parallel_cfg \
+            and parallel_cfg["pipeline_stages"] > 1:
+        # the default batch must divide dp x microbatches or every
+        # --pipeline run would false-flag MXG013; an explicit --batch
+        # is the user's to get wrong (that IS the divisibility check)
+        denom = mesh_axes.get("data", 1) * \
+            parallel_cfg["pipeline_microbatches"]
+        batch = max(batch, denom)
+        batch += (-batch) % denom
 
     failed = warned = False
 
@@ -105,12 +163,14 @@ def main(argv=None):
         from .. import models as _zoo
         models = list(_zoo._MODELS)
     for name in models:
-        _net, report = verify_model(name, batch=args.batch,
+        _net, report = verify_model(name, batch=batch,
                                     tp_size=args.tp,
                                     cost_model=args.cost_model,
                                     slow_factor=args.slow_factor,
                                     plan=args.plan,
-                                    plan_layout=args.layout)
+                                    plan_layout=args.layout,
+                                    mesh=mesh_axes,
+                                    parallel=parallel_cfg)
         print("model %-20s %s" % (name, report))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)
@@ -127,7 +187,8 @@ def main(argv=None):
         report = verify_json(js, shapes=shapes or None, tp_size=args.tp,
                              cost_model=args.cost_model,
                              slow_factor=args.slow_factor,
-                             plan=args.plan, plan_layout=args.layout)
+                             plan=args.plan, plan_layout=args.layout,
+                             mesh=mesh_axes, parallel=parallel_cfg)
         print("%s: %s" % (path, report))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)

@@ -113,7 +113,14 @@ class Report:
         return "\n".join(lines)
 
     def add(self, *args, **kwargs):
-        self.diagnostics.append(Diagnostic(*args, **kwargs))
+        d = Diagnostic(*args, **kwargs)
+        self.diagnostics.append(d)
+        try:
+            from ..telemetry import counter
+            counter("mxtpu_verify_findings_total").labels(
+                rule=d.rule).inc()
+        except Exception:  # mxlint: allow-broad-except(finding accounting is observability; a metric failure must never mask the diagnostic itself)
+            pass
 
     def raise_if_errors(self, context=""):
         if self.ok:
@@ -461,7 +468,8 @@ def _registry_diagnostics(report):
 
 def verify_symbol(sym, shapes=None, types=None, tp_size=1,
                   check_registry=False, report=None, cost_model=None,
-                  slow_factor=3.0, plan=False, plan_layout="NCHW"):
+                  slow_factor=3.0, plan=False, plan_layout="NCHW",
+                  mesh=None, parallel=None):
     """Verify a Symbol graph; returns a :class:`Report`.
 
     ``shapes``: {input_name: shape} (same keys as ``infer_shape`` kwargs;
@@ -476,7 +484,10 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
     before any compile (:mod:`.perf`).  ``plan=True`` switches MXG010
     to plan mode: predictions for the COMMITTED fusion/layout plan
     (the ``graph_plan`` tuning-cache entry at ``plan_layout``; greedy
-    on miss) instead of the default per-node lowering.
+    on miss) instead of the default per-node lowering.  ``mesh``
+    ({axis: size} descriptor) additionally runs the distributed-
+    correctness pass (:mod:`.spmd`, MXG011-016) with ``parallel`` — a
+    :func:`.spmd.build_config` dict describing the composed step.
     """
     report = report if report is not None else Report()
     shapes = dict(shapes or {})
@@ -505,6 +516,26 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
 
     if tp_size and tp_size > 1:
         _check_tp_coverage(topo, arg_shapes, tp_size, report)
+    if mesh:
+        from . import spmd as _spmd
+        cfg = parallel if parallel is not None else _spmd.build_config()
+        if not cfg.get("data_shapes") and shapes:
+            cfg = dict(cfg)
+            cfg["data_shapes"] = {k: v for k, v in shapes.items()
+                                  if not k.endswith("_label")}
+            cfg["label_shapes"] = {k: v for k, v in shapes.items()
+                                   if k.endswith("_label")}
+        # hand the pass the per-node shapes _shape_pass already traced
+        # — re-inferring would run jax.eval_shape over the whole graph
+        # a second time
+        node_shapes = {}
+        for nid, sts in structs.items():
+            if sts is None:
+                continue
+            for i, st in enumerate(sts):
+                node_shapes[(nid, i)] = tuple(int(d) for d in st.shape)
+        _spmd.verify_spmd(sym, mesh, cfg, report=report,
+                          shapes=node_shapes, arg_shapes=arg_shapes)
     if cost_model is not None:
         if plan:
             from .perf import check_predicted_plan
@@ -539,7 +570,8 @@ def infer_node_shapes(sym, shapes=None, types=None):
 
 def verify_json(json_str, shapes=None, types=None, tp_size=1,
                 check_registry=False, cost_model=None,
-                slow_factor=3.0, plan=False, plan_layout="NCHW"):
+                slow_factor=3.0, plan=False, plan_layout="NCHW",
+                mesh=None, parallel=None):
     """Verify a serialized symbol (the reference JSON graph layout).
 
     Runs every :func:`verify_symbol` check *plus* true dead-node
@@ -589,7 +621,8 @@ def verify_json(json_str, shapes=None, types=None, tp_size=1,
     return verify_symbol(sym, shapes=shapes, types=types, tp_size=tp_size,
                          check_registry=check_registry, report=report,
                          cost_model=cost_model, slow_factor=slow_factor,
-                         plan=plan, plan_layout=plan_layout)
+                         plan=plan, plan_layout=plan_layout,
+                         mesh=mesh, parallel=parallel)
 
 
 # default verification inputs per model-zoo entry: (data kwargs)
@@ -602,11 +635,13 @@ _DEFAULT_IMAGE = {"data": (2, 3, 224, 224)}
 
 def verify_model(name, batch=2, tp_size=1, num_classes=10,
                  cost_model=None, slow_factor=3.0, plan=False,
-                 plan_layout="NCHW", **model_kwargs):
+                 plan_layout="NCHW", mesh=None, parallel=None,
+                 **model_kwargs):
     """Build a model-zoo symbol and verify it with its canonical input
     shape.  Returns (symbol, Report).  ``cost_model`` additionally
     runs the MXG010 predicted-slow check (:mod:`.perf`); ``plan=True``
-    switches it to committed-plan mode."""
+    switches it to committed-plan mode; ``mesh``/``parallel`` run the
+    distributed-correctness pass (:mod:`.spmd`)."""
     from .. import models
     net = models.get_model(name, num_classes=num_classes, **model_kwargs)
     shapes = dict(_MODEL_SHAPES.get(name, _DEFAULT_IMAGE))
@@ -615,4 +650,5 @@ def verify_model(name, batch=2, tp_size=1, num_classes=10,
     return net, verify_symbol(net, shapes=shapes, tp_size=tp_size,
                               cost_model=cost_model,
                               slow_factor=slow_factor, plan=plan,
-                              plan_layout=plan_layout)
+                              plan_layout=plan_layout,
+                              mesh=mesh, parallel=parallel)

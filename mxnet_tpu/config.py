@@ -116,8 +116,10 @@ _CATALOG = {
     "MXNET_TPU_STRICT_BIND": ("0", "honored",
                               "run the mxnet_tpu.analysis graph verifier "
                               "on every bind (Executor and Module) and "
-                              "fail with node-level diagnostics before "
-                              "any XLA compile"),
+                              "the distributed-correctness pass "
+                              "(MXG011-016) on every ShardedTrainer "
+                              "construction, failing with node-level "
+                              "diagnostics before any XLA compile"),
     # telemetry subsystem (docs/api/telemetry.md)
     "MXNET_TPU_TELEMETRY_JSONL": ("", "honored",
                                   "append one JSON line per training "

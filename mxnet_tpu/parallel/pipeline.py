@@ -302,11 +302,24 @@ def hetero_pipeline_loss(branches, x_stack, params_stack, microbatches,
 
     branches: list of N fns ``(packed_params_row, x_flat, mb) ->
     (y_flat, loss)`` — branch s unpacks its own stage statically; all
-    return the common padded buffer width and a scalar loss (nonzero
+    return the common padded buffer width and a shape-(1,) loss (nonzero
     only from the last stage).  x_stack: (M, B_u, W) microbatched input
-    (consumed by stage 0).  params_stack: (1, L) this device's packed
-    stage params.  Returns summed loss over microbatches (nonzero on
+    (consumed by stage 0).  params_stack: either (1, L) — this device's
+    packed stage params, pre-sharded over ``axis_name`` — or (N, L)
+    REPLICATED, in which case each device dynamically selects its
+    stage's row.  Callers composing pipe with a data axis must pass the
+    replicated form: GSPMD (jax 0.4.x) mispartitions the reshard of an
+    in-jit concatenate onto a minor mesh axis — the partial
+    dynamic-update-slices it combines with an add double-count the data
+    replicas, silently scaling the packed params by the data-axis size.
+    Returns the shape-(1,) summed loss over microbatches (nonzero on
     the last stage; psum over ``axis_name`` to broadcast).
+
+    The loss stays rank-1 end to end INSIDE the shard_map body: jax
+    0.4.x's shard_map partial-eval promotes rank-0 residuals
+    inconsistently across the remat/transpose path, and a scalar
+    residual with dim-0 axis names fails its out-spec check under
+    jax.grad — callers index ``[0]`` outside the shard_map instead.
     """
     import jax
     import jax.numpy as jnp
@@ -318,7 +331,11 @@ def hetero_pipeline_loss(branches, x_stack, params_stack, microbatches,
     n = len(branches)
     sid = lax.axis_index(axis_name)
     m = x_stack.shape[0]
-    row = params_stack[0]
+    if params_stack.shape[0] == 1:
+        row = params_stack[0]            # pre-sharded: this stage's row
+    else:
+        row = lax.dynamic_index_in_dim(  # replicated: select by stage id
+            params_stack, sid, 0, keepdims=False)
     shift = [(i, (i + 1) % n) for i in range(n)]
 
     def run_stage(x_t, mb):
@@ -332,11 +349,13 @@ def hetero_pipeline_loss(branches, x_stack, params_stack, microbatches,
         x_t = jnp.where(sid == 0, x_stack[jnp.clip(t, 0, m - 1)], inbuf)
         y, loss_c = run_stage(x_t, jnp.clip(mb, 0, m - 1))
         y = jnp.where(active, y, jnp.zeros_like(y))
-        loss_acc = loss_acc + jnp.where(active, loss_c, 0.0)
+        loss_acc = loss_acc + jnp.where(active, loss_c,
+                                        jnp.zeros_like(loss_c))
         nxt = lax.ppermute(y, axis_name, shift)
         return (nxt, loss_acc), None
 
     inbuf0 = jnp.zeros_like(x_stack[0])
-    (_, loss), _ = lax.scan(tick, (inbuf0, jnp.float32(0.0)),
+    (_, loss), _ = lax.scan(tick,
+                            (inbuf0, jnp.zeros((1,), jnp.float32)),
                             jnp.arange(m + n - 1))
     return loss

@@ -51,9 +51,9 @@ from ..base import MXNetError
 
 __all__ = [
     "parse_rules", "env_rules", "match_partition_rules", "first_match",
-    "mesh_axes", "normalized_axes", "mesh_descriptor", "manifest_mesh",
-    "same_mesh", "spec_to_json", "specs_from_tp_rules", "plan_reshard",
-    "note_reshape", "note_world_change",
+    "mesh_axes", "parse_axes", "normalized_axes", "mesh_descriptor",
+    "manifest_mesh", "same_mesh", "spec_to_json", "specs_from_tp_rules",
+    "plan_reshard", "note_reshape", "note_world_change",
 ]
 
 #: manifest meta schema version written by descriptor-carrying savers
@@ -193,6 +193,27 @@ def _nelem(shape):
 def mesh_axes(mesh):
     """{axis name: size} of a ``jax.sharding.Mesh``."""
     return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
+def parse_axes(spec):
+    """``"data=4,model=2"`` → ``{"data": 4, "model": 2}`` (the
+    build_mesh_from_axes/mesh-descriptor axes form); ``""``/``"1"`` →
+    ``{}`` (single device).  The ONE parser behind every ``--mesh``
+    flag (tools/reshard.py, tools/plan_search.py, the analysis CLI) so
+    the grammar cannot drift between tools.  Raises ValueError naming
+    the offending entry."""
+    axes = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or part == "1":
+            continue
+        name, _, size = part.partition("=")
+        if not name or not size.strip().isdigit():
+            raise ValueError(
+                "bad mesh entry %r (expected axis=size[,axis=size])"
+                % part)
+        axes[name.strip()] = int(size)
+    return axes
 
 
 def normalized_axes(axes):

@@ -66,13 +66,14 @@ def attention_reference(q, k, v, causal=False):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, batch_axis=None):
-    """Per-shard body under shard_map: rotate K/V around the ring."""
-    import jax
+def _ring_attention_local(q, k, v, axis_name, causal, n=1):
+    """Per-shard body under shard_map: rotate K/V around the ring.
+    ``n`` is the ring size, threaded in statically (the scan length and
+    the ppermute ring need python ints; jax 0.4.x has no lax.axis_size).
+    """
     import jax.numpy as jnp
     from jax import lax
 
-    n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     t_local = q.shape[1]
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -82,10 +83,6 @@ def _ring_attention_local(q, k, v, axis_name, causal, batch_axis=None):
     o = jnp.zeros((b, t_local, h, d), jnp.float32)
     l = jnp.zeros((b, h, t_local), jnp.float32)       # softmax denominator
     m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)  # running max
-    # mark accumulators device-varying for shard_map's scan typing
-    # (over the batch axis too when dp composes with the ring)
-    vary = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
-    o, l, m = (lax.pcast(x, vary, to="varying") for x in (o, l, m))
 
     q_pos = my_idx * t_local + jnp.arange(t_local)
 
@@ -130,13 +127,15 @@ def ring_attention(q, k, v, mesh, seq_axis="data", causal=False,
     K/V blocks ride the ICI ring; each of the n steps computes a
     (T/n × T/n) block and the online softmax merges it.
     """
-    import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_nocheck
 
     spec = P(batch_axis, seq_axis, None, None)
     body = functools.partial(_ring_attention_local, axis_name=seq_axis,
-                             causal=causal, batch_axis=batch_axis)
-    f = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                  out_specs=spec)
+                             causal=causal,
+                             n=int(mesh.shape[seq_axis]))
+    # replication checking is off (shard_map_nocheck): the online-softmax
+    # accumulators are device-varying from step 0 and jax 0.4.x has no
+    # pcast/pbroadcast surface to declare it
+    f = shard_map_nocheck(body, mesh, (spec, spec, spec), spec)
     return f(q, k, v)

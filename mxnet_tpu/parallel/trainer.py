@@ -147,7 +147,7 @@ class ShardedTrainer:
                  strided_bwd_phase=None, pipeline_stages=1,
                  pipeline_microbatches=None, sequence_parallel=False,
                  input_mean=None, input_std=None, conv1x1_as_dot=None,
-                 native_weight_layout=None):
+                 native_weight_layout=None, strict=None):
         """
         symbol: loss-headed Symbol (e.g. SoftmaxOutput net).
         mesh: jax.sharding.Mesh with ('data', 'model') axes.
@@ -167,6 +167,11 @@ class ShardedTrainer:
             with NCHW checkpoints whenever Flatten only ever sees 1x1
             spatial maps (global-pool-then-FC nets like ResNet/Inception);
             an MLP-style Flatten of a WxH map permutes the FC input order.
+        strict: run the distributed-correctness pass
+            (``analysis.spmd``, MXG011-016) over this (graph, mesh,
+            parallel config) triple before any compile and raise a
+            descriptive MXNetError on findings.  None -> the
+            ``MXNET_TPU_STRICT_BIND`` env default.
         """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -530,6 +535,27 @@ class ShardedTrainer:
                 tp_rules[name] = d
         self.tp_rules = tp_rules
 
+        # distributed-correctness pass (analysis.spmd, MXG011-016): the
+        # composed (graph, mesh, parallel config) triple is verified
+        # BEFORE any compile — mismatched collectives, infeasible
+        # stage/axis partitions and conflicting sharding specs raise a
+        # node-level diagnostic here instead of hanging a fleet
+        if strict is None:
+            from .. import config as _config
+            strict = _config.get_bool("MXNET_TPU_STRICT_BIND")
+        if strict:
+            from ..analysis import spmd as _spmd
+            _spmd.verify_trainer_config(
+                symbol, mesh,
+                data_shapes=dict(data_shapes),
+                label_shapes=dict(label_shapes or {}),
+                pipeline_stages=self._pp,
+                pipeline_microbatches=self._pp_microbatches,
+                sequence_parallel=self._seq_parallel,
+                tp_rules=tp_rules, dtype=self.dtype,
+                arg_shapes=self._arg_shapes,
+            ).raise_if_errors("ShardedTrainer strict bind")
+
         def param_spec(name):
             shp = self._store_shapes.get(name, self._aux_shapes.get(name))
             spec = [None] * len(shp)
@@ -570,6 +596,12 @@ class ShardedTrainer:
             self.opt_state = self._device_zero_slots()
 
         self._step_fn = self._build_step()
+        if strict:
+            # MXG012 over the REAL step program: trace the un-jitted
+            # step (no XLA compile) and scan its jaxpr for collectives
+            # under axis_index-conditioned control flow.  Strict-only —
+            # costs one extra trace of the step
+            self._verify_step_rank_divergence()
         # the numerics variant (telemetry.numerics): the same step with
         # an in-graph stat tree as a fifth output, compiled lazily on
         # the first SAMPLED step (MXNET_TPU_NUMERICS_EVERY) so runs with
@@ -599,6 +631,28 @@ class ShardedTrainer:
         self._resume_epoch = 0
         self._key = jax.random.PRNGKey(seed)
         self._hyper_snapshot = self._hyper_state()
+
+    def _verify_step_rank_divergence(self):
+        """MXG012 over the step this trainer will actually dispatch:
+        trace the un-jitted step function with this trainer's own
+        state/batch avals (``jax.make_jaxpr`` — no compile) and scan
+        the jaxpr for collectives under rank-conditioned control flow
+        (``analysis.spmd.verify_step_fn``).  Raises on findings."""
+        import jax
+        import jax.numpy as jnp
+        from ..analysis import spmd as _spmd
+        py_step = getattr(self, "_py_step", None)
+        if py_step is None:
+            return
+        batch = {n: jax.ShapeDtypeStruct(
+                     tuple(self._input_shapes[n]), jnp.float32)
+                 for n in self._input_names}
+        args = (self.params, self.opt_state, self.aux, batch,
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32))
+        _spmd.verify_step_fn(py_step, args).raise_if_errors(
+            "ShardedTrainer strict bind")
 
     def _device_zero_slots(self):
         """Fresh optimizer slots created ON DEVICE by one jitted program
@@ -984,20 +1038,24 @@ class ShardedTrainer:
                         seg_topo, seg_entries, var_values,
                         is_train=True, key=None, batch_size=nb,
                         seed_vals=seed)
+                # the per-branch loss is shape (1,), never rank 0: a
+                # scalar on the differentiated path becomes a rank-0
+                # shard_map residual, which jax 0.4.x's partial-eval
+                # fails to promote on the remat/transpose path
                 if is_last:
                     logits = heads[0].astype(jnp.float32)
                     logp = jax.nn.log_softmax(logits, axis=-1)
                     idx = label.astype(jnp.int32).reshape((-1, 1))
                     psel = jnp.take_along_axis(logp, idx, axis=1,
                                                mode="clip")[:, 0]
-                    loss = -jnp.sum(psel)
+                    loss = -jnp.sum(psel).reshape((1,))
                     y_flat = jnp.zeros((nb, buf_w), compute_dtype)
                 else:
                     y = heads[0]
                     y2 = y.reshape(nb, -1).astype(compute_dtype)
                     y_flat = jnp.pad(y2,
                                      ((0, 0), (0, buf_w - y2.shape[1])))
-                    loss = jnp.float32(0.0)
+                    loss = jnp.zeros((1,), jnp.float32)
                 return y_flat, loss
 
             return branch
@@ -1025,8 +1083,14 @@ class ShardedTrainer:
                     row = jnp.concatenate(parts) if parts else \
                         jnp.zeros((0,), compute_dtype)
                     rows.append(jnp.pad(row, (0, pack_l - row.shape[0])))
+                # the packed stage rows enter the shard_map REPLICATED
+                # and each device selects its row by stage id inside the
+                # body: resharding this in-jit concatenate onto the pipe
+                # axis trips a GSPMD partitioner bug under dp x pp (the
+                # partial-update all-reduce double-counts the data
+                # replicas, scaling every packed param by dp)
                 stacked = lax.with_sharding_constraint(
-                    jnp.stack(rows), NamedSharding(mesh, P("pipe", None)))
+                    jnp.stack(rows), NamedSharding(mesh, P(None, None)))
                 x = batch[dname].astype(compute_dtype)
                 xs = x.reshape((m_micro, gbatch // m_micro, -1))
                 xs = jnp.pad(xs, ((0, 0), (0, 0),
@@ -1043,13 +1107,16 @@ class ShardedTrainer:
                     br = [(lambda f: (lambda row, xx, mb:
                                       f(row, xx, mb, sd)))(f)
                           for f in branches]
+                    # (1,)-shaped loss through the body (see
+                    # hetero_pipeline_loss: jax 0.4.x mishandles
+                    # rank-0 shard_map residuals under grad)
                     local = hetero_pipeline_loss(br, xs_, ps, m_micro)
                     return lax.psum(lax.psum(local, "pipe"), "data")
 
                 return shard_map_nocheck(
                     smbody, mesh,
-                    (P("pipe", None), P(None, "data", None),
-                     x_side_specs), P())(stacked, xs, side)
+                    (P(None, None), P(None, "data", None),
+                     x_side_specs), P(None))(stacked, xs, side)[0]
 
             loss_sum, grads = jax.value_and_grad(loss_fn)(params)
             new_params, new_state = {}, {}

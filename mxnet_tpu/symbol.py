@@ -594,7 +594,8 @@ class Symbol:
 
     # -------------------------------------------------------- verification
     def verify(self, shapes=None, types=None, tp_size=1,
-               check_registry=False, **shape_kwargs):
+               check_registry=False, mesh=None, parallel=None,
+               **shape_kwargs):
         """Statically verify the graph BEFORE any compile/device time.
 
         Runs the :mod:`mxnet_tpu.analysis` graph verifier: per-node
@@ -608,13 +609,23 @@ class Symbol:
                 print(report)          # node-level diagnostics
             report.raise_if_errors()   # or fail hard
 
+        ``mesh`` ({axis: size}) additionally runs the distributed-
+        correctness pass (MXG011-016) for the composed parallel step
+        described by ``parallel`` (an ``analysis.build_config`` dict)::
+
+            net.verify(data=(32, 8, 64), mesh={"data": 2, "pipe": 2},
+                       parallel=analysis.build_config(
+                           pipeline_stages=2, data_shapes=...))
+
         Returns an :class:`mxnet_tpu.analysis.Report`.
         """
         from .analysis import verify_symbol
         known = dict(shapes or {})
         known.update(shape_kwargs)
         return verify_symbol(self, shapes=known, types=types,
-                             tp_size=tp_size, check_registry=check_registry)
+                             tp_size=tp_size,
+                             check_registry=check_registry,
+                             mesh=mesh, parallel=parallel)
 
     # ------------------------------------------------------------- binding
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",

@@ -162,6 +162,13 @@ CATALOG = {
                                     "bind-time graph_plan lookups that "
                                     "fell back to the greedy fusion "
                                     "plan (untuned graph/mesh/layout)"),
+    # ------------------------- static verification (mxnet_tpu.analysis)
+    "mxtpu_verify_findings_total": (COUNTER, ("rule",),
+                                    "verifier diagnostics reported, by "
+                                    "rule id (MXG001-016; every "
+                                    "Report.add increments — bind-time "
+                                    "strict checks, CLI runs and "
+                                    "ci_check sweeps all count)"),
     # ---------------------------- elastic training (parallel.reshard)
     "mxtpu_reshard_total": (COUNTER, ("kind",),
                             "mesh reshapes performed (kind=load — a "

@@ -1,0 +1,596 @@
+"""Distributed-correctness verifier (analysis.spmd, MXG011-016) +
+mxlint MXL006.
+
+One seeded-defect fixture per rule asserting the named node/stage/axis
+in the diagnostic, plus clean-configuration negative tests over the
+model zoo and the composed pipeline/sequence configs (ISSUE 13
+acceptance: each rule must DISCRIMINATE)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import spmd
+from mxnet_tpu.analysis.verifier import Report
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(report):
+    return [d.rule for d in report]
+
+
+def _find(report, rule):
+    return [d for d in report if d.rule == rule]
+
+
+def _mlp_tower(depth=4, hidden=32, num_classes=8):
+    net = mx.sym.Variable("data")
+    for i in range(depth):
+        net = mx.sym.FullyConnected(net, num_hidden=hidden,
+                                    name="fc%d" % i)
+        net = mx.sym.Activation(net, act_type="relu", name="relu%d" % i)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="out")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _ring_lm(seq, vocab, d=16, heads=2):
+    data = mx.sym.Variable("data")
+    x = mx.sym.Embedding(data, input_dim=vocab, output_dim=d,
+                         name="embed")
+    h = mx.sym.LayerNorm(x, name="ln1")
+    qkv = mx.sym.FullyConnected(h, num_hidden=3 * d, flatten=False,
+                                name="qkv")
+    qkv = mx.sym.Reshape(qkv, shape=(0, 0, 3, heads, -1))
+    q = mx.sym.Reshape(mx.sym.slice_axis(qkv, axis=2, begin=0, end=1),
+                       shape=(0, 0, -3, -2))
+    k = mx.sym.Reshape(mx.sym.slice_axis(qkv, axis=2, begin=1, end=2),
+                       shape=(0, 0, -3, -2))
+    v = mx.sym.Reshape(mx.sym.slice_axis(qkv, axis=2, begin=2, end=3),
+                       shape=(0, 0, -3, -2))
+    att = mx.sym._contrib_RingAttention(q, k, v, causal=True,
+                                        name="attn")
+    att = mx.sym.Reshape(att, shape=(0, 0, -3))
+    x = x + mx.sym.FullyConnected(att, num_hidden=d, flatten=False,
+                                  name="proj")
+    x = mx.sym.LayerNorm(x, name="ln_f")
+    x = mx.sym.Reshape(x, shape=(-1, d))
+    logits = mx.sym.FullyConnected(x, num_hidden=vocab, name="head")
+    return mx.sym.SoftmaxOutput(logits, name="softmax")
+
+
+# ------------------------------------------------------- seeded defects
+
+def test_mxg011_kv_push_subset_names_site():
+    """A DistKVStore push only SOME ranks issue is the canonical
+    desync: the pushing ranks block in the barrier forever."""
+    cfg = analysis.build_config(kv_push=True, kv_push_ranks=[0])
+    report = spmd.verify_spmd(None, {"data": 2}, cfg)
+    bad = _find(report, "MXG011")
+    assert bad and bad[0].node == "kv.push", str(report)
+    assert "rank 0" in bad[0].message and "deadlock" in bad[0].message
+
+
+def test_mxg011_ragged_ring_names_node_and_shapes():
+    """A sequence dim the ring size does not divide leaves neighbor
+    ranks ppermuting different block shapes — flagged at the attention
+    node with both shapes in the message."""
+    sym = _ring_lm(18, 16)
+    cfg = analysis.build_config(sequence_parallel=True,
+                                data_shapes={"data": (4, 18)},
+                                label_shapes={"softmax_label": (4, 18)})
+    report = spmd.verify_spmd(sym, {"data": 1, "model": 4}, cfg)
+    bad = _find(report, "MXG011")
+    assert bad and bad[0].node == "attn", str(report)
+    assert "ppermute" in bad[0].message
+    assert "(4, 5, 2, 8)" in bad[0].message \
+        and "(4, 4, 2, 8)" in bad[0].message
+
+
+def test_mxg011_unknown_axis_named():
+    ev = spmd.CollectiveEvent("psum", "modle", (4,), node="grads")
+    report = Report()
+    spmd.check_schedules({0: {"fwd": [ev], "bwd": []},
+                          1: {"fwd": [ev], "bwd": []}},
+                         {"model": 2}, report)
+    bad = _find(report, "MXG011")
+    assert bad and "modle" in bad[0].message, str(report)
+
+
+def test_mxg012_rank_conditioned_collective_in_jaxpr():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import shard_map_nocheck
+
+    devs = np.array(jax.devices("cpu")[:1])
+    mesh = Mesh(devs, ("data",))
+
+    def bad(x):
+        r = lax.axis_index("data")
+        return lax.cond(r == 0, lambda v: lax.psum(v, "data"),
+                        lambda v: v, x)
+
+    f = shard_map_nocheck(bad, mesh, (P("data"),), P("data"))
+    report = Report()
+    spmd.check_rank_divergence(jax.make_jaxpr(f)(jnp.ones((4,))),
+                               report, where="bad_step")
+    bad_d = _find(report, "MXG012")
+    assert bad_d and "psum" in bad_d[0].message, str(report)
+    assert "axis_index" in bad_d[0].message
+
+    def good(x):
+        return lax.psum(x, "data")
+
+    g = shard_map_nocheck(good, mesh, (P("data"),), P(None))
+    clean = Report()
+    spmd.check_rank_divergence(jax.make_jaxpr(g)(jnp.ones((4,))), clean)
+    assert clean.ok and not len(clean), str(clean)
+
+
+def test_mxg012_taint_crosses_scan_and_jit_boundaries():
+    """A rank-conditioned collective INSIDE a scan (or jit) body must
+    be found: the axis_index taint is mapped across the sub-jaxpr call
+    boundary (real step functions wrap their bodies in lax.scan)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import shard_map_nocheck
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+
+    def body(x):
+        r = lax.axis_index("data")
+
+        def tick(carry, _):
+            out = lax.cond(r == 0,
+                           lambda v: lax.psum(v, "data"),
+                           lambda v: v, carry)
+            return out, None
+
+        y, _ = lax.scan(tick, x, jnp.arange(3))
+        return y
+
+    f = shard_map_nocheck(body, mesh, (P("data"),), P("data"))
+    report = Report()
+    spmd.check_rank_divergence(jax.make_jaxpr(f)(jnp.ones((4,))),
+                               report, where="scan_step")
+    assert _find(report, "MXG012"), str(report)
+
+    def jit_body(x):
+        r = lax.axis_index("data")
+        return jax.jit(lambda v: lax.cond(
+            r == 0, lambda u: lax.psum(u, "data"), lambda u: u, v))(x)
+
+    g = shard_map_nocheck(jit_body, mesh, (P("data"),), P("data"))
+    report2 = Report()
+    spmd.check_rank_divergence(jax.make_jaxpr(g)(jnp.ones((4,))),
+                               report2, where="jit_step")
+    assert _find(report2, "MXG012"), str(report2)
+
+
+def test_mxg014_seq_on_data_axis_composes_with_model_tp():
+    """sequence shards on 'data' + tensor parallelism on 'model' is a
+    legitimate composition — no MXG014 conflict finding."""
+    report = Report()
+    spmd.check_sharding_composition(
+        None, {"data": 4, "model": 2},
+        analysis.build_config(sequence_parallel=True, seq_axis="data",
+                              tp_size=2, tp_rules={"fc0_weight": 0},
+                              data_shapes={"data": (4, 16)}),
+        report, arg_shapes={"fc0_weight": (32, 12)})
+    conflicts = [d for d in _find(report, "MXG014")
+                 if "sequence" in d.message and "conflict" in d.message]
+    assert not conflicts, str(report)
+
+
+def test_mxg013_batch_not_divisible_names_input():
+    sym = _mlp_tower()
+    cfg = analysis.build_config(pipeline_stages=2,
+                                pipeline_microbatches=2,
+                                data_shapes={"data": (15, 12)},
+                                label_shapes={"softmax_label": (15,)})
+    report = spmd.verify_spmd(sym, {"data": 2, "pipe": 2}, cfg)
+    bad = _find(report, "MXG013")
+    assert bad and bad[0].node == "data", str(report)
+    assert "15" in bad[0].message and "microbatches" in bad[0].message
+
+
+def test_mxg013_duplicated_stage_node_named():
+    """A hand-built plan assigning one node to two stages is flagged
+    with the node and both stage ids."""
+    sym = _mlp_tower()
+    from mxnet_tpu.parallel.pipeline import plan_pipeline_stages
+    topo = sym._topo()
+    stages = plan_pipeline_stages(topo, sym._entries,
+                                  {"data", "softmax_label"}, 2)
+    stages[1]["nodes"] = [stages[0]["nodes"][-1]] + stages[1]["nodes"]
+    cfg = analysis.build_config(pipeline_stages=2,
+                                pipeline_microbatches=2,
+                                data_shapes={"data": (16, 12)},
+                                label_shapes={"softmax_label": (16,)})
+    report = Report()
+    spmd.check_pipeline_partition(sym, {"data": 1, "pipe": 2}, cfg,
+                                  report, stages=stages)
+    bad = _find(report, "MXG013")
+    assert bad, str(report)
+    dup = stages[0]["nodes"][-1].name
+    assert bad[0].node == dup and "BOTH" in bad[0].message
+
+
+def test_mxg013_fused_chain_straddle_named():
+    """pipeline x fuse_blocks: a fused fc->relu chain the cut splits is
+    the contradiction MXG013 reports (stage bodies never fuse)."""
+    sym = _mlp_tower()
+    cfg = analysis.build_config(pipeline_stages=2,
+                                pipeline_microbatches=2,
+                                data_shapes={"data": (16, 12)},
+                                label_shapes={"softmax_label": (16,)})
+    cfg["fuse_blocks"] = True
+    report = spmd.verify_spmd(sym, {"data": 2, "pipe": 2}, cfg)
+    bad = _find(report, "MXG013")
+    assert bad and "straddles" in bad[0].message, str(report)
+    assert bad[0].node and bad[0].node.startswith("fc")
+
+
+def test_mxg014_reshard_rule_unknown_axis_flagged():
+    sym = _mlp_tower()
+    cfg = analysis.build_config(
+        data_shapes={"data": (16, 12)},
+        label_shapes={"softmax_label": (16,)},
+        reshard_rules=".*fc0_weight=modle")   # typo'd axis
+    report = spmd.verify_spmd(sym, {"data": 2, "model": 2}, cfg)
+    bad = _find(report, "MXG014")
+    assert bad and "modle" in bad[0].message, str(report)
+    assert "fc0_weight" in bad[0].message
+
+
+def test_mxg014_tp_rule_indivisible_dim_named():
+    sym = _mlp_tower(hidden=30)               # 30 % 4 != 0
+    cfg = analysis.build_config(
+        tp_size=4, tp_rules={"fc0_weight": 0},
+        data_shapes={"data": (16, 12)},
+        label_shapes={"softmax_label": (16,)})
+    report = spmd.verify_spmd(sym, {"data": 1, "model": 4}, cfg)
+    bad = _find(report, "MXG014")
+    assert bad and bad[0].node == "fc0_weight", str(report)
+    assert "divide" in bad[0].message
+
+
+def test_mxg014_seq_axis_conflict_named():
+    sym = _ring_lm(16, 16)
+    cfg = analysis.build_config(
+        sequence_parallel=True, tp_rules={"qkv_weight": 0},
+        data_shapes={"data": (4, 16)},
+        label_shapes={"softmax_label": (4, 16)})
+    report = spmd.verify_spmd(sym, {"data": 1, "model": 2}, cfg)
+    bad = _find(report, "MXG014")
+    assert bad and bad[0].node == "qkv_weight", str(report)
+    assert "sequence" in bad[0].message
+
+
+def test_mxg015_donated_group_read_after_step():
+    cfg = analysis.build_config(donate=["params", "opt_state"],
+                                post_step_reads=["params"])
+    report = spmd.verify_spmd(None, {"data": 2}, cfg)
+    bad = _find(report, "MXG015")
+    assert bad and bad[0].node == "params", str(report)
+    assert "donated" in bad[0].message
+    assert bad[0].severity == "error"
+
+
+def test_mxg015_provenance_replay_is_warning_only():
+    cfg = analysis.build_config(donate=["params", "batch"],
+                                numerics_provenance=True)
+    report = spmd.verify_spmd(None, {"data": 2}, cfg)
+    w = _find(report, "MXG015")
+    assert w and w[0].severity == "warning", str(report)
+    assert "post-update" in w[0].message
+    assert report.ok                         # warnings don't fail
+
+
+def test_mxg016_wrong_direction_ring_named():
+    perm = ((0, 1), (1, 2), (2, 3), (3, 0))
+    fwd = [spmd.CollectiveEvent("ppermute", "sp", (2, 4, 2, 8),
+                                node="attn", perm=perm)]
+    bwd_bad = [spmd.CollectiveEvent("ppermute", "sp", (2, 4, 2, 8),
+                                    node="attn", perm=perm)]
+    report = Report()
+    spmd.check_gradient_parity(fwd, bwd_bad, report, where="attn")
+    bad = _find(report, "MXG016")
+    assert bad and bad[0].node == "attn", str(report)
+    assert "rotate the wrong way" in bad[0].message
+
+    ok = Report()
+    spmd.check_gradient_parity(fwd, [spmd.dual_event(fwd[0])], ok)
+    assert ok.ok and not len(ok)
+
+
+def test_mxg016_missing_bwd_collective_counted():
+    fwd = [spmd.CollectiveEvent("ppermute", "sp", (4,), node="attn",
+                                perm=((0, 1), (1, 0)))]
+    report = Report()
+    spmd.check_gradient_parity(fwd, [], report, where="attn")
+    bad = _find(report, "MXG016")
+    assert bad and "1 structural collective" in bad[0].message
+
+
+def test_mxg016_fires_through_verify_spmd_on_broken_bwd(monkeypatch):
+    """check_ring_duality is WIRED: a ring_attention whose custom bwd
+    re-rotates the forward direction (no inverse ppermute) is flagged
+    through the plain verify_spmd entry point."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.parallel import sequence as seq_mod
+
+    real = seq_mod.ring_attention
+
+    def broken(q, k, v, mesh, seq_axis="data", causal=False,
+               batch_axis=None):
+        @jax.custom_vjp
+        def att(q_, k_, v_):
+            return real(q_, k_, v_, mesh, seq_axis=seq_axis,
+                        causal=causal, batch_axis=batch_axis)
+
+        def fwd(q_, k_, v_):
+            return real(q_, k_, v_, mesh, seq_axis=seq_axis,
+                        causal=causal,
+                        batch_axis=batch_axis), (q_, k_, v_)
+
+        def bwd(res, g):
+            q_, k_, v_ = res
+            # WRONG: a collective-free backward — the ring's inverse
+            # ppermutes never happen, dK/dV silently stay local
+            return (g, jnp.zeros_like(k_), jnp.zeros_like(v_))
+
+        att.defvjp(fwd, bwd)
+        return att(q, k, v)
+
+    monkeypatch.setattr(seq_mod, "ring_attention", broken)
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs a >=3-shard probe ring (self-inverse below)")
+    sym = _ring_lm(16, 16)
+    cfg = analysis.build_config(sequence_parallel=True,
+                                data_shapes={"data": (4, 16)},
+                                label_shapes={"softmax_label": (4, 16)})
+    report = spmd.verify_spmd(sym, {"data": 1, "model": 4}, cfg)
+    bad = _find(report, "MXG016")
+    assert bad and bad[0].node == "attn", str(report)
+    assert "missing the inverse" in bad[0].message
+
+
+def test_mxg016_real_ring_attention_grad_is_dual():
+    """The ACTUAL ring attention vjp satisfies duality: every forward
+    ppermute's inverse permutation appears in the gradient jaxpr."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.sequence import ring_attention
+
+    devs = np.array(jax.devices("cpu")[:1])
+    mesh = Mesh(devs, ("sp",))
+    q = jnp.asarray(np.random.RandomState(0)
+                    .randn(2, 16, 2, 8).astype("f"))
+
+    def loss(q_):
+        return jnp.sum(ring_attention(q_, q_, q_, mesh,
+                                      seq_axis="sp") ** 2)
+
+    fwd = spmd.collectives_in_jaxpr(jax.make_jaxpr(loss)(q))
+    grad = spmd.collectives_in_jaxpr(jax.make_jaxpr(jax.grad(loss))(q))
+    fwd_perms = [tuple(p["perm"]) for p in
+                 (prm for name, prm in fwd if name == "ppermute")]
+    assert fwd_perms, "ring attention forward must ppermute"
+    grad_perms = {tuple(prm["perm"]) for name, prm in grad
+                  if name == "ppermute"}
+    for perm in fwd_perms:
+        inv = tuple(sorted((d, s) for (s, d) in perm))
+        assert inv in grad_perms, (perm, grad_perms)
+
+
+# ------------------------------------------------------ clean sweeps
+
+def test_clean_zoo_models_under_dp_mesh():
+    from mxnet_tpu import models
+    for name in ("mlp", "lenet"):
+        net, report = analysis.verify_model(
+            name, mesh={"data": 2},
+            parallel=analysis.build_config())
+        assert report.ok and not report.warnings, (name, str(report))
+
+
+def test_clean_pipeline_config():
+    sym = _mlp_tower()
+    cfg = analysis.build_config(pipeline_stages=2,
+                                pipeline_microbatches=2,
+                                data_shapes={"data": (16, 12)},
+                                label_shapes={"softmax_label": (16,)})
+    report = sym.verify(data=(16, 12), softmax_label=(16,),
+                        mesh={"data": 2, "pipe": 2}, parallel=cfg)
+    assert report.ok and not report.warnings, str(report)
+
+
+def test_clean_sequence_config():
+    sym = _ring_lm(16, 16)
+    cfg = analysis.build_config(sequence_parallel=True,
+                                kv_push=True,
+                                data_shapes={"data": (4, 16)},
+                                label_shapes={"softmax_label": (4, 16)})
+    report = spmd.verify_spmd(sym, {"data": 1, "model": 4}, cfg)
+    assert report.ok and not report.warnings, str(report)
+
+
+def test_clean_composed_moe_kv_config():
+    cfg = analysis.build_config(moe_experts=4, kv_push=True)
+    report = spmd.verify_spmd(None, {"data": 2, "expert": 2}, cfg)
+    assert report.ok and not report.warnings, str(report)
+
+
+def test_verify_findings_metric_counts_rules():
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    cfg = analysis.build_config(kv_push=True, kv_push_ranks=[0])
+    spmd.verify_spmd(None, {"data": 2}, cfg)
+    val = telemetry.counter("mxtpu_verify_findings_total").labels(
+        rule="MXG011").get()
+    assert val >= 1, val
+
+
+# --------------------------------------------------- strict trainer bind
+
+def test_strict_bind_rejects_composed_defect():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+    with pytest.raises(MXNetError, match="MXG013"):
+        ShardedTrainer(
+            _mlp_tower(), build_mesh(n_devices=4, pp=2),
+            data_shapes={"data": (18, 12)},
+            label_shapes={"softmax_label": (18,)},
+            pipeline_stages=2, pipeline_microbatches=4, strict=True)
+
+
+def test_strict_bind_env_default(monkeypatch):
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+    monkeypatch.setenv("MXNET_TPU_STRICT_BIND", "1")
+    with pytest.raises(MXNetError, match="MXG013"):
+        ShardedTrainer(
+            _mlp_tower(), build_mesh(n_devices=4, pp=2),
+            data_shapes={"data": (18, 12)},
+            label_shapes={"softmax_label": (18,)},
+            pipeline_stages=2, pipeline_microbatches=4)
+
+
+def test_strict_bind_clean_pipeline_passes():
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+    tr = ShardedTrainer(
+        _mlp_tower(), build_mesh(n_devices=4, pp=2),
+        data_shapes={"data": (16, 12)},
+        label_shapes={"softmax_label": (16,)},
+        pipeline_stages=2, pipeline_microbatches=2, strict=True)
+    assert tr is not None
+
+
+# ------------------------------------------------------------ CLI
+
+def test_cli_mesh_pipeline_flags():
+    from mxnet_tpu.analysis.__main__ import main
+    rc = main(["--model", "mlp", "--mesh", "data=2"])
+    assert rc == 0
+    # --pipeline without --mesh is a usage error
+    with pytest.raises(SystemExit) as e:
+        main(["--model", "mlp", "--pipeline", "2"])
+    assert e.value.code == 2
+
+
+# ------------------------------------------------------------ MXL006
+
+def test_mxl006_rank_conditioned_collective_flagged():
+    mxlint = analysis.load_mxlint()
+    bad = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def sync(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        return lax.psum(x, 'data')\n"
+        "    return x\n")
+    findings = mxlint.lint_source(bad, "fixture.py")
+    f6 = [f for f in findings if f.rule == "MXL006"]
+    assert f6 and f6[0].line == 5, findings
+    assert "lax.psum" in f6[0].message
+
+
+def test_mxl006_rank_named_variable_and_while():
+    mxlint = analysis.load_mxlint()
+    bad = (
+        "def sync(x, rank, mh):\n"
+        "    y = pp(x) if rank == 0 else x\n"
+        "    while rank > 0:\n"
+        "        mh.process_barrier()\n"
+        "    return y\n")
+    findings = mxlint.lint_source(bad, "fixture.py")
+    f6 = [f for f in findings if f.rule == "MXL006"]
+    assert len(f6) == 1 and f6[0].line == 4, findings
+
+
+def test_mxl006_pragma_and_clean_patterns():
+    mxlint = analysis.load_mxlint()
+    ok = (
+        "from jax import lax\n"
+        "def sync(x, rank):\n"
+        "    r = lax.psum(x, 'data')\n"
+        "    if rank == 0:\n"
+        "        save(r)\n"
+        "    if rank == 0:\n"
+        "        g = lax.all_gather(x, 'data')  "
+        "# mxlint: allow-rank-collective(every peer enters via the "
+        "mirrored branch)\n"
+        "    return r\n")
+    findings = mxlint.lint_source(ok, "ok.py")
+    assert not [f for f in findings if f.rule == "MXL006"], findings
+
+
+def test_mxl006_nested_rank_branches_report_once():
+    mxlint = analysis.load_mxlint()
+    bad = (
+        "from jax import lax\n"
+        "def sync(x, rank):\n"
+        "    if rank == 0:\n"
+        "        if rank == 1:\n"
+        "            lax.psum(x, 'data')\n")
+    f6 = [f for f in mxlint.lint_source(bad, "fixture.py")
+          if f.rule == "MXL006"]
+    assert len(f6) == 1 and f6[0].line == 5, f6
+
+
+def test_verify_step_fn_flags_rank_conditioned_step():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import shard_map_nocheck
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+
+    def step(x):
+        def body(v):
+            r = lax.axis_index("data")
+            return lax.cond(r == 0, lambda u: lax.psum(u, "data"),
+                            lambda u: u, v)
+        return shard_map_nocheck(body, mesh, (P("data"),), P("data"))(x)
+
+    report = spmd.verify_step_fn(
+        step, (jax.ShapeDtypeStruct((4,), jnp.float32),),
+        where="bad.step")
+    bad = _find(report, "MXG012")
+    assert bad and "bad.step" in str(bad[0]), str(report)
+
+    def clean_step(x):
+        return shard_map_nocheck(lambda v: lax.psum(v, "data"), mesh,
+                                 (P("data"),), P(None))(x)
+
+    ok = spmd.verify_step_fn(
+        clean_step, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    assert ok.ok and not len(ok)
+
+
+def test_mxl006_repo_clean():
+    mxlint = analysis.load_mxlint()
+    paths = [os.path.join(REPO, d) for d in mxlint.DEFAULT_LINT_DIRS]
+    findings = [f for f in mxlint.lint_paths(paths)
+                if f.rule == "MXL006"]
+    assert not findings, "\n".join(str(f) for f in findings)

@@ -3,10 +3,10 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Twelve stages, all of which must be clean:
+Thirteen stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
-   the TPU-hazard rules MXL001-005; pragmas with reasons are the only
+   the TPU-hazard rules MXL001-006; pragmas with reasons are the only
    accepted suppressions.
 2. **op-registry self-check** — alias/hook/TP-rule drift
    (:func:`mxnet_tpu.ops.registry.selfcheck`).
@@ -83,6 +83,18 @@ Twelve stages, all of which must be clean:
     greedy executor's outputs and gradients numerically.  (The
     stage-4 drift guard covers the new ``mxtpu_plan_cache_*`` metrics
     automatically.)
+13. **SPMD gate** — the distributed-correctness pass
+    (``mxnet_tpu.analysis.spmd``, MXG011-016): one seeded-defect
+    fixture per rule must produce the expected diagnostic with the
+    offending node/stage/axis NAMED (a rank-subset kvstore push, a
+    ragged ring-attention shard, an axis_index-conditioned psum in a
+    jaxpr, a duplicated/fused-straddling pipeline stage, a typo'd
+    reshard-rule axis, a donated-then-read buffer group, a
+    wrong-direction backward ring), AND a clean sweep — every zoo
+    model under a dp mesh plus the composed pipeline and
+    sequence-parallel transformer configs — must report ZERO
+    findings.  (The stage-4 drift guard covers the new
+    ``mxtpu_verify_findings_total`` metric automatically.)
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -118,7 +130,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/12] mxlint: %d finding(s) over %s"
+        say("ci_check[1/13] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -127,7 +139,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/12] registry selfcheck: %d problem(s)"
+        say("ci_check[2/13] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -141,14 +153,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/12] verify model %-22s %s" % (name, status))
+            say("ci_check[3/13] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/12] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/13] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -156,7 +168,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/12] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/13] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -164,7 +176,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/12] distview smoke: %d problem(s)"
+        say("ci_check[6/13] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -172,14 +184,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/12] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/13] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/12] perf ground truth: %d problem(s)"
+        say("ci_check[8/13] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -187,7 +199,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/12] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/13] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -195,7 +207,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/12] reshard gate: %d problem(s)"
+        say("ci_check[10/13] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -204,7 +216,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/12] numerics gate: %d problem(s)"
+        say("ci_check[11/13] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -213,10 +225,18 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/12] plan search: %d problem(s)"
+        say("ci_check[12/13] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
+            say("  " + p)
+
+        # stage 13: SPMD gate (seeded-defect discrimination per
+        # MXG011-016 rule + clean sweep over zoo and composed configs)
+        problems = spmd_check(repo_root)
+        say("ci_check[13/13] spmd gate: %d problem(s)" % len(problems))
+        for p in problems:
+            failures.append("spmd: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -473,7 +493,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/12] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/13] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -1197,6 +1217,165 @@ def plansearch_check(repo_root=_ROOT):
                 break
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def spmd_check(repo_root=_ROOT):
+    """SPMD gate (stage 13).  Two legs:
+
+    1. seeded-defect discrimination — one fixture per MXG011-016 rule;
+       each must fire with the offending node/stage/axis named in the
+       diagnostic;
+    2. clean sweep — every zoo model under a {data:2} mesh, plus the
+       composed pipeline (mlp tower, dp x pp) and sequence-parallel
+       (ring-attention LM) configs, must report ZERO findings.
+    """
+    problems = []
+    import mxnet_tpu as mx
+    from mxnet_tpu import analysis
+    from mxnet_tpu.analysis import spmd
+    from mxnet_tpu.analysis.verifier import Report
+
+    def tower():
+        net = mx.sym.Variable("data")
+        for i in range(4):
+            net = mx.sym.FullyConnected(net, num_hidden=32,
+                                        name="fc%d" % i)
+            net = mx.sym.Activation(net, act_type="relu",
+                                    name="relu%d" % i)
+        net = mx.sym.FullyConnected(net, num_hidden=8, name="out")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def ring_lm(seq, vocab=16, d=16, heads=2):
+        data = mx.sym.Variable("data")
+        x = mx.sym.Embedding(data, input_dim=vocab, output_dim=d,
+                             name="embed")
+        h = mx.sym.LayerNorm(x, name="ln1")
+        qkv = mx.sym.FullyConnected(h, num_hidden=3 * d, flatten=False,
+                                    name="qkv")
+        qkv = mx.sym.Reshape(qkv, shape=(0, 0, 3, heads, -1))
+        cut = lambda i: mx.sym.Reshape(
+            mx.sym.slice_axis(qkv, axis=2, begin=i, end=i + 1),
+            shape=(0, 0, -3, -2))
+        att = mx.sym._contrib_RingAttention(cut(0), cut(1), cut(2),
+                                            causal=True, name="attn")
+        att = mx.sym.Reshape(att, shape=(0, 0, -3))
+        x = x + mx.sym.FullyConnected(att, num_hidden=d, flatten=False,
+                                      name="proj")
+        x = mx.sym.Reshape(mx.sym.LayerNorm(x, name="ln_f"),
+                           shape=(-1, d))
+        logits = mx.sym.FullyConnected(x, num_hidden=vocab, name="head")
+        return mx.sym.SoftmaxOutput(logits, name="softmax")
+
+    def expect(tag, report, rule, *needles):
+        found = [d for d in report if d.rule == rule]
+        if not found:
+            problems.append("%s: rule %s did not fire (%s)"
+                            % (tag, rule, report))
+            return
+        text = "\n".join(str(d) for d in found)
+        for needle in needles:
+            if needle not in text:
+                problems.append("%s: %s diagnostic does not name %r: %s"
+                                % (tag, rule, needle, text))
+
+    # --- MXG011: rank-subset kvstore push + ragged ring shard
+    rep = spmd.verify_spmd(None, {"data": 2}, analysis.build_config(
+        kv_push=True, kv_push_ranks=[0]))
+    expect("kv-subset", rep, "MXG011", "kv.push", "deadlock")
+    rep = spmd.verify_spmd(
+        ring_lm(18), {"data": 1, "model": 4},
+        analysis.build_config(sequence_parallel=True,
+                              data_shapes={"data": (4, 18)},
+                              label_shapes={"softmax_label": (4, 18)}))
+    expect("ragged-ring", rep, "MXG011", "attn", "ppermute")
+
+    # --- MXG012: axis_index-conditioned psum in a jaxpr
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.parallel.mesh import shard_map_nocheck
+    import numpy as np
+    mesh1 = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+
+    def bad(x):
+        r = lax.axis_index("data")
+        return lax.cond(r == 0, lambda v: lax.psum(v, "data"),
+                        lambda v: v, x)
+
+    rep = Report()
+    spmd.check_rank_divergence(
+        jax.make_jaxpr(shard_map_nocheck(bad, mesh1, (P("data"),),
+                                         P("data")))(jnp.ones((4,))),
+        rep, where="seeded_step")
+    expect("rank-cond", rep, "MXG012", "seeded_step", "psum")
+
+    # --- MXG013: duplicated stage node + fused straddle
+    net = tower()
+    from mxnet_tpu.parallel.pipeline import plan_pipeline_stages
+    stages = plan_pipeline_stages(net._topo(), net._entries,
+                                  {"data", "softmax_label"}, 2)
+    dup = stages[0]["nodes"][-1]
+    stages[1]["nodes"] = [dup] + stages[1]["nodes"]
+    cfg = analysis.build_config(pipeline_stages=2,
+                                pipeline_microbatches=2,
+                                data_shapes={"data": (16, 12)},
+                                label_shapes={"softmax_label": (16,)})
+    rep = Report()
+    spmd.check_pipeline_partition(net, {"data": 1, "pipe": 2}, cfg,
+                                  rep, stages=stages)
+    expect("dup-stage", rep, "MXG013", dup.name, "BOTH")
+    fcfg = dict(cfg)
+    fcfg["fuse_blocks"] = True
+    rep = spmd.verify_spmd(tower(), {"data": 2, "pipe": 2}, fcfg)
+    expect("straddle", rep, "MXG013", "straddles")
+
+    # --- MXG014: typo'd reshard-rule axis
+    rep = spmd.verify_spmd(
+        tower(), {"data": 2, "model": 2},
+        analysis.build_config(
+            data_shapes={"data": (16, 12)},
+            label_shapes={"softmax_label": (16,)},
+            reshard_rules=".*fc0_weight=modle"))
+    expect("typo-axis", rep, "MXG014", "modle", "fc0_weight")
+
+    # --- MXG015: donated group read after dispatch
+    rep = spmd.verify_spmd(None, {"data": 2}, analysis.build_config(
+        donate=["params"], post_step_reads=["params"]))
+    expect("donate-read", rep, "MXG015", "params", "donated")
+
+    # --- MXG016: backward ring rotating the wrong way
+    perm = ((0, 1), (1, 2), (2, 3), (3, 0))
+    fwd = [spmd.CollectiveEvent("ppermute", "sp", (2, 4, 2, 8),
+                                node="attn", perm=perm)]
+    rep = Report()
+    spmd.check_gradient_parity(
+        fwd, [spmd.CollectiveEvent("ppermute", "sp", (2, 4, 2, 8),
+                                   node="attn", perm=perm)],
+        rep, where="attn")
+    expect("wrong-ring", rep, "MXG016", "attn", "wrong way")
+
+    # --- clean sweep: zoo under a dp mesh + composed configs
+    from mxnet_tpu.models import _MODELS
+    for name in _MODELS:
+        _net, report = analysis.verify_model(
+            name, mesh={"data": 2}, parallel=analysis.build_config())
+        if len(report):
+            problems.append("clean sweep: model %s has findings: %s"
+                            % (name, report))
+    report = spmd.verify_spmd(tower(), {"data": 2, "pipe": 2}, cfg)
+    if len(report):
+        problems.append("clean sweep: pipeline config has findings: %s"
+                        % report)
+    report = spmd.verify_spmd(
+        ring_lm(16), {"data": 1, "model": 4},
+        analysis.build_config(sequence_parallel=True, kv_push=True,
+                              data_shapes={"data": (4, 16)},
+                              label_shapes={"softmax_label": (4, 16)}))
+    if len(report):
+        problems.append("clean sweep: sequence config has findings: %s"
+                        % report)
     return problems
 
 

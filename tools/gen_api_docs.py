@@ -122,7 +122,12 @@ SEE_ALSO = {
     "parallel": ["[resilience](resilience.md) — multihost init/barrier "
                  "timeouts, watchdog restarts, preemption handler",
                  "[analysis](analysis.md) — MXG007 sharding-coverage "
-                 "verification against tp_rules",
+                 "verification against tp_rules, and the "
+                 "distributed-correctness pass (MXG011-016, "
+                 "`analysis.spmd`): collective matching, pipeline "
+                 "partition validity, sharding-spec composition and "
+                 "fwd/bwd collective duality, run at "
+                 "`ShardedTrainer(strict=True)` bind time",
                  "[telemetry](telemetry.md) — trainer/pipeline spans, "
                  "kvstore traffic counters, the trainer step's memory "
                  "plan + HBM budget check, the flight-recorder black "

@@ -32,11 +32,19 @@ MXL005   train-step wrapper jitted without buffer donation: a
          ``train_step``, ``*_step``) passed to ``jax.jit`` without
          ``donate_argnums``/``donate_argnames`` — parameters and
          optimizer state are then double-buffered in HBM.
+MXL006   collective inside a rank-conditioned branch: a collective
+         call (``psum``/``ppermute``/``all_gather``/``barrier``/...)
+         lexically inside an ``if``/``while`` whose test reads
+         ``process_index()``/``axis_index()`` or a rank-named
+         variable.  Only SOME ranks reach the collective; the rest
+         block its peers forever — the SPMD divergence class the
+         graph-level MXG012 rule checks in jaxprs.
 =======  ============================================================
 
 Pragmas: ``# mxlint: allow-broad-except(reason)`` (and the analogous
 ``allow-host-sync`` / ``allow-recompile-hazard`` /
-``allow-capture-mutation`` / ``allow-missing-donate``) or the generic
+``allow-capture-mutation`` / ``allow-missing-donate`` /
+``allow-rank-collective``) or the generic
 ``# mxlint: disable=MXL002(reason)``, placed on the offending line or
 the line above it.  A non-empty reason is required — a bare pragma is
 itself reported (MXL000).
@@ -63,6 +71,8 @@ RULES = {
               "Python concreteness)",
     "MXL004": "mutation of captured state inside a jit body",
     "MXL005": "train-step wrapper jitted without donate_argnums",
+    "MXL006": "collective inside a rank-conditioned branch (SPMD "
+              "divergence: only some ranks reach it)",
 }
 
 DEFAULT_LINT_DIRS = ("mxnet_tpu", "tools", "examples")
@@ -73,6 +83,7 @@ _PRAGMA_NAMES = {
     "allow-recompile-hazard": "MXL003",
     "allow-capture-mutation": "MXL004",
     "allow-missing-donate": "MXL005",
+    "allow-rank-collective": "MXL006",
 }
 
 _PRAGMA_RE = re.compile(
@@ -99,6 +110,20 @@ _MUTATING_METHODS = {"append", "extend", "insert", "add", "discard",
                      "remove", "sort", "reverse"}
 
 _STEP_NAME_RE = re.compile(r"(^|_)(train_)?step(_|$)|^train_step")
+
+# ---- MXL006: collectives under rank-conditioned branches
+# collective call names (bare or dotted tail): the cross-rank surface
+_COLLECTIVE_FUNCS = {
+    "psum", "pmax", "pmin", "pmean", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter", "reduce_scatter",
+    "pbroadcast", "axis_index_groups",
+    "barrier", "process_barrier", "pre_collective_barrier",
+    "sync_global_devices", "broadcast_one_to_all", "process_allgather",
+}
+# names whose appearance in an if/while test marks it rank-conditioned
+_RANK_SOURCES = {"process_index", "axis_index", "host_id", "process_id",
+                 "local_rank"}
+_RANK_NAME_RE = re.compile(r"(^|_)rank(_|$)|^rank$")
 
 
 class Finding:
@@ -621,6 +646,71 @@ def _check_missing_donate(tree, findings, pragmas, path):
 
 # ---------------------------------------------------------------- driver
 
+def _rank_conditioned(test):
+    """Does this if/while test read the process/device rank?  True for
+    a call to ``process_index``/``axis_index``-style accessors (bare or
+    dotted) or a name/attribute matching ``rank``/``*_rank``/``rank_*``
+    anywhere in the expression."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None and d.split(".")[-1] in _RANK_SOURCES:
+                return True
+        elif isinstance(node, ast.Name):
+            if node.id in _RANK_SOURCES or _RANK_NAME_RE.search(node.id):
+                return True
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _RANK_SOURCES or \
+                    _RANK_NAME_RE.search(node.attr):
+                return True
+    return False
+
+
+def _check_rank_collective(tree, findings, pragmas, path):
+    """MXL006: a collective call lexically inside a branch whose test is
+    rank-conditioned.  Both arms count — the divergence is that SOME
+    ranks take a different path around the collective, whichever arm it
+    sits in.  The SPMD-safe patterns are: issue the collective on EVERY
+    rank and discard/mask the result, or keep rank-conditioned work
+    collective-free."""
+    reported = set()      # one finding per call site even when nested
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            continue
+        if not _rank_conditioned(node.test):
+            continue
+        arms = []
+        if isinstance(node, ast.IfExp):
+            arms = [node.body, node.orelse]
+        else:
+            arms = list(node.body) + list(node.orelse)
+        for arm in arms:
+            for sub in ast.walk(arm):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = _dotted(sub.func)
+                if d is None:
+                    continue
+                tail = d.split(".")[-1]
+                if tail not in _COLLECTIVE_FUNCS:
+                    continue
+                if id(sub) in reported:
+                    continue
+                reported.add(id(sub))
+                if _suppressed(pragmas, sub.lineno, "MXL006"):
+                    continue
+                findings.append(Finding(
+                    path, sub.lineno, "MXL006",
+                    "collective %r inside a rank-conditioned branch "
+                    "(test at line %d): only some ranks reach it and "
+                    "the rest block its peers forever; issue the "
+                    "collective on every rank (mask the result "
+                    "instead), or annotate with '# mxlint: "
+                    "allow-rank-collective(reason)' if every peer "
+                    "provably takes the same path"
+                    % (d, node.test.lineno)))
+
+
 def lint_source(source, path="<string>"):
     """Lint one source string; returns a list of Findings."""
     findings = []
@@ -634,6 +724,7 @@ def lint_source(source, path="<string>"):
     _check_broad_except(tree, findings, pragmas, path)
     _check_jit_hazards(tree, findings, pragmas, path)
     _check_missing_donate(tree, findings, pragmas, path)
+    _check_rank_collective(tree, findings, pragmas, path)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 

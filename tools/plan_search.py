@@ -87,9 +87,9 @@ def main(argv=None):
 
     mesh = None
     if args.mesh:
+        from mxnet_tpu.parallel.reshard import parse_axes
         try:
-            mesh = {k: int(v) for k, v in
-                    (kv.split("=") for kv in args.mesh.split(","))}
+            mesh = parse_axes(args.mesh)
         except ValueError:
             ap.error("--mesh must look like 'data=8,model=2'")
 

@@ -50,19 +50,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def parse_mesh(spec):
     """``"data=4,model=2"`` → ``{"data": 4, "model": 2}`` (the
     build_mesh_from_axes/mesh-descriptor axes form); ``""``/``"1"`` →
-    ``{}`` (single device)."""
-    axes = {}
-    for part in (spec or "").split(","):
-        part = part.strip()
-        if not part or part == "1":
-            continue
-        name, _, size = part.partition("=")
-        if not name or not size.strip().isdigit():
-            raise ValueError(
-                "bad --mesh entry %r (expected axis=size[,axis=size])"
-                % part)
-        axes[name.strip()] = int(size)
-    return axes
+    ``{}`` (single device).  Delegates to the shared
+    ``parallel.reshard.parse_axes`` grammar."""
+    from mxnet_tpu.parallel.reshard import parse_axes
+    return parse_axes(spec)
 
 
 def _read_arrays(prefix, epoch):
