@@ -23,7 +23,9 @@ carries the plan summary (blocks fused, relayouts eliminated) in a
 leg with per-leg step-program sizes (top-level jaxpr equations — each
 fused block collapses its chain into ONE custom-vjp call).
 
-The JSON also carries a ``costdb`` roll-up (telemetry.costdb: measured
+The JSON also carries an ``io`` block (telemetry.ioview: per-stage
+input-pipeline seconds/items/bytes + the bottleneck verdict — empty on
+synthetic-batch runs), a ``costdb`` roll-up (telemetry.costdb: measured
 per-program wall/MFU + the worst-MFU fused blocks with their roofline
 bound; set ``MXNET_TPU_COSTDB`` to persist the full record set), an
 ``autotune`` block (tuning-cache mode + hit/miss counts + the tuned
@@ -302,6 +304,10 @@ def _emit(result, fusion=None):
     cost = costdb.summary()
     cost["flushed_to"] = costdb.flush()
     result["costdb"] = cost
+    # data-plane evidence (telemetry.ioview): per-stage seconds/items/
+    # bytes + the bottleneck verdict — empty stages on synthetic-batch
+    # runs, populated when the bench is fed from a real pipeline
+    result["io"] = telemetry.ioview.summary()
     # tuning-cache attribution: hit/miss counts plus the identity of
     # every tuned config this run dispatched with, so bench_diff
     # trajectories can attribute a win to tuning (not just see it)

@@ -137,6 +137,21 @@ _CATALOG = {
     "MXNET_TPU_FLIGHT_EVENTS": ("512", "honored",
                                 "flight-recorder ring capacity "
                                 "(oldest events fall off)"),
+    "MXNET_TPU_IOVIEW_EVERY": ("1", "honored",
+                               "attach the input-pipeline io block "
+                               "(per-stage seconds/items/bytes, "
+                               "stall/starved, occupancy, iterator "
+                               "position) to every Nth step's JSONL "
+                               "record (telemetry.ioview; 0 disables "
+                               "the per-step block — stage metrics and "
+                               "the bottleneck classifier keep "
+                               "running)"),
+    "MXNET_TPU_IOVIEW_WINDOW": ("5", "honored",
+                                "ioview bottleneck-classifier window "
+                                "in seconds: per window, consumer-"
+                                "stall vs producer-starved time picks "
+                                "producer-bound (naming the slowest "
+                                "stage) / consumer-bound / balanced"),
     "MXNET_TPU_SKEW_EVERY": ("8", "honored",
                              "measure the pre-collective timestamp "
                              "barrier (collective wait + rank skew) "

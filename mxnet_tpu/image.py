@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import os
 import random
+import time
 
 import numpy as np
 
@@ -20,6 +21,7 @@ from .base import MXNetError
 from . import io as io_mod
 from . import ndarray as nd
 from . import recordio
+from .telemetry import ioview as _ioview
 
 __all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
            "random_crop", "center_crop", "color_normalize",
@@ -32,9 +34,17 @@ __all__ = ["imdecode", "scale_down", "resize_short", "fixed_crop",
 
 def imdecode(buf, flag=1, to_rgb=True, out=None):
     """Decode an image byte buffer to HWC uint8 (reference image.imdecode,
-    backed by the imdecode op / OpenCV there, PIL here)."""
+    backed by the imdecode op / OpenCV there, PIL here).
+
+    Accounted as the ioview ``decode`` stage (wall per image, input
+    bytes); the ``io.decode`` fault seam fires per image — a
+    ``kind=delay`` spec is a seeded slow decoder for bottleneck-
+    attribution drills (docs/api/telemetry.md)."""
     import io as _pyio
     from PIL import Image
+    from . import resilience
+    t0 = time.perf_counter()
+    resilience.fault_point("io.decode")
     im = Image.open(_pyio.BytesIO(buf if isinstance(buf, (bytes, bytearray))
                                   else bytes(buf)))
     im = im.convert("RGB" if flag else "L")
@@ -43,6 +53,8 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
         arr = arr[:, :, ::-1]  # RGB -> BGR (OpenCV convention)
     if arr.ndim == 2:
         arr = arr[:, :, None]
+    _ioview.account("decode", time.perf_counter() - t0, items=1,
+                    nbytes=len(buf))
     return arr
 
 
@@ -353,7 +365,10 @@ class ImageIter(io_mod.DataIter):
             self.auglist = CreateAugmenter(data_shape, **kwargs)
         else:
             self.auglist = aug_list
+        self.part_index = int(part_index)
+        self.num_parts = int(num_parts)
         self.cur = 0
+        self._epochs = -1           # the constructor reset brings it to 0
         self.reset()
 
     def reset(self):
@@ -362,6 +377,22 @@ class ImageIter(io_mod.DataIter):
         if self.imgrec is not None:
             self.imgrec.reset()
         self.cur = 0
+        self._epochs += 1
+
+    def position(self):
+        """{"epoch", "shard", "num_shards", "offset", "resyncs"} —
+        the advisory iterator position (``telemetry.ioview``): record
+        offset within this shard's epoch, plus the underlying reader's
+        corruption-resync count."""
+        if self.seq is not None:
+            offset = self.cur
+        elif self.imgrec is not None:
+            offset = self.imgrec.records_read
+        else:
+            offset = 0
+        return {"epoch": self._epochs, "shard": self.part_index,
+                "num_shards": self.num_parts, "offset": int(offset),
+                "resyncs": int(getattr(self.imgrec, "resyncs", 0) or 0)}
 
     def next_sample(self):
         """Read + decode one sample."""
@@ -391,6 +422,7 @@ class ImageIter(io_mod.DataIter):
             if self.label_width > 1 else np.zeros(batch_size,
                                                   dtype=np.float32)
         i = 0
+        t_batch = 0.0
         try:
             while i < batch_size:
                 label, s = self.next_sample()
@@ -402,6 +434,7 @@ class ImageIter(io_mod.DataIter):
                     logging.debug("Invalid image, skipping:  %s", str(e))
                     continue
                 data = self.augmentation_transform(data)
+                t0 = time.perf_counter()
                 for datum in data:
                     assert i < batch_size, \
                         "Batch size must be multiple of augmenter output"
@@ -413,9 +446,14 @@ class ImageIter(io_mod.DataIter):
                         batch_label[i] = label if np.isscalar(label) \
                             else np.asarray(label).reshape(-1)[0]
                     i += 1
+                t_batch += time.perf_counter() - t0
         except StopIteration:
             if not i:
                 raise StopIteration
+        # batch-assembly stage: the cast/transpose/copy into the batch
+        # buffer (decode and augment account themselves above)
+        _ioview.account("batch", t_batch, items=i,
+                        nbytes=batch_data.nbytes)
         return io_mod.DataBatch([nd.array(batch_data)],
                                 [nd.array(batch_label)],
                                 pad=batch_size - i)
@@ -437,6 +475,9 @@ class ImageIter(io_mod.DataIter):
             return fin.read()
 
     def augmentation_transform(self, data):
+        t0 = time.perf_counter()
         for aug in self.auglist:
             data = [ret for src in data for ret in aug(src)]
+        _ioview.account("augment", time.perf_counter() - t0,
+                        items=len(data))
         return data

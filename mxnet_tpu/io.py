@@ -23,16 +23,8 @@ from .base import MXNetError
 from . import ndarray
 from . import resilience
 from . import telemetry
+from .telemetry import ioview as _ioview
 from .ndarray import NDArray, array
-
-# prefetcher observability (docs/api/telemetry.md): queue depth +
-# consumer stall time, per iterator family (host thread vs device stager)
-_HOST_STALL = telemetry.counter(
-    "mxtpu_io_prefetch_stall_seconds_total").labels(iter="host")
-_HOST_DEPTH = telemetry.gauge("mxtpu_io_prefetch_depth").labels(iter="host")
-_DEV_STALL = telemetry.counter(
-    "mxtpu_io_prefetch_stall_seconds_total").labels(iter="device")
-_DEV_DEPTH = telemetry.gauge("mxtpu_io_prefetch_depth").labels(iter="device")
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "DevicePrefetchIter",
            "ResizeIter",
@@ -116,6 +108,18 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    def position(self):
+        """Advisory iterator position for the data-plane observability
+        layer (``telemetry.ioview``): a JSON-able dict — by convention
+        ``{"epoch", "shard", "num_shards", "offset", "resyncs"}``, any
+        subset — or None when the iterator tracks nothing.  Rides each
+        sampled step's telemetry JSONL record and the checkpoint
+        manifest's ``data_position`` meta; wrappers delegate to their
+        inner iterator (prefetchers run AHEAD of the consumer by their
+        queue depth, so a wrapped position is the producer's, not the
+        trainer's — advisory, never used for control flow)."""
+        return None
+
 
 class ResizeIter(DataIter):
     """Resize another iterator to ``size`` batches per epoch
@@ -167,6 +171,9 @@ class ResizeIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    def position(self):
+        return self.data_iter.position()
+
 
 class PrefetchingIter(DataIter):
     """Thread-prefetch over one or more iterators (reference io.py:319;
@@ -193,7 +200,14 @@ class PrefetchingIter(DataIter):
 
         def prefetch_func(self, i):
             while True:
+                # producer-starved time: this thread is idle because the
+                # consumer has not taken the previous batch — a slow
+                # consumer must not be misread as a healthy pipeline
+                # (the consumer-bound half of the bottleneck verdict)
+                t_wait = time.perf_counter()
                 self.data_taken[i].wait()
+                _ioview.note_starved("host",
+                                     time.perf_counter() - t_wait)
                 if not self.started:
                     break
                 try:
@@ -201,13 +215,28 @@ class PrefetchingIter(DataIter):
                     # with backoff (transient-read semantics); a real —
                     # or exhausted — error is surfaced on the consumer
                     # in iter_next instead of killing this thread and
-                    # hanging the consumer on data_ready forever
+                    # hanging the consumer on data_ready forever.  The
+                    # host_prefetch stage is this window EXCLUSIVE of
+                    # the inner stages the upstream next() accounts on
+                    # this same thread (read/decode/augment/batch) —
+                    # charging them twice would make host_prefetch >=
+                    # their sum by construction, so the slowest-stage
+                    # verdict could never name the real culprit.  A
+                    # kind=delay seam fault (a seeded slow stage) is
+                    # outside the inner stages and lands here
+                    t_work = time.perf_counter()
+                    inner0 = _ioview.thread_accounted()
                     resilience.retry_call(
                         resilience.fault_point, args=("io.prefetch",),
                         retries=2, base_delay=0.01, max_delay=0.1,
                         exceptions=(resilience.FaultInjected,),
                         name="io.prefetch")
                     self.next_batch[i] = self.iters[i].next()
+                    inner = _ioview.thread_accounted() - inner0
+                    _ioview.account(
+                        "host_prefetch",
+                        max(0.0, time.perf_counter() - t_work - inner),
+                        items=1)
                 except StopIteration:
                     self.next_batch[i] = None
                 except BaseException as e:  # mxlint: allow-broad-except(stored and re-raised on the consumer thread, not swallowed)
@@ -215,13 +244,12 @@ class PrefetchingIter(DataIter):
                     self.prefetch_errors[i] = e
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
-                # producer side drives the depth gauge: a composite
-                # batch counts as staged once EVERY slot is ready, and
-                # the value must hold between iter_next calls so
-                # scrapes/snapshots see it (the consumer zeroes it when
-                # it takes the batch)
+                # a composite batch counts as staged once EVERY slot is
+                # ready; the occupancy tracker owns the depth value (the
+                # consumer zeroes it when it takes the batch) and holds
+                # it between iter_next calls so scrapes/snapshots see it
                 if all(e.is_set() for e in self.data_ready):
-                    _HOST_DEPTH.set(1)
+                    _ioview.queue_tracker("host").set_depth(1)
 
         self.prefetch_threads = [
             threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
@@ -261,6 +289,8 @@ class PrefetchingIter(DataIter):
             e.clear()
         for e in self.data_taken:
             e.set()
+        # the staged composite (if any) was discarded above
+        _ioview.queue_tracker("host").set_depth(0)
 
     def iter_next(self):
         # consumer stall: time blocked on the prefetch threads — nonzero
@@ -268,7 +298,7 @@ class PrefetchingIter(DataIter):
         t0 = time.perf_counter()
         for e in self.data_ready:
             e.wait()
-        _HOST_STALL.inc(time.perf_counter() - t0)
+        _ioview.note_stall("host", time.perf_counter() - t0)
         errs = [e for e in self.prefetch_errors if e is not None]
         if errs:
             # re-arm EVERY slot before raising so a caller that treats
@@ -298,7 +328,7 @@ class PrefetchingIter(DataIter):
             e.clear()
         for e in self.data_taken:
             e.set()
-        _HOST_DEPTH.set(0)
+        _ioview.queue_tracker("host").set_depth(0)
         return True
 
     def next(self):
@@ -317,6 +347,12 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    def position(self):
+        """The FIRST wrapped iterator's position (composite iterators
+        advance in lockstep), advisory: the producer thread runs one
+        batch ahead of the consumer."""
+        return self.iters[0].position()
 
 
 def _init_data(data, allow_empty, default_name):
@@ -418,22 +454,46 @@ class DevicePrefetchIter:
         def worker():
             # payloads are tagged, so a stage_fn returning None or a
             # tuple is never mistaken for a control message
+            tracker = _ioview.queue_tracker("device")
             try:
                 for batch in self._it:
                     if self._stop:
                         return
                     # io.prefetch fault seam: injected staging faults
                     # retry with backoff; exhaustion surfaces on the
-                    # consumer like any other staging error
+                    # consumer like any other staging error (a
+                    # kind=delay fault is a seeded slow device_stage)
+                    t_work = time.perf_counter()
                     resilience.retry_call(
                         resilience.fault_point, args=("io.prefetch",),
                         retries=2, base_delay=0.01, max_delay=0.1,
                         exceptions=(resilience.FaultInjected,),
                         name="io.prefetch")
-                    staged = self._stage(self._to_host_dict(batch))
+                    host = self._to_host_dict(batch)
+                    nbytes = sum(getattr(v, "nbytes", 0)
+                                 for v in host.values())
+                    staged = self._stage(host)
+                    _ioview.account("device_stage",
+                                    time.perf_counter() - t_work,
+                                    items=1, nbytes=nbytes)
+                    # the tracker owns the depth counter: the old
+                    # producer/consumer set(qsize()) pair raced and the
+                    # exported depth flapped (ISSUE 14 satellite).
+                    # Increment BEFORE the put: the consumer decrements
+                    # after its take, so depth transiently over-reads by
+                    # one instead of under-reading — an underflow would
+                    # hit the tracker's 0-clamp and leave a permanent +1
+                    # offset (a put that loses the race to a cancelled
+                    # reset is settled by reset's set_depth(0))
+                    tracker.adjust(+1)
+                    # a blocked put is producer-starved time: the queue
+                    # is full because the consumer (the training step)
+                    # is the slow side — backpressure, not a stall
+                    t_put = time.perf_counter()
                     if not self._put(("item", staged)):
                         return
-                    _DEV_DEPTH.set(self._queue.qsize())
+                    _ioview.note_starved(
+                        "device", time.perf_counter() - t_put)
             except BaseException as e:  # mxlint: allow-broad-except(surfaced on the consumer via the error queue item)
                 self._put(("error", e))
                 return
@@ -449,17 +509,25 @@ class DevicePrefetchIter:
             raise StopIteration     # iterator protocol: stays exhausted
         t0 = time.perf_counter()
         kind, val = self._queue.get()
-        _DEV_STALL.inc(time.perf_counter() - t0)
-        _DEV_DEPTH.set(self._queue.qsize())
+        _ioview.note_stall("device", time.perf_counter() - t0)
         if kind == "end":
             self._exhausted = True
             raise StopIteration
         if kind == "error":
             self._exhausted = True
             raise val
+        # only staged items count toward occupancy (end/error control
+        # messages were never tracked in)
+        _ioview.queue_tracker("device").adjust(-1)
         return val
 
     next = __next__
+
+    def position(self):
+        """The wrapped iterator's position — advisory: the worker runs
+        up to ``depth`` staged batches ahead of the consumer."""
+        return self._it.position() if hasattr(self._it, "position") \
+            else None
 
     def reset(self):
         """Cancel the worker (at most ``depth`` staged batches are
@@ -478,6 +546,7 @@ class DevicePrefetchIter:
             except _queue.Empty:
                 pass
         self._thread.join()
+        _ioview.queue_tracker("device").set_depth(0)
         if pending_error is not None:
             self._exhausted = True
             raise pending_error
@@ -517,6 +586,7 @@ class NDArrayIter(DataIter):
         self.cursor = -batch_size
         self.batch_size = batch_size
         self.last_batch_handle = last_batch_handle
+        self._epochs = 0
 
     @property
     def provide_data(self):
@@ -534,12 +604,20 @@ class NDArrayIter(DataIter):
         self.cursor = -self.batch_size
 
     def reset(self):
+        self._epochs += 1
         if self.last_batch_handle == "roll_over" and \
                 self.cursor > self.num_data:
             self.cursor = -self.batch_size + \
                 (self.cursor % self.num_data) % self.batch_size
         else:
             self.cursor = -self.batch_size
+
+    def position(self):
+        """{"epoch", "offset"}: samples consumed this epoch (advisory —
+        see :meth:`DataIter.position`)."""
+        return {"epoch": self._epochs,
+                "offset": int(min(max(0, self.cursor + self.batch_size),
+                                  self.num_data))}
 
     def iter_next(self):
         self.cursor += self.batch_size
@@ -608,6 +686,8 @@ class MNISTIter(DataIter):
             img = img.reshape(img.shape[0], -1)
         else:
             img = img.reshape(img.shape[0], 1, img.shape[1], img.shape[2])
+        self._part_index = int(part_index)
+        self._num_parts = int(num_parts)
         self._inner = NDArrayIter(img, lab, batch_size=batch_size,
                                   last_batch_handle="discard")
 
@@ -627,6 +707,11 @@ class MNISTIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+    def position(self):
+        pos = self._inner.position()
+        pos.update(shard=self._part_index, num_shards=self._num_parts)
+        return pos
 
 
 class CSVIter(DataIter):
@@ -667,6 +752,9 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+    def position(self):
+        return self._inner.position()
 
 
 def ImageRecordIter(*args, **kwargs):

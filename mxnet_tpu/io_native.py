@@ -12,10 +12,12 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import time
 
 import numpy as np
 
 from . import telemetry
+from .telemetry import ioview as _ioview
 
 # per-record counters for the native reader (source label separates it
 # from the pure-python recordio path)
@@ -107,6 +109,7 @@ class NativeRecordIOReader:
             raise IOError("cannot open %s" % path)
         self._buf = (ctypes.c_uint8 * max_record)()
         self._max_record = max_record
+        self.records_read = 0
 
     def _note_bad_record(self, exc):
         if self._bad_quota <= 0:
@@ -126,6 +129,7 @@ class NativeRecordIOReader:
     def read(self):
         """Next record bytes, or None at EOF."""
         from . import resilience
+        t0 = time.perf_counter()
         while True:
             dropped = False
             try:
@@ -154,11 +158,21 @@ class NativeRecordIOReader:
             if dropped:
                 continue
             _NAT_READS.inc()
+            self.records_read += 1
+            _ioview.account("read", time.perf_counter() - t0, items=1,
+                            nbytes=int(n))
             return bytes(bytearray(self._buf[:n]))
+
+    def position(self):
+        """Advisory reader position (records read; the native thread
+        prefetches ahead of these consumer-side reads)."""
+        return {"offset": self.records_read,
+                "bad_records": self.bad_records}
 
     def read_float_batch(self, batch, record_floats):
         """Parse ``batch`` records of IRHeader+float32 payload into
         (labels, data) numpy arrays in one native call."""
+        t0 = time.perf_counter()
         labels = np.zeros(batch, np.float32)
         data = np.zeros((batch, record_floats), np.float32)
         n = self._lib.MXTPURecordIOReadFloatBatch(
@@ -168,6 +182,10 @@ class NativeRecordIOReader:
             record_floats, batch)
         if n > 0:
             _NAT_READS.inc(int(n))
+            self.records_read += int(n)
+            _ioview.account("read", time.perf_counter() - t0,
+                            items=int(n),
+                            nbytes=int(n) * (record_floats * 4 + 4))
         return int(n), labels, data
 
     def close(self):
@@ -235,6 +253,7 @@ class ImageRecordIter:
         self._part_index = int(part_index)
         self._round = bool(round_batch)
         self._epoch = 0
+        self._consumed = 0
         self._handle = None
         self._open()
         from .io import DataDesc
@@ -261,7 +280,15 @@ class ImageRecordIter:
 
     def reset(self):
         self._epoch += 1
+        self._consumed = 0
         self._open()
+
+    def position(self):
+        """{"epoch", "shard", "num_shards", "offset"} — records consumed
+        by the python side (the native decoder threads run ahead of
+        this; advisory, see ``telemetry.ioview``)."""
+        return {"epoch": self._epoch, "shard": self._part_index,
+                "num_shards": self._num_parts, "offset": self._consumed}
 
     def next(self):
         from .io import DataBatch
@@ -270,6 +297,7 @@ class ImageRecordIter:
         labels = np.zeros(self.batch_size, np.float32)
         raw = np.zeros((self.batch_size, h, w, 3), np.uint8)
         import ctypes as ct
+        t0 = time.perf_counter()
         n = self._lib.MXTPUImagePipelineNextBatch(
             self._handle, labels.ctypes.data_as(ct.POINTER(ct.c_float)),
             raw.ctypes.data_as(ct.POINTER(ct.c_uint8)), self.batch_size)
@@ -277,6 +305,11 @@ class ImageRecordIter:
             raise StopIteration
         n = int(n)
         _NAT_READS.inc(n)
+        self._consumed += n
+        # the native pipeline reads + JPEG-decodes behind one call:
+        # account it as the decode stage (read is not separable here)
+        _ioview.account("decode", time.perf_counter() - t0, items=n,
+                        nbytes=int(raw.nbytes))
         if n < self.batch_size and self._round:
             # pad the tail by wrapping real samples (reference round_batch
             # pads with wrapped data, never zero images); pad count lets
@@ -287,9 +320,13 @@ class ImageRecordIter:
         if self._raw:
             return DataBatch(data=[nd_array(raw)], label=[nd_array(labels)],
                              pad=self.batch_size - int(n))
+        t1 = time.perf_counter()
         data = raw.astype(np.float32)
         data = (data - self._mean) / self._std * self._scale
         data = np.ascontiguousarray(data.transpose(0, 3, 1, 2))  # NCHW
+        # host-side normalize + NCHW transpose is batch-assembly work
+        _ioview.account("batch", time.perf_counter() - t1, items=n,
+                        nbytes=int(data.nbytes))
         return DataBatch(data=[nd_array(data)], label=[nd_array(labels)],
                          pad=self.batch_size - int(n))
 

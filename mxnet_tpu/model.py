@@ -117,8 +117,14 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     resilience.atomic_write(param_name,
                             lambda tmp: nd.save(tmp, save_dict),
                             fault_site="checkpoint.save")
-    resilience.write_manifest(prefix, epoch, [param_name],
-                              arrays=save_dict)
+    # manifest meta carries the ADVISORY iterator position of the run's
+    # tracked data iterator (telemetry.ioview.track) — the observability
+    # half of mid-epoch resume; loaders that predate the key ignore it
+    from .telemetry import ioview
+    pos = ioview.current_position()
+    resilience.write_manifest(
+        prefix, epoch, [param_name], arrays=save_dict,
+        meta={"data_position": pos} if pos is not None else None)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

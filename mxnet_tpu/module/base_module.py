@@ -186,6 +186,10 @@ class BaseModule:
 
         from .. import telemetry
         fetch_span = telemetry.span("data.fetch", category="io")
+        # data-plane observability (telemetry.ioview): the training
+        # iterator's position() rides sampled step records and
+        # checkpoint manifests for the rest of the run
+        telemetry.ioview.track(train_data)
 
         for epoch in range(begin_epoch, num_epoch):
             started = time.time()

@@ -1691,15 +1691,23 @@ class ShardedTrainer:
 
     def _stage_timed(self, batch):
         """Stage a host batch, charging the wall time to the step's
-        ``input_wait`` segment (already-staged device batches cost 0)."""
+        ``input_wait`` segment (already-staged device batches cost 0)
+        and to the ioview ``device_stage`` pipeline stage (the H2D half
+        of the data plane; a DevicePrefetchIter staging on its worker
+        thread accounts there instead — the two paths are disjoint)."""
         import time as _time
         import jax
+        from ..telemetry import ioview as _iov
         first = next(iter(batch.values()))
         if isinstance(first, jax.Array):
             return batch
         t0 = _time.perf_counter()
         dev_batch = self.put_batch(batch)
-        self._seg["input_s"] += _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self._seg["input_s"] += dt
+        _iov.account("device_stage", dt, items=1,
+                     nbytes=sum(getattr(v, "nbytes", 0)
+                                for v in batch.values()))
         return dev_batch
 
     def _measure_collective_entry(self, site):
@@ -2102,9 +2110,17 @@ class ShardedTrainer:
             # atomically), so a crash anywhere above leaves no epoch a
             # verified loader would pick up.  meta["mesh"] (schema v2)
             # records the saving mesh so a later load on a different
-            # shape reshards instead of guessing (docs/api/reshard.md)
+            # shape reshards instead of guessing (docs/api/reshard.md);
+            # meta["data_position"] is the ADVISORY iterator position of
+            # the run's tracked data iterator (telemetry.ioview) — the
+            # recorded half of mid-epoch resume (restore comes later)
+            meta = {"mesh": self.mesh_descriptor()}
+            from ..telemetry import ioview as _iov
+            pos = _iov.current_position()
+            if pos is not None:
+                meta["data_position"] = pos
             resilience.write_manifest(prefix, epoch, files, arrays=arrays,
-                                      meta={"mesh": self.mesh_descriptor()})
+                                      meta=meta)
         if self._multiproc:
             multihost.process_barrier("sharded_trainer_ckpt_save")
 

@@ -13,11 +13,13 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 from collections import namedtuple
 
 import numpy as np
 
 from . import telemetry
+from .telemetry import ioview as _ioview
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
@@ -59,6 +61,8 @@ class MXRecordIO:
         self.bad_records = 0
         self.skipped_bytes = 0
         self.resyncs = 0
+        self.records_read = 0
+        self._epochs = 0
         self.open()
 
     def open(self):
@@ -101,6 +105,20 @@ class MXRecordIO:
     def reset(self):
         self.close()
         self.open()
+        self._epochs += 1
+        self.records_read = 0
+
+    def position(self):
+        """Advisory reader position for the data-plane observability
+        layer (``telemetry.ioview``): records read this epoch, the
+        byte offset, and the corruption-resync count."""
+        pos = {"epoch": self._epochs, "offset": self.records_read,
+               "resyncs": self.resyncs}
+        try:
+            pos["byte"] = self.fid.tell() if self.is_open else None
+        except (OSError, ValueError, AttributeError):
+            pass
+        return pos
 
     def tell(self):
         return self.fid.tell()
@@ -252,6 +270,7 @@ class MXRecordIO:
         returned, exactly like real corruption."""
         assert not self.writable
         from . import resilience
+        t0 = time.perf_counter()
         while True:
             # remember where this record starts: a corrupt length field
             # can drag the file position to EOF, so resync must restart
@@ -263,6 +282,12 @@ class MXRecordIO:
                 rec = self._read_record()
                 if rec is not None:
                     _REC_READS.inc()
+                    self.records_read += 1
+                    # ioview "read" stage: framing + file IO wall time
+                    # per record (resync scans after corruption included
+                    # — they ARE read-stage work)
+                    _ioview.account("read", time.perf_counter() - t0,
+                                    items=1, nbytes=len(rec))
                 return rec
             except resilience.FaultInjected as e:
                 self._note_bad_record(e)

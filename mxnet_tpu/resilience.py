@@ -66,6 +66,9 @@ __all__ = [
 KNOWN_SITES = (
     "recordio.read", "checkpoint.save", "checkpoint.load",
     "multihost.init", "multihost.barrier", "io.prefetch",
+    # per-image decode seam (image.imdecode): kind=delay seeds a slow
+    # decode stage for ioview bottleneck-attribution drills
+    "io.decode",
     "trainer.step",
     # elastic training (parallel/reshard.py, docs/api/reshard.md):
     # per-param gather/scatter of a mesh reshape, and the world-size
@@ -426,8 +429,10 @@ def write_manifest(prefix, epoch, files, arrays=None, meta=None):
     elastic savers record their mesh descriptor under ``meta["mesh"]``
     (schema v2, ``parallel/reshard.py``; the manifest ``format`` bumps
     to 2 when a mesh descriptor is present, and v1 manifests keep
-    loading — readers only consume the keys they know).  Returns the
-    manifest path."""
+    loading — readers only consume the keys they know), and checkpoint
+    paths record the tracked data iterator's position under
+    ``meta["data_position"]`` (advisory; ``telemetry.ioview``).
+    Returns the manifest path."""
     entry_files = {}
     for p in files:
         entry_files[os.path.basename(p)] = {

@@ -28,6 +28,13 @@ Three engines:
   live ``device.memory_stats()`` sampling at step boundaries, a
   pre-dispatch budget check (``MXNET_TPU_MEMORY_BUDGET``), and
   ``RESOURCE_EXHAUSTED`` annotation with plan + live-buffer forensics;
+* **input-pipeline view** (:mod:`.ioview`) — per-stage accounting of
+  the data plane (read/decode/augment/batch/host prefetch/device
+  staging: wall, items, bytes), time-weighted prefetch-queue occupancy,
+  a per-window bottleneck classifier (producer-bound naming the slow
+  stage / consumer-bound / balanced), and iterator ``position()``
+  tracking riding step records and checkpoint manifests;
+  ``tools/io_top.py`` renders the stream;
 * **flight recorder** (:mod:`.flight`) — a bounded ring of recent
   structured events dumped to a JSON black box
   (``MXNET_TPU_FLIGHT_DIR``) on MXNetError/OOM/SIGTERM/crash;
@@ -61,6 +68,7 @@ from .spans import span, drain_step_spans, step_span_totals
 from . import flight
 from . import memory
 from . import distview
+from . import ioview
 from . import costdb
 from . import numerics
 from .exporters import (step_end, jsonl_event, render_prom, report,
@@ -77,7 +85,7 @@ __all__ = [
     "step_end", "jsonl_event", "render_prom", "report",
     "start_http_server", "jsonl_path", "env_port", "reset",
     "reset_steps", "compile_events",
-    "flight", "memory", "distview", "costdb", "numerics",
+    "flight", "memory", "distview", "ioview", "costdb", "numerics",
 ]
 
 # best-effort process-wide init: compile listener (jax.monitoring) and

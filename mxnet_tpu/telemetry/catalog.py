@@ -56,10 +56,45 @@ CATALOG = {
                                      "past corrupt records"),
     "mxtpu_io_prefetch_depth": (GAUGE, ("iter",),
                                 "staged batches currently queued "
-                                "(iter=host|device)"),
+                                "(iter=host|device); last-observed "
+                                "value set by the ioview occupancy "
+                                "tracker under its own lock"),
     "mxtpu_io_prefetch_stall_seconds_total": (
         COUNTER, ("iter",),
         "time the consumer blocked waiting on the prefetcher"),
+    "mxtpu_io_prefetch_starved_seconds_total": (
+        COUNTER, ("iter",),
+        "time prefetch producer threads idled waiting for the "
+        "consumer to drain the queue (consumer-bound: the device, "
+        "not the pipeline, bounds throughput)"),
+    # ------------------------------- input-pipeline view (ioview)
+    "mxtpu_io_stage_seconds": (HISTOGRAM, ("stage",),
+                               "wall time per unit of work in each "
+                               "input-pipeline stage (stage=read|"
+                               "decode|augment|batch|host_prefetch|"
+                               "device_stage)"),
+    "mxtpu_io_stage_items_total": (COUNTER, ("stage",),
+                                   "items processed per input-pipeline "
+                                   "stage (records/images for the "
+                                   "leaf stages, batches for the "
+                                   "prefetch/staging stages)"),
+    "mxtpu_io_bytes_total": (COUNTER, ("stage",),
+                             "bytes flowing through each input-"
+                             "pipeline stage"),
+    "mxtpu_io_queue_occupancy": (HISTOGRAM, ("iter",),
+                                 "time-weighted prefetch-queue "
+                                 "occupancy: weighted observations "
+                                 "where bucket counts are SECONDS "
+                                 "spent at each staged-batch depth "
+                                 "(sum/count = time-weighted mean "
+                                 "depth)"),
+    "mxtpu_io_bottleneck_total": (COUNTER, ("stage",),
+                                  "per-window bottleneck verdicts from "
+                                  "the ioview classifier (stage=<the "
+                                  "slowest pipeline stage> when "
+                                  "producer-bound, consumer when the "
+                                  "training loop binds, balanced "
+                                  "otherwise)"),
     # -------------------------------------------------------- kvstore
     "mxtpu_kvstore_push_bytes_total": (COUNTER, ("store",),
                                        "gradient bytes pushed "

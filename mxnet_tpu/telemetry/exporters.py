@@ -28,6 +28,7 @@ from .registry import REGISTRY, counter, gauge, histogram
 from . import compile as compile_mod
 from . import distview as distview_mod
 from . import flight
+from . import ioview as ioview_mod
 from . import memory as memory_mod
 from .spans import drain_step_spans
 
@@ -136,6 +137,11 @@ def step_end(samples=None, step_time=None, extra=None, count=1):
                   if v != _last_counters.get(k, 0)}
         _last_counters.clear()
         _last_counters.update(counters)
+    # the input-pipeline view's per-step block (telemetry.ioview):
+    # per-stage deltas + stall/starved + occupancy + iterator position,
+    # on the MXNET_TPU_IOVIEW_EVERY cadence.  Runs even when the JSONL
+    # is off — the call also ticks the window bottleneck classifier
+    io_rec = ioview_mod.step_record()
     ev = {"step": step_no, "step_time_s": step_time, "samples": samples,
           "spans": spans, "counter_deltas": deltas}
     if count > 1:
@@ -161,6 +167,8 @@ def step_end(samples=None, step_time=None, extra=None, count=1):
         }
         if count > 1:
             rec["count"] = count
+        if io_rec is not None:
+            rec["io"] = io_rec
         if extra:
             rec.update(extra)
         fh.write(json.dumps(rec) + "\n")
@@ -442,6 +450,7 @@ def reset():
     REGISTRY.reset()
     drain_step_spans()
     flight.clear()
+    ioview_mod.reset()
     memory_mod.clear_plans()
     from . import costdb as costdb_mod
     costdb_mod.reset()

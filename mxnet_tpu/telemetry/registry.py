@@ -43,8 +43,8 @@ class _Child:
     def set(self, value):
         self._metric._set(self._key, value)
 
-    def observe(self, value):
-        self._metric._observe(self._key, value)
+    def observe(self, value, weight=1):
+        self._metric._observe(self._key, value, weight)
 
     def get(self):
         return self._metric._get(self._key)
@@ -91,8 +91,8 @@ class Metric:
     def set(self, value):
         self._require_default().set(value)
 
-    def observe(self, value):
-        self._require_default().observe(value)
+    def observe(self, value, weight=1):
+        self._require_default().observe(value, weight)
 
     def get(self):
         return self._require_default().get()
@@ -109,7 +109,7 @@ class Metric:
         raise MXNetError("metric %r (%s) does not support set"
                          % (self.name, self.kind))
 
-    def _observe(self, key, value):
+    def _observe(self, key, value, weight=1):
         raise MXNetError("metric %r (%s) does not support observe"
                          % (self.name, self.kind))
 
@@ -160,7 +160,10 @@ class Histogram(Metric):
 
     Buckets are upper bounds; an implicit +Inf bucket catches the tail.
     Bucket counts are stored non-cumulative and rendered cumulative by
-    the Prometheus exporter.
+    the Prometheus exporter.  ``observe(value, weight=w)`` credits the
+    bucket/sum/count by ``w`` instead of 1 — the time-weighted form the
+    queue-occupancy sampler uses (bucket counts become seconds-at-depth,
+    so sum/count is the time-weighted mean).
     """
 
     kind = HISTOGRAM
@@ -174,8 +177,12 @@ class Histogram(Metric):
                              "increasing, got %s" % (name, list(b)))
         self.buckets = b
 
-    def _observe(self, key, value):
+    def _observe(self, key, value, weight=1):
         value = float(value)
+        weight = float(weight)
+        if weight < 0:
+            raise MXNetError("histogram %r: negative observe weight %r"
+                             % (self.name, weight))
         with self._lock():
             s = self._samples.get(key)
             if s is None:
@@ -188,9 +195,9 @@ class Histogram(Metric):
                     break
             else:
                 i = len(self.buckets)
-            s["buckets"][i] += 1
-            s["sum"] += value
-            s["count"] += 1
+            s["buckets"][i] += weight
+            s["sum"] += value * weight
+            s["count"] += weight
 
     def _get(self, key):
         with self._lock():

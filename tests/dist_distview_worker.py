@@ -24,6 +24,16 @@ where a real barrier parks them while the straggler catches up — and
 every rank reports ``skew_s``/``slowest_rank`` in its step record, so
 the aggregated timeline must carry the injected skew and attribute the
 collective wait to the fast ranks, not the straggler.
+
+``DISTVIEW_IO=1`` switches the per-step payload from sleeps to a REAL
+mini input pipeline (telemetry.ioview): each rank builds a tiny JPEG
+``.rec`` shard and fetches batches through ``image.ImageIter``; rank
+``DISTVIEW_SLOW_RANK`` arms the ``io.decode`` fault seam with a
+``kind=delay`` spec, so its decode stage is genuinely slow and its
+batch fetch dominates the step as ``input_wait``.  The aggregated
+timeline must then carry per-rank io stage totals + positions, and
+``run_top --summarize`` must name the decode stage on the slow rank
+(``io_bottleneck``) — end-to-end bottleneck attribution across ranks.
 """
 import os
 import sys
@@ -34,7 +44,47 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from mxnet_tpu import telemetry  # noqa: E402
-from mxnet_tpu.telemetry import distview  # noqa: E402
+from mxnet_tpu.telemetry import distview, ioview  # noqa: E402
+
+
+def _make_rec(path, n=16, size=8):
+    """Tiny JPEG .rec shard for the DISTVIEW_IO pipeline."""
+    import io as _pyio
+
+    import numpy as np
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(42)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+        buf = _pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=85)
+        w.write(recordio.pack(
+            recordio.IRHeader(0, float(i % 4), i, 0), buf.getvalue()))
+    w.close()
+    return path
+
+
+def _io_pipeline(rank, world, slow_rank, slow_s):
+    from mxnet_tpu import image as image_mod
+    from mxnet_tpu import resilience
+
+    rec = _make_rec("%s.rec%d" % (telemetry.jsonl_path(), rank))
+    if rank == slow_rank and slow_s > 0:
+        # the seeded slow DECODE stage: every imdecode sleeps through
+        # the io.decode fault seam (kind=delay never raises)
+        resilience.configure_faults(
+            "io.decode:kind=delay,delay=%g" % slow_s)
+    # each rank reads its own shard; the iterator's position() must
+    # carry the shard identity into step records and the run timeline
+    it = image_mod.ImageIter(batch_size=4, data_shape=(3, 8, 8),
+                             path_imgrec=rec)
+    it.part_index, it.num_parts = rank, world
+    ioview.track(it)
+    return it
 
 
 def main():
@@ -45,6 +95,7 @@ def main():
     slow_s = float(os.environ.get("DISTVIEW_SLOW_S", "0.15"))
     base_s = float(os.environ.get("DISTVIEW_BASE_S", "0.02"))
     skew_s = float(os.environ.get("DISTVIEW_SKEW_S", "0"))
+    io_mode = os.environ.get("DISTVIEW_IO", "0") == "1"
 
     # the launcher must have redirected this rank's step-log to its own
     # stream — co-located ranks interleaving one file is the bug class
@@ -55,12 +106,26 @@ def main():
     if distview.capture_dir():
         assert distview.install_capture_handler()
 
+    data_iter = _io_pipeline(rank, world, slow_rank, slow_s) \
+        if io_mode else None
+
     for _ in range(steps):
         t0 = time.perf_counter()
-        time.sleep(base_s / 2)                   # "input wait"
-        input_s = time.perf_counter() - t0
-        time.sleep(base_s / 2 +
-                   (slow_s if rank == slow_rank else 0.0))  # "compute"
+        if io_mode:
+            # real pipeline fetch: the seeded slow decode makes this
+            # the step's dominant input_wait on the slow rank
+            try:
+                next(data_iter)
+            except StopIteration:
+                data_iter.reset()
+                next(data_iter)
+            input_s = time.perf_counter() - t0
+            time.sleep(base_s)                   # "compute"
+        else:
+            time.sleep(base_s / 2)               # "input wait"
+            input_s = time.perf_counter() - t0
+            time.sleep(base_s / 2 +
+                       (slow_s if rank == slow_rank else 0.0))  # compute
         collective_s = 0.0
         if skew_s and rank != slow_rank:
             # simulated barrier: the fast ranks pay the straggler's

@@ -14,11 +14,20 @@ import importlib.util
 import os
 
 
-def load_distview():
+def _load(modname, filename):
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        os.pardir, "mxnet_tpu", "telemetry",
-                        "distview.py")
-    spec = importlib.util.spec_from_file_location("mxtpu_distview", path)
+                        os.pardir, "mxnet_tpu", "telemetry", filename)
+    spec = importlib.util.spec_from_file_location(modname, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def load_distview():
+    return _load("mxtpu_distview", "distview.py")
+
+
+def load_ioview():
+    """Aggregation half of ``telemetry/ioview.py`` for ``io_top.py`` —
+    same stdlib-only-by-path contract as distview."""
+    return _load("mxtpu_ioview", "ioview.py")

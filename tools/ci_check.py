@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Thirteen stages, all of which must be clean:
+Fourteen stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-006; pragmas with reasons are the only
@@ -95,6 +95,19 @@ Thirteen stages, all of which must be clean:
     sequence-parallel transformer configs — must report ZERO
     findings.  (The stage-4 drift guard covers the new
     ``mxtpu_verify_findings_total`` metric automatically.)
+14. **io observability gate** — data-plane bottleneck attribution end
+    to end (``mxnet_tpu/telemetry/ioview.py``, docs/api/telemetry.md):
+    a dry-run pipeline with a seeded slow stage (an ``io.prefetch``
+    ``kind=delay`` fault — the existing seam family) must leave a
+    JSONL step-log whose ``io`` blocks ``tools/io_top.py --json``
+    parses (schema ``mxtpu-iotop/1``), naming the seeded stage
+    producer-bound, with the iterator position present; the live
+    classifier must have left an ``io_bottleneck`` flight event and
+    bumped ``mxtpu_io_bottleneck_total`` for the same stage.  (The
+    stage-4 drift guard covers the new ``mxtpu_io_stage_*`` /
+    ``mxtpu_io_queue_occupancy`` / ``mxtpu_io_bottleneck_total`` /
+    ``mxtpu_io_prefetch_starved_seconds_total`` metrics
+    automatically.)
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -130,7 +143,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/13] mxlint: %d finding(s) over %s"
+        say("ci_check[1/14] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -139,7 +152,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/13] registry selfcheck: %d problem(s)"
+        say("ci_check[2/14] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -153,14 +166,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/13] verify model %-22s %s" % (name, status))
+            say("ci_check[3/14] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/13] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/14] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -168,7 +181,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/13] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/14] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -176,7 +189,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/13] distview smoke: %d problem(s)"
+        say("ci_check[6/14] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -184,14 +197,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/13] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/14] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/13] perf ground truth: %d problem(s)"
+        say("ci_check[8/14] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -199,7 +212,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/13] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/14] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -207,7 +220,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/13] reshard gate: %d problem(s)"
+        say("ci_check[10/14] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -216,7 +229,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/13] numerics gate: %d problem(s)"
+        say("ci_check[11/14] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -225,7 +238,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/13] plan search: %d problem(s)"
+        say("ci_check[12/14] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
@@ -234,9 +247,18 @@ def run(repo_root=_ROOT, out=None):
         # stage 13: SPMD gate (seeded-defect discrimination per
         # MXG011-016 rule + clean sweep over zoo and composed configs)
         problems = spmd_check(repo_root)
-        say("ci_check[13/13] spmd gate: %d problem(s)" % len(problems))
+        say("ci_check[13/14] spmd gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("spmd: %s" % p)
+            say("  " + p)
+
+        # stage 14: io observability gate (seeded slow stage ->
+        # io_top --json names it; flight + counter verdicts agree)
+        problems = ioview_check(repo_root)
+        say("ci_check[14/14] io observability: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("ioview: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -493,7 +515,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/13] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/14] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -1376,6 +1398,99 @@ def spmd_check(repo_root=_ROOT):
     if len(report):
         problems.append("clean sweep: sequence config has findings: %s"
                         % report)
+    return problems
+
+
+def ioview_check(repo_root=_ROOT):
+    """IO observability gate (docs/api/telemetry.md): a dry-run
+    pipeline with a seeded slow stage — an ``io.prefetch``
+    ``kind=delay`` fault, so the PrefetchingIter producer's work window
+    is genuinely slow — must leave a JSONL step-log whose ``io`` blocks
+    ``tools/io_top.py --json`` parses (schema ``mxtpu-iotop/1``) naming
+    the seeded ``host_prefetch`` stage producer-bound with the iterator
+    position attached, and the live classifier must agree (flight
+    ``io_bottleneck`` event + ``mxtpu_io_bottleneck_total`` counter).
+    Returns a list of problem strings (empty = clean)."""
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import io as io_mod, resilience, telemetry
+    from mxnet_tpu.telemetry import flight, ioview
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_ioview_gate_")
+    log_path = os.path.join(tmpdir, "io.jsonl")
+    saved = {k: os.environ.get(k)
+             for k in ("MXNET_TPU_TELEMETRY_JSONL", "MXNET_TPU_FAULTS",
+                       "MXNET_TPU_IOVIEW_EVERY")}
+    try:
+        ioview.reset()
+        os.environ["MXNET_TPU_TELEMETRY_JSONL"] = log_path
+        os.environ["MXNET_TPU_IOVIEW_EVERY"] = "1"
+        # the seeded slow stage, through the existing io.prefetch seam
+        # family: every producer batch sleeps 30ms inside the seam
+        os.environ["MXNET_TPU_FAULTS"] = \
+            "io.prefetch:kind=delay,delay=0.03"
+        x = np.zeros((32, 4), np.float32)
+        y = np.zeros(32, np.float32)
+        it = io_mod.PrefetchingIter(
+            io_mod.NDArrayIter(x, y, batch_size=8))
+        ioview.track(it)
+        for _batch in it:
+            telemetry.step_end(samples=8, step_time=0.001)
+        verdict = ioview.classify(force=True)
+        if not verdict or verdict.get("verdict") != "producer-bound" \
+                or verdict.get("stage") != "host_prefetch":
+            problems.append("live classifier did not name the seeded "
+                            "slow stage (got %r)" % (verdict,))
+        if not any(e.get("kind") == "io_bottleneck"
+                   for e in flight.events()):
+            problems.append("no io_bottleneck flight event recorded")
+        ctr = telemetry.counter("mxtpu_io_bottleneck_total").labels(
+            stage="host_prefetch").get()
+        if not ctr:
+            problems.append("mxtpu_io_bottleneck_total{stage="
+                            "host_prefetch} did not advance")
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "io_top.py"),
+             log_path, "--json"],
+            capture_output=True, text=True, timeout=60, cwd=repo_root)
+        if res.returncode != 0:
+            problems.append("io_top --json failed (%d): %s"
+                            % (res.returncode, res.stderr[-400:]))
+            return problems
+        try:
+            report = json.loads(res.stdout)
+        except ValueError as e:
+            problems.append("io_top --json is not parseable: %s" % e)
+            return problems
+        if report.get("schema") != "mxtpu-iotop/1":
+            problems.append("io_top schema %r != 'mxtpu-iotop/1'"
+                            % report.get("schema"))
+        b = report.get("bottleneck") or {}
+        if b.get("verdict") != "producer-bound" or \
+                b.get("stage") != "host_prefetch":
+            problems.append("io_top did not name the seeded slow stage "
+                            "(got %r)" % (b,))
+        rank0 = (report.get("ranks") or {}).get("0") or {}
+        pos = rank0.get("position")
+        if not isinstance(pos, dict) or "offset" not in pos:
+            problems.append("io_top report lacks the iterator position "
+                            "(got %r)" % (pos,))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resilience.clear_faults()
+        ioview.reset()
+        shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 
 

@@ -100,15 +100,27 @@ SEE_ALSO = {
                  "`_forward_monitored` route is the NaN/Inf provenance "
                  "replay"],
     "io": ["[resilience](resilience.md) — bad-record quotas, the "
-           "io.prefetch/recordio.read fault seams, retry/backoff",
+           "io.prefetch/io.decode/recordio.read fault seams, "
+           "retry/backoff",
            "[telemetry](telemetry.md) — prefetch depth/stall gauges, "
-           "records-read counters, the JSONL step-log"],
+           "records-read counters, the JSONL step-log",
+           "[telemetry](telemetry.md) input-pipeline observability "
+           "(`telemetry.ioview`): per-stage wall/items/bytes "
+           "accounting through the prefetchers, time-weighted queue "
+           "occupancy, producer-starved vs consumer-stalled "
+           "attribution, and the `position()` API every iterator (and "
+           "wrapper) here implements — rendered by `tools/io_top.py`"],
     "model": ["[resilience](resilience.md) — atomic checkpoint writes, "
               "the manifest format, latest-checkpoint fallback",
               "[reshard](reshard.md) — manifest schema v2 mesh "
               "descriptors, `find_latest_checkpoint` as the elastic "
               "resume point, and the offline `tools/reshard.py` "
-              "converter"],
+              "converter",
+              "[telemetry](telemetry.md) input-pipeline observability "
+              "(`telemetry.ioview`): `save_checkpoint` records the "
+              "tracked data iterator's `position()` in the manifest "
+              "meta as advisory `data_position` — the recorded half "
+              "of mid-epoch resume"],
     "module": ["[resilience](resilience.md) — fault injection, "
                "preemption-safe training, chaos testing",
                "[analysis](analysis.md) — `Module.bind(..., "
@@ -118,7 +130,10 @@ SEE_ALSO = {
     "recordio": ["[resilience](resilience.md) — bad-record quota and "
                  "magic-resync semantics",
                  "[telemetry](telemetry.md) — records/bad-record/"
-                 "resync counters this reader emits"],
+                 "resync counters this reader emits, the ioview "
+                 "`read` stage accounting per record, and the "
+                 "reader's `position()` (epoch/offset/resyncs) riding "
+                 "step records and checkpoint manifests"],
     "parallel": ["[resilience](resilience.md) — multihost init/barrier "
                  "timeouts, watchdog restarts, preemption handler",
                  "[analysis](analysis.md) — MXG007 sharding-coverage "

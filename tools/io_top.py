@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""io_top — render the input pipeline as a staged dataflow.
+
+The reader half of the data-plane observability layer
+(``mxnet_tpu/telemetry/ioview.py``): training runs recorded with
+``MXNET_TPU_TELEMETRY_JSONL`` carry an ``io`` block on every sampled
+step (per-stage wall/items/bytes deltas, prefetch stall/starved time,
+time-weighted queue occupancy, iterator position); this tool rolls the
+stream up and answers *which stage of the pipeline is the bottleneck* —
+
+* **per-stage throughput** — seconds, items, items/s, MB/s per stage
+  (read / decode / augment / batch / host_prefetch / device_stage);
+* **occupancy waterlines** — seconds spent at each prefetch-queue
+  depth (a queue pinned at 0 starves the consumer; pinned at max, the
+  consumer is the slow side);
+* **per-shard skew** — per-rank ingest rates and the slowest shard,
+  when the input is a multi-rank run timeline;
+* **the named bottleneck** — producer-bound (naming the slowest
+  stage) / consumer-bound / balanced, recomputed from the accumulated
+  stream (not just the live classifier's last verdict).
+
+Input is either a per-rank telemetry step-log (``<base>`` /
+``<base>.rankN``) or the launch.py supervisor's merged ``mxtpu-run/1``
+timeline (``<base>.run``) — the mode is sniffed from the first record.
+``--json`` emits the roll-up as schema ``mxtpu-iotop/1`` for scripts
+(``tools/ci_check.py`` stage 14 parses it); ``--follow`` repaints live.
+
+Stdlib-only (ioview's aggregation half is loaded by file path), so it
+runs on a supervisor host with no jax installed.
+
+Usage::
+
+    python tools/io_top.py RUN.jsonl                # postmortem, one rank
+    python tools/io_top.py RUN.jsonl.run            # cross-rank timeline
+    python tools/io_top.py RUN.jsonl --follow       # live
+    python tools/io_top.py RUN.jsonl --json | jq .bottleneck
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+from _distview import load_distview, load_ioview  # noqa: E402
+
+
+def _parse_jsonl(text):
+    records = []
+    for line in text.split("\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue            # mid-append tail / garbage line
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+def _bar(levels, width=24):
+    """One occupancy waterline: '#' columns proportional to the seconds
+    spent at each depth, lowest depth first."""
+    total = sum(levels.values()) or 1.0
+    cells = []
+    for depth in sorted(levels, key=lambda d: float(d)):
+        n = max(1, int(round(width * levels[depth] / total)))
+        cells.append("%s:%s" % (depth, "#" * n))
+    return "  ".join(cells)
+
+
+def format_report(summary):
+    """The io_top report as one string."""
+    lines = []
+    lines.append("io_top: %s  ranks=%d" % (summary.get("source", "?"),
+                                           summary.get("num_ranks", 0)))
+    b = summary.get("bottleneck")
+    if b:
+        where = "" if b.get("rank") is None else " on rank %s" % b["rank"]
+        lines.append("bottleneck: %s — stage '%s'%s"
+                     % (b.get("verdict"), b.get("stage"), where))
+    else:
+        lines.append("bottleneck: (no pipeline activity recorded)")
+    lines.append("")
+    lines.append("  %-14s %10s %10s %9s %9s" %
+                 ("stage", "seconds", "items", "items/s", "MB/s"))
+    for st, v in (summary.get("stages") or {}).items():
+        s = v.get("s") or 0.0
+        lines.append("  %-14s %10.3f %10d %9s %9s" % (
+            st, s, v.get("items") or 0,
+            "%.1f" % ((v.get("items") or 0) / s) if s else "-",
+            "%.2f" % ((v.get("bytes") or 0) / s / 1e6) if s else "-"))
+    for r in sorted(summary.get("ranks") or {}, key=int):
+        rd = summary["ranks"][r]
+        lines.append("")
+        v = rd.get("bottleneck") or {}
+        lines.append("rank %s: %s%s  ingest=%s items/s" % (
+            r, v.get("verdict", "-"),
+            " (stage '%s')" % v["stage"]
+            if v.get("verdict") == "producer-bound" else "",
+            rd.get("ingest_items_per_s") or "-"))
+        stall = rd.get("stall_s") or {}
+        starved = rd.get("starved_s") or {}
+        if stall or starved:
+            lines.append("  stall %s   starved %s" % (
+                " ".join("%s=%.3fs" % kv for kv in sorted(stall.items()))
+                or "-",
+                " ".join("%s=%.3fs" % kv
+                         for kv in sorted(starved.items())) or "-"))
+        for qn, q in sorted((rd.get("queues") or {}).items()):
+            lines.append("  queue %-7s depth=%s mean=%.2f  [%s]"
+                         % (qn, q.get("depth"), q.get("mean") or 0.0,
+                            _bar(q.get("levels") or {})))
+        pos = rd.get("position")
+        if pos:
+            lines.append("  position: %s" % " ".join(
+                "%s=%s" % (k, pos[k]) for k in sorted(pos)))
+    skew = summary.get("shard_skew")
+    if skew:
+        lines.append("")
+        lines.append("shard skew: slowest rank %s (%.1f..%.1f items/s%s)"
+                     % (skew.get("slowest_rank"),
+                        skew.get("min_items_per_s") or 0.0,
+                        skew.get("max_items_per_s") or 0.0,
+                        ", %.2fx spread" % skew["ratio"]
+                        if skew.get("ratio") else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="io_top")
+    ap.add_argument("log",
+                    help="telemetry JSONL step-log (<base> or "
+                         "<base>.rankN) or an mxtpu-run/1 timeline "
+                         "(<base>.run)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the mxtpu-iotop/1 roll-up as JSON")
+    ap.add_argument("--follow", action="store_true",
+                    help="live repaint until interrupted")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="repaint period for --follow (seconds)")
+    args = ap.parse_args(argv)
+    iov = load_ioview()
+
+    def render():
+        try:
+            with open(args.log) as f:
+                text = f.read()
+        except OSError as e:
+            raise ValueError("cannot read %r: %s" % (args.log, e))
+        records = _parse_jsonl(text)
+        head = records[0] if records else {}
+        if head.get("kind") == "run_begin":
+            # validate the timeline through distview's strict reader so
+            # a malformed file fails with the same diagnostics run_top
+            # gives (tolerating only the live mid-append tail)
+            dv = load_distview()
+            records = dv.read_run_timeline(args.log)
+        summary = iov.summarize_io(records,
+                                   source=os.path.basename(args.log))
+        if args.json:
+            print(json.dumps(summary, indent=1, sort_keys=True))
+        else:
+            print(format_report(summary))
+
+    try:
+        if not args.follow:
+            render()
+            return 0
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")     # clear + home
+            try:
+                render()
+            except ValueError as e:
+                print("io_top: waiting (%s)" % e)
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except ValueError as e:
+        print("io_top: %s" % e, file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

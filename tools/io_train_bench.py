@@ -6,8 +6,10 @@ Measures what docs/perf.md's input-pipeline section claims, with data:
    at 1/2/4 preprocess threads;
 2. IO-in-the-loop training — ResNet-50 fused steps fed from the native
    reader (raw uint8 bytes over the host link, (x-mean)/std on device),
-   reporting end-to-end img/s plus where the wall time went (iterator
-   wait vs staging vs step dispatch).
+   reporting end-to-end img/s plus where the wall time went — the
+   per-stage breakdown and bottleneck verdict come from the ioview
+   accounting (``mxnet_tpu.telemetry.ioview``), the same numbers every
+   production run exports, instead of ad-hoc loop timers.
 
 Usage: python tools/io_train_bench.py [--rec /tmp/synth_imagenet.rec]
        [--batch 128] [--image 224] [--layers 50] [--train-batches 30]
@@ -69,11 +71,46 @@ def decoder_scaling(rec, image, batch):
     return results
 
 
+def _io_delta(before, after):
+    """Per-stage (seconds, items) deltas between two ioview snapshots."""
+    out = {}
+    for st, v in after["stages"].items():
+        prev = before["stages"].get(st, {"s": 0.0, "items": 0})
+        ds = v["s"] - prev["s"]
+        di = v["items"] - prev["items"]
+        if ds > 0 or di > 0:
+            out[st] = (ds, di)
+    return out
+
+
+def _print_io_breakdown(before, after, train_batches):
+    """The ioview stage table for the timed loop window."""
+    from mxnet_tpu.telemetry import ioview
+    print("   io stage breakdown (telemetry.ioview, per timed batch):")
+    for st, (ds, di) in sorted(_io_delta(before, after).items()):
+        print("     %-13s %7.1f ms/batch  (%d items)"
+              % (st, 1e3 * ds / max(1, train_batches), di))
+    for kind, label in (("stall_s", "consumer stalled"),
+                        ("starved_s", "producer starved")):
+        d = {k: after[kind].get(k, 0.0) - before[kind].get(k, 0.0)
+             for k in after[kind]}
+        d = {k: v for k, v in d.items() if v > 1e-4}
+        if d:
+            print("     %-16s %s" % (label, "  ".join(
+                "%s=%.1fms/batch" % (k, 1e3 * v / max(1, train_batches))
+                for k, v in sorted(d.items()))))
+    verdict = ioview.classify(force=True)
+    if verdict:
+        print("     bottleneck: %s (stage %r)"
+              % (verdict["verdict"], verdict["stage"]))
+
+
 def train_loop(rec, image, batch, layers, train_batches,
                prefetch_depth=0):
     import mxnet_tpu as mx
     from mxnet_tpu import models
     from mxnet_tpu.parallel import ShardedTrainer, build_mesh
+    from mxnet_tpu.telemetry import ioview
 
     net = models.get_model("resnet%d" % layers, num_classes=1000,
                            image_shape="3,%d,%d" % (image, image))
@@ -104,7 +141,8 @@ def train_loop(rec, image, batch, layers, train_batches,
         # overlapping the step (reference iter_prefetcher.h role)
         pre = mx.io.DevicePrefetchIter(it, trainer.put_batch,
                                        depth=prefetch_depth)
-        n, loss, warm, t_wall = 0, None, 2, None
+        ioview.track(pre)
+        n, loss, warm, t_wall, io0 = 0, None, 2, None, None
         while n < train_batches + warm:
             try:
                 dev = next(pre)
@@ -116,6 +154,7 @@ def train_loop(rec, image, batch, layers, train_batches,
             if n == warm:
                 float(loss)
                 t_wall = time.perf_counter()
+                io0 = ioview.snapshot()
         lval = float(loss)
         wall = time.perf_counter() - t_wall
         imgs = train_batches * batch
@@ -123,23 +162,32 @@ def train_loop(rec, image, batch, layers, train_batches,
               % prefetch_depth, flush=True)
         print("   resnet%d batch %d image %d: %7.1f img/s end-to-end "
               "(loss %.3f)" % (layers, batch, image, imgs / wall, lval))
+        _print_io_breakdown(io0, ioview.snapshot(), train_batches)
         return imgs / wall
 
-    t_iter = t_stage = t_step = 0.0
+    # ioview accounts the pipeline stages (native decode, batch
+    # assembly, H2D staging through trainer.put_batch via step); the
+    # only remaining hand timer is the step dispatch itself, which is
+    # not a pipeline stage
+    ioview.track(it)
+    t_step = 0.0
     n = 0
     loss = None
     warm = 2
     t_wall = None
+    io0 = None
     while n < train_batches + warm:
-        t0 = time.perf_counter()
         try:
             b = next(it)
         except StopIteration:
             it.reset()
             b = next(it)
+        host = {"data": b.data[0].asnumpy(),
+                "softmax_label": b.label[0].asnumpy()}
         t1 = time.perf_counter()
-        dev = trainer.put_batch({"data": b.data[0].asnumpy(),
-                                 "softmax_label": b.label[0].asnumpy()})
+        dev = trainer.put_batch(host)
+        ioview.account("device_stage", time.perf_counter() - t1, items=1,
+                       nbytes=sum(v.nbytes for v in host.values()))
         t2 = time.perf_counter()
         loss = trainer.step(dev)
         t3 = time.perf_counter()
@@ -147,10 +195,9 @@ def train_loop(rec, image, batch, layers, train_batches,
         if n == warm:
             float(loss)          # close the async chain before timing
             t_wall = time.perf_counter()
-            t_iter = t_stage = t_step = 0.0
+            io0 = ioview.snapshot()
+            t_step = 0.0
             continue
-        t_iter += t1 - t0
-        t_stage += t2 - t1
         t_step += t3 - t2
     lval = float(loss)           # drain the pipeline
     wall = time.perf_counter() - t_wall
@@ -158,11 +205,9 @@ def train_loop(rec, image, batch, layers, train_batches,
     print("-- IO-in-the-loop training (raw_uint8 -> device normalize)")
     print("   resnet%d batch %d image %d: %7.1f img/s end-to-end "
           "(loss %.3f)" % (layers, batch, image, imgs / wall, lval))
-    print("   host wall split per batch: iterator %.1f ms, staging "
-          "%.1f ms, step dispatch %.1f ms (device compute overlaps "
-          "asynchronously)" % (1e3 * t_iter / train_batches,
-                               1e3 * t_stage / train_batches,
-                               1e3 * t_step / train_batches))
+    print("   step dispatch %.1f ms/batch (device compute overlaps "
+          "asynchronously)" % (1e3 * t_step / train_batches))
+    _print_io_breakdown(io0, ioview.snapshot(), train_batches)
     return imgs / wall
 
 

@@ -22,8 +22,12 @@ Run observability (local launcher, ``MXNET_TPU_TELEMETRY_JSONL`` set):
 * the supervisor tails every rank's stream and merges them into ONE
   run-level timeline ``<base>.run`` (schema ``mxtpu-run/1``: per-step
   p50/max across ranks, worst-rank id, skew history, restart/fault
-  events) — render it with ``tools/run_top.py`` (live ``--follow`` or
-  postmortem ``--summarize``);
+  events, and each rank's input-pipeline ``io`` block) — render it
+  with ``tools/run_top.py`` (live ``--follow`` or postmortem
+  ``--summarize``, which names the slow input-pipeline STAGE on the
+  slow RANK when ``input_wait`` dominates) or ``tools/io_top.py``
+  (the per-stage data-plane view: throughput, queue-occupancy
+  waterlines, shard skew, the named bottleneck);
 * SIGUSR1 sent to the supervisor is relayed to every worker, whose
   telemetry handler captures a bounded profiler window + flight
   snapshot WITHOUT restarting (``MXNET_TPU_CAPTURE_DIR``);

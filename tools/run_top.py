@@ -80,6 +80,14 @@ def format_dashboard(records, summary, steps_shown=12):
                    str(summary["straggler"]), 0),
                summary.get("steps", 0),
                1e3 * summary.get("skew_max_s", 0.0)))
+    iob = summary.get("io_bottleneck")
+    if iob:
+        lines.append(
+            "input bottleneck: stage '%s' on rank %s (%.3fs in stage, "
+            "%.3fs input_wait) — tools/io_top.py for the full pipeline "
+            "view" % (iob.get("stage"), iob.get("rank"),
+                      iob.get("stage_s") or 0.0,
+                      iob.get("input_wait_s") or 0.0))
     if summary.get("grad_skew_max") is not None or \
             summary.get("digest_mismatch_steps"):
         lines.append(
@@ -156,6 +164,13 @@ def format_summary(summary):
         lines.append("  straggler:      none identified")
     lines.append("  peak skew:      %.3f ms"
                  % (1e3 * summary.get("skew_max_s", 0.0)))
+    iob = summary.get("io_bottleneck")
+    if iob:
+        lines.append("  input bottleneck: stage '%s' on rank %s "
+                     "(%.3fs in stage, %.3fs input_wait)"
+                     % (iob.get("stage"), iob.get("rank"),
+                        iob.get("stage_s") or 0.0,
+                        iob.get("input_wait_s") or 0.0))
     if summary.get("grad_skew_max") is not None or \
             summary.get("digest_mismatch_steps"):
         lines.append("  grad-norm skew: %s peak across ranks%s"
@@ -180,6 +195,14 @@ def format_summary(summary):
                      % (r, 1e3 * pr.get("p50_s", 0.0),
                         1e3 * pr.get("max_s", 0.0),
                         pr.get("total_s", 0.0), seg_txt))
+        io_st = pr.get("io_stages_s")
+        if io_st:
+            lines.append("           io: %s" % "  ".join(
+                "%s=%.3fs" % (k, io_st[k]) for k in sorted(io_st)))
+        if pr.get("data_position"):
+            pos = pr["data_position"]
+            lines.append("           position: %s" % " ".join(
+                "%s=%s" % (k, pos[k]) for k in sorted(pos)))
     ev = summary.get("events") or []
     lines.append("  events:         %d" % len(ev))
     for e in ev:
