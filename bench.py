@@ -34,6 +34,12 @@ to tuning — ``MXNET_TPU_TUNE_CACHE`` arms the cache) and a
 ``valid`` flag — ``false`` on the tunnel-down watchdog artifact, so
 ``tools/bench_diff.py`` and the trajectory plots skip dead runs
 instead of reading their 0 as a 100% regression.
+
+``BENCH_OVERLAP_AB=1`` additionally embeds an ``overlap`` block in the
+dry-run artifact: the 2-process bucketed-overlap on/off A/B
+(``tools/overlap_ab.py`` — fast rank's collective wait + segment share
+with overlap on vs off at bit-identical final params, ROADMAP item 4;
+docs/api/overlap.md).
 """
 from __future__ import annotations
 
@@ -160,7 +166,7 @@ def main():
             "value": round(steps * batch / dt / n_dev, 2),
             "unit": "samples/s/chip",
             "vs_baseline": 0,
-        }, fusion=fusion_info)
+        }, fusion=fusion_info, overlap=_overlap_ab())
         return
 
     # batch 128/chip: the reference benchmarks batch 32 on 12GB GPUs; the
@@ -248,6 +254,31 @@ def main():
                "summary": trainer.fusion_summary()})
 
 
+def _overlap_ab():
+    """The dry-run overlap leg (``BENCH_OVERLAP_AB=1``; off by default
+    — it launches two 2-process jobs, which the ci_check dry-run legs
+    should not pay twice): ``tools/overlap_ab.py``'s bucketed-overlap
+    on/off A/B with a seeded slow rank — the BENCH JSON evidence for
+    ROADMAP item 4 (fast rank's collective wait + segment share
+    strictly smaller with overlap on, at bit-identical params; see
+    docs/api/overlap.md).  Never raises — a failure reports as an
+    error field."""
+    if os.environ.get("BENCH_OVERLAP_AB", "0") != "1":
+        return None
+    import subprocess
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "overlap_ab.py"), "--json"],
+            capture_output=True, text=True, timeout=1300)
+        doc = json.loads(res.stdout.strip().splitlines()[-1])
+        doc["exit_code"] = res.returncode
+        return doc
+    except Exception as e:  # mxlint: allow-broad-except(the overlap leg is bench evidence, not the benchmark; a failure must not kill the artifact)
+        return {"error": str(e)[:200]}
+
+
 def _plansearch_ab(models, batch):
     """The dry-run plan-search leg: tiny-budget whole-graph plan search
     on the dry-run MLP with the searched-vs-greedy predicted AND
@@ -285,7 +316,7 @@ def _step_program_eqns(trainer, batch_dict):
         return None
 
 
-def _emit(result, fusion=None):
+def _emit(result, fusion=None, overlap=None):
     """Attach the standardized telemetry report (step-time percentiles,
     throughput, compile count, and the HBM block: static memory plans
     per compiled program + peak live memory_stats — the BENCH
@@ -301,6 +332,10 @@ def _emit(result, fusion=None):
     result["valid"] = True
     if fusion is not None:
         result["fusion"] = fusion
+    if overlap is not None:
+        # the bucketed-overlap on/off A/B (BENCH_OVERLAP_AB=1,
+        # tools/overlap_ab.py) — ROADMAP item 4's trajectory evidence
+        result["overlap"] = overlap
     cost = costdb.summary()
     cost["flushed_to"] = costdb.flush()
     result["costdb"] = cost

@@ -19,10 +19,12 @@ rule      severity  meaning
 ========  ========  ====================================================
 MXG011    error     collective matching: the abstractly-interpreted
                     composed step (plain dp, pipeline, sequence/ring,
-                    MoE, DistKVStore push) must issue the SAME ordered
-                    collective sequence — matching (op, axis, shape,
-                    dtype) — on every rank; a divergence is the static
-                    shadow of a multiprocess hang
+                    MoE, DistKVStore push — monolithic or the bucketed
+                    overlap schedule of parallel/overlap.py) must issue
+                    the SAME ordered collective sequence — matching
+                    (op, axis, shape, dtype) — on every rank; a
+                    divergence (including a rank-reordered bucket
+                    launch) is the static shadow of a multiprocess hang
 MXG012    error     rank-divergent control flow: a collective under
                     control flow conditioned on the rank
                     (``lax.cond`` on ``axis_index`` in a jaxpr; the
@@ -79,10 +81,11 @@ COLLECTIVE_PRIMITIVES = frozenset({
 
 class CollectiveEvent:
     """One abstract collective: what a rank issues, in program order."""
-    __slots__ = ("op", "axis", "shape", "dtype", "node", "phase", "perm")
+    __slots__ = ("op", "axis", "shape", "dtype", "node", "phase", "perm",
+                 "payload")
 
     def __init__(self, op, axis, shape=(), dtype="float32", node=None,
-                 phase="fwd", perm=None):
+                 phase="fwd", perm=None, payload=None):
         self.op = op            # psum | ppermute | allreduce | barrier...
         self.axis = axis        # mesh axis name the collective runs over
         self.shape = tuple(int(d) for d in shape)
@@ -90,11 +93,16 @@ class CollectiveEvent:
         self.node = node        # graph node / site name for diagnostics
         self.phase = phase      # fwd | bwd
         self.perm = tuple(tuple(p) for p in perm) if perm else None
+        # operand IDENTITY beyond shape/dtype — a bucketed kv allreduce
+        # carries a keyed pytree, so two equal-sized buckets are NOT
+        # interchangeable: rank A reducing bucket 0 against rank B's
+        # bucket 1 corrupts both silently (shapes match, no deadlock)
+        self.payload = payload
 
     def key(self):
         """The cross-rank matching key: two ranks deadlock-free only
         when their event streams agree on this tuple, element-wise."""
-        return (self.op, self.axis, self.shape, self.dtype)
+        return (self.op, self.axis, self.shape, self.dtype, self.payload)
 
     def __repr__(self):
         return "<%s %s/%s %s %s%s>" % (
@@ -106,6 +114,7 @@ def build_config(pipeline_stages=1, pipeline_microbatches=None,
                  sequence_parallel=False, seq_axis="model",
                  batch_axis="data", tp_size=1, tp_rules=None,
                  reshard_rules=None, kv_push=False, kv_push_ranks=None,
+                 kv_buckets=None, kv_bucket_order=None,
                  moe_experts=0, moe_axis="expert", data_shapes=None,
                  label_shapes=None, dtype="float32", donate=None,
                  post_step_reads=None, numerics_provenance=False):
@@ -116,7 +125,19 @@ def build_config(pipeline_stages=1, pipeline_microbatches=None,
     safe default so CLI/fixture callers specify only what they compose.
     ``kv_push_ranks``: None = every rank pushes (the DistKVStore
     contract); a subset is the classic desync defect MXG011 exists for.
+    ``kv_buckets``: the BUCKETED push schedule (parallel/overlap.py,
+    docs/api/overlap.md) — a list of per-bucket element counts; with it
+    the kv push models as one sampled barrier followed by one allreduce
+    per bucket instead of the legacy barrier-then-monolithic-allreduce.
+    ``kv_bucket_order``: None = every rank launches the plan order (the
+    overlap layer's cross-rank determinism invariant); a
+    ``{rank_id: [bucket indices]}`` dict seeds per-rank launch orders —
+    a rank-divergent order is exactly the reordering defect MXG011 must
+    name (mismatched collectives deadlock or corrupt the reduce).
     """
+    if kv_bucket_order is not None:
+        kv_bucket_order = {int(r): [int(i) for i in order]
+                           for r, order in dict(kv_bucket_order).items()}
     return {
         "pipeline_stages": int(pipeline_stages),
         "pipeline_microbatches": (int(pipeline_microbatches)
@@ -132,6 +153,9 @@ def build_config(pipeline_stages=1, pipeline_microbatches=None,
         "kv_push": bool(kv_push),
         "kv_push_ranks": (None if kv_push_ranks is None
                           else sorted(int(r) for r in kv_push_ranks)),
+        "kv_buckets": (None if kv_buckets is None
+                       else [int(n) for n in kv_buckets]),
+        "kv_bucket_order": kv_bucket_order,
         "moe_experts": int(moe_experts),
         "moe_axis": moe_axis,
         "data_shapes": dict(data_shapes or {}),
@@ -292,17 +316,39 @@ def collective_schedule(sym, mesh_axes, config, shapes=None):
                                        (), "float32", node="grads",
                                        phase="bwd"))
 
-        # DistKVStore push: barrier + allreduce, every rank or a
-        # configured subset (the subset IS the defect)
+        # DistKVStore push: every rank or a configured subset (the
+        # subset IS the defect).  Legacy path: barrier + one monolithic
+        # allreduce.  Bucketed path (kv_buckets, parallel/overlap.py):
+        # one sampled barrier at the first bucket boundary, then one
+        # allreduce per bucket in this rank's launch order — the
+        # overlap invariant says that order is the shared plan order on
+        # every rank; a seeded kv_bucket_order divergence models the
+        # reordering defect, and the differing payload shapes make
+        # check_schedules name the first mismatched bucket
         if cfg.get("kv_push"):
             push_ranks = cfg.get("kv_push_ranks")
             if push_ranks is None or rid in push_ranks:
-                bwd.append(CollectiveEvent("barrier", "world", (),
-                                           "float32", node="kv.push",
-                                           phase="bwd"))
-                bwd.append(CollectiveEvent("allreduce", "world", (),
-                                           "float32", node="kv.push",
-                                           phase="bwd"))
+                buckets = cfg.get("kv_buckets")
+                if buckets:
+                    order = list(range(len(buckets)))
+                    per_rank = cfg.get("kv_bucket_order") or {}
+                    order = per_rank.get(rid, order)
+                    bwd.append(CollectiveEvent(
+                        "barrier", "world", (), "float32",
+                        node="kv.bucket_skew", phase="bwd"))
+                    for bi in order:
+                        bwd.append(CollectiveEvent(
+                            "allreduce", "world",
+                            (int(buckets[bi]),), "float32",
+                            node="kv.bucket%d" % bi, phase="bwd",
+                            payload="bucket%d" % bi))
+                else:
+                    bwd.append(CollectiveEvent("barrier", "world", (),
+                                               "float32", node="kv.push",
+                                               phase="bwd"))
+                    bwd.append(CollectiveEvent("allreduce", "world", (),
+                                               "float32", node="kv.push",
+                                               phase="bwd"))
 
         schedules[rid] = {"fwd": fwd, "bwd": bwd, "coord": coord}
     return schedules
@@ -362,15 +408,25 @@ def check_schedules(schedules, mesh_axes, report):
             else:
                 a = schedules[ref_rid][phase][i]
                 b = schedules[rid][phase][i]
+                # equal-shape events can still mismatch on operand
+                # identity (equal-sized kv buckets in divergent launch
+                # order) — name the payloads so the diagnostic is not
+                # an identical-vs-identical read
+                pay = ""
+                if a.payload is not None or b.payload is not None:
+                    pay = (" with payload %r vs %r (same-shaped operands"
+                           " are NOT interchangeable — the reduce mixes"
+                           " different gradient buckets silently)"
+                           % (a.payload, b.payload))
                 report.add(
                     "MXG011", "error",
                     "%s collective #%d diverges across ranks: rank %d "
                     "issues %s(axis=%r, shape=%s, dtype=%s) while rank "
-                    "%d issues %s(axis=%r, shape=%s, dtype=%s) — "
+                    "%d issues %s(axis=%r, shape=%s, dtype=%s)%s — "
                     "mismatched collectives desync or corrupt the ring"
                     % (phase, i,
                        ref_rid, a.op, a.axis, a.shape, a.dtype,
-                       rid, b.op, b.axis, b.shape, b.dtype),
+                       rid, b.op, b.axis, b.shape, b.dtype, pay),
                     node=a.node or b.node)
             return   # first divergence only; the rest is noise
 

@@ -195,6 +195,23 @@ _CATALOG = {
                           "per-chip peak memory bytes/s override for "
                           "costdb roofline derivation (default: "
                           "built-in per-backend table)"),
+    # communication overlap (parallel/overlap.py, docs/api/overlap.md)
+    "MXNET_TPU_OVERLAP": ("1", "honored",
+                          "bucketed async gradient allreduce overlapped "
+                          "with backward: DistKVStore trainer-gradient "
+                          "sync routes through push_bucketed/drain "
+                          "(buckets launch as cotangents land, one "
+                          "drain at the optimizer boundary) and "
+                          "DevicePrefetchIter double-buffers H2D "
+                          "staging; 0 restores the per-push "
+                          "barrier-then-allreduce (bit-parity between "
+                          "the modes is CI-gated)"),
+    "MXNET_TPU_BUCKET_BYTES": ("4194304", "honored",
+                               "gradient-bucket size target in bytes "
+                               "for the overlap layer (DDP-style; "
+                               "smaller buckets start communication "
+                               "earlier, larger ones amortize "
+                               "per-collective overhead)"),
     # elastic training (docs/api/reshard.md)
     "MXNET_TPU_ELASTIC": ("0", "honored",
                           "tools/launch.py --elastic default: a failed "

@@ -447,18 +447,50 @@ class DevicePrefetchIter:
                 continue
         return False
 
+    def _try_put(self, item):
+        """Non-blocking put; False when the queue is full (the caller
+        holds the item in its double-buffer slot instead)."""
+        import queue as _queue
+        try:
+            self._queue.put_nowait(item)
+            return True
+        except _queue.Full:
+            return False
+
     def _start(self):
         self._stop = False
         self._exhausted = False
 
         def worker():
             # payloads are tagged, so a stage_fn returning None or a
-            # tuple is never mistaken for a control message
+            # tuple is never mistaken for a control message.
+            #
+            # DOUBLE-BUFFERED staging (ISSUE 15): the worker holds up
+            # to one staged batch ASIDE of the bounded queue, so when
+            # the queue is full (backpressure) the NEXT batch's decode
+            # + H2D staging dispatch still proceeds instead of waiting
+            # behind the blocked put — the transfer overlaps the
+            # current step's compute, and the moment the consumer takes
+            # a batch the replacement is already staged (no pipeline
+            # bubble of one decode+transfer per take).  An empty queue
+            # flushes immediately, so consumer-bound pipelines see no
+            # added latency.
             tracker = _ioview.queue_tracker("device")
+            held = []        # staged, tracked, awaiting queue space
+            # MXNET_TPU_OVERLAP=0 restores the strictly serial
+            # decode -> stage -> blocking-put worker (held_cap 0)
+            import os as _os
+            held_cap = 0 if _os.environ.get(
+                "MXNET_TPU_OVERLAP", "1") in ("0", "false", "False") \
+                else 1
             try:
                 for batch in self._it:
                     if self._stop:
                         return
+                    # opportunistic flush: hand over anything the
+                    # consumer made room for, without blocking
+                    while held and self._try_put(held[0]):
+                        held.pop(0)
                     # io.prefetch fault seam: injected staging faults
                     # retry with backoff; exhaustion surfaces on the
                     # consumer like any other staging error (a
@@ -486,15 +518,35 @@ class DevicePrefetchIter:
                     # offset (a put that loses the race to a cancelled
                     # reset is settled by reset's set_depth(0))
                     tracker.adjust(+1)
-                    # a blocked put is producer-starved time: the queue
-                    # is full because the consumer (the training step)
-                    # is the slow side — backpressure, not a stall
-                    t_put = time.perf_counter()
-                    if not self._put(("item", staged)):
+                    held.append(("item", staged))
+                    # hand the fresh batch over NOW if the queue has
+                    # room — holding it until the next upstream fetch
+                    # would add one upstream-production latency to
+                    # every take on a producer-bound pipeline
+                    while held and self._try_put(held[0]):
+                        held.pop(0)
+                    # block only once BOTH double-buffer slots are
+                    # occupied; the blocked time is producer-starved —
+                    # the consumer (the training step) is the slow side
+                    while len(held) > held_cap:
+                        t_put = time.perf_counter()
+                        if not self._put(held[0]):
+                            return
+                        held.pop(0)
+                        _ioview.note_starved(
+                            "device", time.perf_counter() - t_put)
+                while held:
+                    if not self._put(held[0]):
                         return
-                    _ioview.note_starved(
-                        "device", time.perf_counter() - t_put)
+                    held.pop(0)
             except BaseException as e:  # mxlint: allow-broad-except(surfaced on the consumer via the error queue item)
+                # deliver any already-staged batch first: the serial
+                # path (held_cap 0) put it before the failing fetch,
+                # so the double-buffer must not silently drop it
+                while held:
+                    if not self._put(held[0]):
+                        return
+                    held.pop(0)
                 self._put(("error", e))
                 return
             self._put(("end", None))

@@ -70,12 +70,46 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
             kvstore.pull(idx, param_on_devs, priority=-idx)
 
 
+def _grad_pairs(param_arrays, grad_arrays):
+    """(index, arg_list, grad_list) for params that HAVE a gradient —
+    the one iteration every update path shares (frozen params carry a
+    None grad and are skipped)."""
+    for index, (arg_list, grad_list) in \
+            enumerate(zip(param_arrays, grad_arrays)):
+        if grad_list[0] is not None:
+            yield index, arg_list, grad_list
+
+
+def _push_all_bucketed(param_arrays, grad_arrays, kvstore):
+    """The overlap prologue both update paths share: push every
+    gradient into the store's size-targeted buckets (allreduces launch
+    asynchronously as the gradients land, overlapping the still-running
+    backward dispatch), then drain at the optimizer boundary."""
+    for index, _arg_list, grad_list in _grad_pairs(param_arrays,
+                                                   grad_arrays):
+        kvstore.push_bucketed(index, grad_list, priority=-index)
+    kvstore.drain()
+
+
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """push grads; pull updated weights (reference model.py:88-97)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
+    """push grads; pull updated weights (reference model.py:88-97).
+
+    On an overlap-capable store (``DistKVStore`` under
+    ``MXNET_TPU_OVERLAP``, docs/api/overlap.md) the per-key
+    push-then-pull interleave is restructured into push-all /
+    drain / pull-all: pushes buffer into size-targeted buckets whose
+    allreduces launch asynchronously as the gradients land (overlapping
+    the still-running backward dispatch), the drain at the optimizer
+    boundary applies every update at once, and the pulls then read the
+    updated weights — retiring the per-push fleet-wide barrier."""
+    if getattr(kvstore, "overlap_active", False):
+        _push_all_bucketed(param_arrays, grad_arrays, kvstore)
+        for index, arg_list, _grad_list in _grad_pairs(param_arrays,
+                                                       grad_arrays):
+            kvstore.pull(index, arg_list, priority=-index)
+        return
+    for index, arg_list, grad_list in _grad_pairs(param_arrays,
+                                                  grad_arrays):
         kvstore.push(index, grad_list, priority=-index)
         kvstore.pull(index, arg_list, priority=-index)
 
@@ -83,12 +117,17 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
     """aggregate via kvstore (or not), update locally per device
-    (reference model.py:99-116)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        if kvstore:
+    (reference model.py:99-116).  The kvstore aggregation leg takes
+    the same bucketed-overlap restructure as
+    :func:`_update_params_on_kvstore` when the store supports it."""
+    overlap = kvstore and getattr(kvstore, "overlap_active", False)
+    if overlap:
+        _push_all_bucketed(param_arrays, grad_arrays, kvstore)
+    for index, arg_list, grad_list in _grad_pairs(param_arrays,
+                                                  grad_arrays):
+        if overlap:
+            kvstore.pull(index, grad_list, priority=-index)
+        elif kvstore:
             kvstore.push(index, grad_list, priority=-index)
             kvstore.pull(index, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):

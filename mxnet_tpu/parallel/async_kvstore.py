@@ -250,6 +250,8 @@ class AsyncKVStore(KVStore):
                        for i, h in enumerate(server_hosts)]
         self._sock = self._socks[0]  # back-compat alias
         self._plans = {}             # key -> None (small) | [(lo, hi)]*S
+        self._push_pool = None       # lazy single sender thread
+        self._bucket_queue = None    # lazy overlap.BucketQueue
 
     @staticmethod
     def _connect(host, port, timeout=60.0):
@@ -336,6 +338,19 @@ class AsyncKVStore(KVStore):
                 for i, (lo, hi) in enumerate(plan):
                     self._rpc_to(i, "init", "%s#%d" % (k, i), flat[lo:hi])
 
+    def _send_push(self, k, merged):
+        """Wire one merged gradient to its server(s) — the per-key
+        protocol shared by the synchronous push and the bucketed
+        sender thread (sockets are serialized either way: a single
+        caller, or the single worker of the push pool)."""
+        plan = self._plan_of(k, merged.size)
+        if plan is None:
+            self._rpc_to(self._server_of(k), "push", k, merged)
+        else:
+            flat = merged.reshape(-1)
+            for i, (lo, hi) in enumerate(plan):
+                self._rpc_to(i, "push", "%s#%d" % (k, i), flat[lo:hi])
+
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         uniq, grouped = _group_kv_pairs(keys, vals)
@@ -344,16 +359,75 @@ class AsyncKVStore(KVStore):
             for other in group[1:]:
                 merged = merged + other.asnumpy()
             self._push_bytes.inc(merged.nbytes)
-            plan = self._plan_of(k, merged.size)
-            if plan is None:
-                self._rpc_to(self._server_of(k), "push", k, merged)
-            else:
-                flat = merged.reshape(-1)
-                for i, (lo, hi) in enumerate(plan):
-                    self._rpc_to(i, "push", "%s#%d" % (k, i), flat[lo:hi])
+            self._send_push(k, merged)
+
+    # ------------------------------------------- bucketed overlap path
+    @property
+    def overlap_active(self):
+        """Bucketed pushes (parallel/overlap.py, MXNET_TPU_OVERLAP):
+        the RPC round trips — the async path's per-key latency — move
+        onto a background sender thread, overlapping the rest of
+        gradient production; :meth:`drain` is the ack point before the
+        weight pulls."""
+        from . import overlap as _overlap
+        return _overlap.overlap_enabled()
+
+    def _launch_push_bucket(self, bucket):
+        """BucketQueue reduce_fn: ship one bucket's pushes on the
+        single sender thread (one worker — the per-server sockets are
+        not concurrency-safe and the server applies updates per push
+        in arrival order anyway).  The handle joins the send; async
+        semantics mean there is no reduced value to hand back."""
+        import concurrent.futures
+
+        if self._push_pool is None:
+            self._push_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="mxtpu-async-push")
+
+        def send(items=tuple(bucket.items())):
+            for k, merged in items:
+                self._send_push(k, merged)
+
+        fut = self._push_pool.submit(send)
+
+        def handle():
+            fut.result()
+            return {}
+        return handle
+
+    def push_bucketed(self, key, value, priority=0):
+        """Merge local replicas and buffer into size-targeted buckets;
+        full buckets ship on the sender thread immediately.  Updates
+        still apply server-side per push (the dist_async contract) —
+        nothing is applied locally at :meth:`drain`."""
+        from . import overlap as _overlap
+        if self._bucket_queue is None:
+            self._bucket_queue = _overlap.BucketQueue(
+                self._launch_push_bucket, site="kvstore.async_push",
+                skew_probe=lambda: None)
+        keys, vals = _ctype_key_value(key, value)
+        uniq, grouped = _group_kv_pairs(keys, vals)
+        for k, group in zip(uniq, grouped):
+            merged = group[0].asnumpy()
+            for other in group[1:]:
+                merged = merged + other.asnumpy()
+            self._push_bytes.inc(merged.nbytes)
+            self._bucket_queue.push(k, merged, merged.nbytes)
+
+    def drain(self):
+        """Ship the remaining buckets and join every in-flight send —
+        the ordering point that keeps push-before-pull semantics for
+        the Module update path.  No-op when nothing was pushed."""
+        if self._bucket_queue is None or not self._bucket_queue.pending:
+            return
+        self._bucket_queue.drain()
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
+        # join any in-flight bucketed sends first: per-worker
+        # push-then-pull ordering, and the sender thread must not
+        # share a socket with this pull mid-message
+        self.drain()
         keys, outs = _ctype_key_value(key, out)
         cache = {}
         for k, o in zip(keys, outs):
@@ -388,6 +462,7 @@ class AsyncKVStore(KVStore):
     def barrier(self):
         # every server gates on all workers, so the slowest server
         # bounds the barrier exactly once per generation
+        self.drain()
         self._rpc_all("barrier")
 
     def server_stats(self):
@@ -437,6 +512,10 @@ class AsyncKVStore(KVStore):
             self._rpc_to(i, "set_opt_states", b)
 
     def close(self):
+        try:
+            self.drain()
+        except MXNetError:
+            pass          # best-effort teardown: sends may be half-dead
         for i, sock in enumerate(list(self._socks)):
             try:
                 self._rpc_to(i, "bye")

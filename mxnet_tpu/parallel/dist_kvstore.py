@@ -47,6 +47,8 @@ class DistKVStore(KVStore):
         self._rank = jax.process_index()
         self._mesh = None
         self._reduce_fn = None
+        self._merge_fn = None
+        self._bucket_queue = None
 
     @property
     def rank(self):
@@ -103,24 +105,63 @@ class DistKVStore(KVStore):
                               else np.asarray(arr)])
         return out[0]
 
-    # ------------------------------------------------------------------ api
-    def push(self, key, value, priority=0):
-        """Aggregate local replicas, AllReduce every key across hosts in
-        one program, then apply the updater — the reference's
-        sync-aggregation contract (kvstore_dist_server.h:164-199: update
-        runs once after exactly num_workers pushes)."""
+    # ----------------------------------------------------- local merge
+    def _merge_local(self, key, value):
+        """Merge local replicas per key into ``{key: NDArray}`` and the
+        total payload bytes.  Single-member groups pass through WITHOUT
+        the old defensive ``copy()`` — the allreduce consumes without
+        mutating, and the single-process apply paths re-protect via
+        ``copy_on_store`` (:meth:`_apply` copies before a store
+        assignment or a user updater, either of which could otherwise
+        alias/mutate the caller's live gradient); multi-member groups
+        are summed in ONE dispatched program over the whole key set —
+        the old serial per-key ``m += other`` host loop paid a dispatch
+        per replica per key (ISSUE 15 satellite)."""
         from ..kvstore import _ctype_key_value, _group_kv_pairs
         from ..ndarray import NDArray
         keys, vals = _ctype_key_value(key, value)
         uniq, grouped = _group_kv_pairs(keys, vals)
         merged = {}
+        multi = {}
         push_bytes = 0
         for k, group in zip(uniq, grouped):
-            m = group[0].copy()
-            for other in group[1:]:
-                m += other
-            merged[k] = m
-            push_bytes += _nbytes(m)
+            if len(group) == 1:
+                merged[k] = group[0]
+            else:
+                multi[k] = [g.data for g in group]
+            push_bytes += _nbytes(group[0])
+        if multi:
+            if self._merge_fn is None:
+                import functools
+                import jax
+                # sum each key's replica list left-to-right inside one
+                # jitted program (bit-identical to the old serial
+                # NDArray += loop, which also folded left-to-right);
+                # jit caches per pytree structure, so a different key
+                # set retraces and a repeated one dispatches directly
+                self._merge_fn = jax.jit(lambda tree: {
+                    kk: functools.reduce(lambda a, b: a + b, vs)
+                    for kk, vs in tree.items()})
+            summed = self._merge_fn(multi)
+            for k, v in summed.items():
+                merged[k] = NDArray(v)
+        return {k: merged[k] for k in uniq}, push_bytes
+
+    # ------------------------------------------------------------------ api
+    def push(self, key, value, priority=0):
+        """Aggregate local replicas, AllReduce every key across hosts in
+        one program, then apply the updater — the reference's
+        sync-aggregation contract (kvstore_dist_server.h:164-199: update
+        runs once after exactly num_workers pushes).
+
+        This is the SYNCHRONOUS path (one fleet-wide collective per
+        call); trainer gradient sync should prefer the bucketed
+        :meth:`push_bucketed`/:meth:`drain` pair, which overlaps the
+        allreduce with backward (``model._update_params_on_kvstore``
+        routes there when ``MXNET_TPU_OVERLAP`` is on — see
+        docs/api/overlap.md)."""
+        from ..ndarray import NDArray
+        merged, push_bytes = self._merge_local(key, value)
         self._push_bytes.inc(push_bytes)
         if self._num_workers > 1:
             # cross-host collective: worth a flight-ring entry (a hang
@@ -144,13 +185,99 @@ class DistKVStore(KVStore):
             # value — no host round trip
             merged = {k: NDArray(v.addressable_data(0))
                       for k, v in summed.items()}
+        self._apply(merged, copy_on_store=self._num_workers == 1)
+
+    def _apply(self, merged, copy_on_store=False):
+        """Apply reduced values: through the updater when installed,
+        else into the store.  ``copy_on_store``: single-process merges
+        skip the defensive copy in :meth:`_merge_local`, so BOTH
+        branches re-protect here — a store assignment (which keeps the
+        array) copies instead of aliasing the caller's gradient, and an
+        installed updater receives a private recv buffer (the reference
+        contract lets a user updater mutate its gradient argument in
+        place; without the copy that would corrupt the executor's live
+        gradient).  Multi-worker values are fresh allreduce outputs and
+        never alias."""
+        if self._updater is not None:
+            # validate the whole batch BEFORE any update so a missing
+            # key cannot leave a partially-applied drain
+            for k in merged:
+                if k not in self._store:
+                    raise MXNetError("key %s has not been inited"
+                                     % str(k))
         for k, m in merged.items():
             if self._updater is not None:
-                if k not in self._store:
-                    raise MXNetError("key %s has not been inited" % str(k))
-                self._updater(k, m, self._store[k])
+                self._updater(k, m.copy() if copy_on_store else m,
+                              self._store[k])
             else:
-                self._store[k] = m
+                self._store[k] = m.copy() if copy_on_store else m
+
+    # ------------------------------------------- bucketed overlap path
+    @property
+    def overlap_active(self):
+        """Whether trainer gradient sync should route through the
+        bucketed :meth:`push_bucketed`/:meth:`drain` pair
+        (``MXNET_TPU_OVERLAP``, multi-worker only — a single process
+        has no collective to hide)."""
+        from . import overlap as _overlap
+        return self._num_workers > 1 and _overlap.overlap_enabled()
+
+    def _launch_bucket(self, bucket):
+        """BucketQueue reduce_fn: dispatch ONE bucket's pytree
+        allreduce.  JAX dispatch is asynchronous — the call returns as
+        soon as the program is enqueued, so the collective runs behind
+        whatever device work (the backward) is still in flight; the
+        returned handle only converts the already-dispatched arrays."""
+        from ..ndarray import NDArray
+        summed = self.allreduce({k: m.data for k, m in bucket.items()})
+
+        def handle():
+            return {k: NDArray(v.addressable_data(0))
+                    for k, v in summed.items()}
+        return handle
+
+    def push_bucketed(self, key, value, priority=0):
+        """Bucketed asynchronous push: merge local replicas (one
+        dispatched program), append to the current size-targeted
+        bucket (``MXNET_TPU_BUCKET_BYTES``), and launch a full
+        bucket's allreduce immediately — overlapping the rest of
+        gradient production.  Nothing is applied until :meth:`drain`;
+        per-key :meth:`pull` ordering holds after the drain exactly as
+        after a synchronous push."""
+        from . import overlap as _overlap
+        if self._num_workers <= 1:
+            # no collective to bucket: keep the synchronous semantics
+            return self.push(key, value, priority=priority)
+        if self._bucket_queue is None:
+            self._bucket_queue = _overlap.BucketQueue(
+                self._launch_bucket, site="kvstore.push")
+        merged, push_bytes = self._merge_local(key, value)
+        self._push_bytes.inc(push_bytes)
+        for k, m in merged.items():
+            self._bucket_queue.push(k, m, _nbytes(m))
+
+    def drain(self):
+        """Optimizer boundary: launch the remaining buckets
+        (slowest-to-produce first — parallel/overlap.py scheduler),
+        wait out every in-flight allreduce, then apply the updater for
+        ALL keys.  All-or-nothing: a collective fault mid-drain (the
+        ``kvstore.collective`` seam) raises before any update is
+        applied, leaving optimizer state untouched.  No-op when
+        nothing was pushed."""
+        if self._bucket_queue is None or not self._bucket_queue.pending:
+            return
+        mesh = {"hosts": self._num_workers}
+        reduced = self._bucket_queue.drain(mesh=mesh)
+        self._apply(reduced)
+
+    def pull(self, key, out=None, priority=0):
+        """Join any in-flight buckets first: per-worker push-then-pull
+        ordering must hold for the bucketed path exactly as it does for
+        the synchronous :meth:`push` (``AsyncKVStore.pull`` has the
+        same guard) — without it a ``push_bucketed`` → ``pull`` pair
+        would silently read the stale pre-drain weights."""
+        self.drain()
+        return super().pull(key, out=out, priority=priority)
 
     def barrier(self):
         if self._num_workers > 1:

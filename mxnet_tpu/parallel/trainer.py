@@ -1559,6 +1559,53 @@ class ShardedTrainer:
         self._stage_fns[key] = (fn, in_sharding)
         return fn, in_sharding
 
+    def _stage_accounted(self, host_batch):
+        """Stage one host batch, charging the wall to the ioview
+        ``device_stage`` pipeline stage (the H2D half of the data
+        plane).  Unlike :meth:`_stage_timed` this runs OUTSIDE a step,
+        so nothing lands in the step's ``input_wait`` segment — that
+        is the point of prefetched staging."""
+        import time as _time
+        from ..telemetry import ioview as _iov
+        t0 = _time.perf_counter()
+        dev = self.put_batch(host_batch)
+        _iov.account("device_stage", _time.perf_counter() - t0,
+                     items=1,
+                     nbytes=sum(getattr(v, "nbytes", 0)
+                                for v in host_batch.values()))
+        return dev
+
+    def staged_batches(self, batches):
+        """Double-buffered host->device staging over an iterable of
+        HOST batches: yields staged device batches (feedable straight
+        to :meth:`step`), dispatching batch N+1's transfer right after
+        the caller resumes from batch N — i.e. while batch N's step is
+        still in flight on an async backend, so the H2D transfer
+        overlaps the current step's compute instead of serializing
+        into its ``input_wait`` segment.
+
+        The thread-free sibling of :class:`~mxnet_tpu.io.
+        DevicePrefetchIter` (which adds a worker thread and a depth-N
+        queue on top of the same staging seam; the ioview
+        ``device_stage`` metric times both).  Use when the host batches
+        are already cheap to produce (synthetic/benchmark loops)::
+
+            for dev_batch in trainer.staged_batches(host_batches):
+                loss = trainer.step(dev_batch)
+        """
+        it = iter(batches)
+        try:
+            nxt = self._stage_accounted(next(it))
+        except StopIteration:
+            return
+        for host in it:
+            cur, nxt = nxt, None
+            yield cur
+            # the caller just dispatched its step on `cur`; this
+            # transfer rides under that still-running step
+            nxt = self._stage_accounted(host)
+        yield nxt
+
     def step(self, batch):
         """One fused training step.  ``batch``: dict name -> host array
         with GLOBAL batch dim (or a dict from :meth:`put_batch`).

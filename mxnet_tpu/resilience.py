@@ -70,6 +70,12 @@ KNOWN_SITES = (
     # decode stage for ioview bottleneck-attribution drills
     "io.decode",
     "trainer.step",
+    # bucketed gradient allreduce (parallel/overlap.py,
+    # docs/api/overlap.md): fires at every bucket launch — arming it
+    # with after=N faults a launch mid-drain, and the drain's
+    # all-or-nothing contract (optimizer state untouched) is the thing
+    # under test
+    "kvstore.collective",
     # elastic training (parallel/reshard.py, docs/api/reshard.md):
     # per-param gather/scatter of a mesh reshape, and the world-size
     # change detection on a rank join/leave resume

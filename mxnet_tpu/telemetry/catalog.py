@@ -105,6 +105,26 @@ CATALOG = {
     "mxtpu_kvstore_pending_async": (GAUGE, (),
                                     "dist_async push/pull RPCs "
                                     "currently in flight"),
+    # ------------------------- communication overlap (parallel.overlap)
+    "mxtpu_overlap_buckets_total": (COUNTER, ("phase",),
+                                    "gradient buckets launched by the "
+                                    "overlap layer (phase=backward — "
+                                    "the launch overlapped gradient "
+                                    "production; drain — it waited "
+                                    "for the optimizer boundary)"),
+    "mxtpu_overlap_bucket_bytes": (HISTOGRAM, (),
+                                   "payload bytes per launched "
+                                   "gradient bucket "
+                                   "(MXNET_TPU_BUCKET_BYTES sets the "
+                                   "fill target)"),
+    "mxtpu_overlap_drain_seconds": (HISTOGRAM, (),
+                                    "wall time of the optimizer-"
+                                    "boundary bucket drain (launch "
+                                    "remainder + wait out every "
+                                    "in-flight allreduce)"),
+    "mxtpu_overlap_inflight_buckets": (GAUGE, (),
+                                       "gradient buckets launched and "
+                                       "not yet drained"),
     # ----------------------------------------------------- resilience
     "mxtpu_retry_total": (COUNTER, ("site",),
                           "retry attempts scheduled by "
@@ -172,7 +192,8 @@ CATALOG = {
     "mxtpu_costdb_records_total": (COUNTER, ("kind",),
                                    "aggregate records created in the "
                                    "op/block cost database "
-                                   "(kind=program|block|kernel)"),
+                                   "(kind=program|block|kernel|"
+                                   "collective)"),
     # ----------------------------------------- autotuner (autotune)
     "mxtpu_tune_cache_hit_total": (COUNTER, ("op",),
                                    "trace-time tuning-cache lookups "

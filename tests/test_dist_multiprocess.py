@@ -53,8 +53,28 @@ def _launch(worker, n=4, timeout=280, extra_env=None, extra_args=None):
     return res, res.stdout + res.stderr
 
 
+def _require_cpu_multiprocess():
+    """Quarantine guard for the collective-requiring dist tests (ISSUE
+    15 satellite triage).  Root cause of the standing failures: jax
+    0.4.x's CPU backend does not implement cross-process computations
+    at all — every collective raises ``INVALID_ARGUMENT: Multiprocess
+    computations aren't implemented on the CPU backend`` within
+    seconds, deterministically (not a flake; it only ever LOOKED
+    windowed because the tier-1 time cap moved around it).  The cached
+    2-process probe (below) detects a capable backend, so these tests
+    run wherever collectives exist (real TPU pods, newer jax CPU) and
+    skip with this documented reason where they cannot."""
+    if not _cpu_multiprocess_supported():
+        pytest.skip("this jax/CPU backend cannot run cross-process "
+                    "collectives (jax 0.4.x: 'Multiprocess "
+                    "computations aren't implemented on the CPU "
+                    "backend'); deterministic, not a flake — runs on "
+                    "collective-capable backends")
+
+
 @pytest.mark.timeout(300)
 def test_dist_sync_4_workers():
+    _require_cpu_multiprocess()
     res, out = _launch("dist_sync_worker.py")
     assert res.returncode == 0, out
     for rank in range(4):
@@ -84,6 +104,7 @@ def test_dist_fused_trainer_multihost_parity(tmp_path):
     checkpoint that a fresh trainer resumes to identical losses (the
     resume leg runs inside the worker).  Step-for-step loss parity is
     asserted against the SAME global mesh in a single process."""
+    _require_cpu_multiprocess()
     env1 = {"FUSED_DEVS_PER_PROC": "4",
             "FUSED_CKPT_PREFIX": str(tmp_path / "sp")}
     res1, out1 = _launch("dist_fused_worker.py", n=1, timeout=400,
@@ -114,6 +135,7 @@ def test_dist_kill_worker_recovery(tmp_path):
     fast with a clear error (surviving ranks would block on the dead
     rank's collectives) — then a fresh job resumes every rank from the
     last complete checkpoint and trains to the loss threshold."""
+    _require_cpu_multiprocess()
     env = {"RECOVERY_MODE": "crash",
            "RECOVERY_CKPT": str(tmp_path / "rec"),
            "KILL_RANK": "1", "KILL_STEP": "7",
@@ -477,12 +499,46 @@ def test_dist_distview_sigusr1_live_capture(tmp_path):
         assert planes, "no trace window for rank %d:\n%s" % (rank, out)
 
 
+@pytest.mark.timeout(1500)
+def test_dist_overlap_bitparity_and_collective_wait(tmp_path):
+    """ISSUE 15 acceptance (ROADMAP item 4): the 2-process overlap A/B.
+    ``tools/overlap_ab.py`` trains the same Module twice under
+    ``launch.py`` with a seeded slow rank — overlap off (per-key
+    barrier-then-allreduce, the retired DistKVStore.push shape) vs on
+    (the bucketed ``push_bucketed``/``drain`` branch through the real
+    ``parallel.overlap.BucketQueue``).  Gates: the fast rank's
+    ``mxtpu_collective_wait_seconds`` total AND step-segment
+    ``collective_wait`` share strictly smaller with overlap on; final
+    params of BOTH ranks bit-identical across the modes; the on leg's
+    ``overlap`` bucket flight events parseable by flight_read.  The
+    transport is the filesystem allreduce (no jax cross-process
+    collectives needed — this runs on every backend, unlike the
+    probe-guarded tests above)."""
+    import json
+    import subprocess
+
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "overlap_ab.py"),
+         "--json", "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=1300, cwd=ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    doc = json.loads(res.stdout.strip().splitlines()[-1])
+    assert doc["schema"] == "mxtpu-overlap-ab/1", doc
+    assert doc["pass"] is True, doc
+    assert doc["on"]["wait_s"] < doc["off"]["wait_s"], doc
+    assert doc["on"]["share"] < doc["off"]["share"], doc
+    assert doc["params_bit_identical"] is True, doc
+    assert doc["overlap_flight_events"] > 0, doc
+
+
 @pytest.mark.timeout(600)
 def test_dist_train_convergence_identical_replicas():
     """Reference tests/nightly/dist_lenet.py equivalent: 4 processes
     train the MLP to >0.9 accuracy with dist_sync gradient allreduce,
     each on its own data shard, and every rank proves zero cross-rank
     parameter variance (identical replicas) through the kvstore."""
+    _require_cpu_multiprocess()
     res, out = _launch("dist_train_worker.py", timeout=560)
     assert res.returncode == 0, out
     for rank in range(4):

@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Fourteen stages, all of which must be clean:
+Fifteen stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-006; pragmas with reasons are the only
@@ -109,6 +109,20 @@ Fourteen stages, all of which must be clean:
     ``mxtpu_io_prefetch_starved_seconds_total`` metrics
     automatically.)
 
+15. **overlap gate** — the bucketed-async-allreduce overlap layer end
+    to end (``mxnet_tpu/parallel/overlap.py``, docs/api/overlap.md):
+    ``tools/overlap_ab.py`` runs a 2-process dry run with a seeded
+    slow rank twice (overlap off, then on — the on leg routes through
+    ``model._update_params_on_kvstore``'s bucketed branch and the real
+    ``BucketQueue``); the FAST rank's ``mxtpu_collective_wait_
+    seconds`` total and step-segment ``collective_wait`` share must be
+    strictly smaller with overlap on, the final params of BOTH ranks
+    must be bit-identical between the modes, and the on leg's
+    ``overlap`` bucket flight events must parse via
+    ``tools/flight_read.py``.  (The stage-4 drift guard covers the new
+    ``mxtpu_overlap_*`` metrics automatically; stage 13 additionally
+    discriminates a seeded bucket-order mismatch via MXG011.)
+
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
 """
@@ -143,7 +157,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/14] mxlint: %d finding(s) over %s"
+        say("ci_check[1/15] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -152,7 +166,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/14] registry selfcheck: %d problem(s)"
+        say("ci_check[2/15] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -166,14 +180,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/14] verify model %-22s %s" % (name, status))
+            say("ci_check[3/15] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/14] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/15] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -181,7 +195,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/14] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/15] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -189,7 +203,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/14] distview smoke: %d problem(s)"
+        say("ci_check[6/15] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -197,14 +211,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/14] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/15] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/14] perf ground truth: %d problem(s)"
+        say("ci_check[8/15] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -212,7 +226,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/14] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/15] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -220,7 +234,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/14] reshard gate: %d problem(s)"
+        say("ci_check[10/15] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -229,7 +243,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/14] numerics gate: %d problem(s)"
+        say("ci_check[11/15] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -238,7 +252,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/14] plan search: %d problem(s)"
+        say("ci_check[12/15] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
@@ -247,7 +261,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 13: SPMD gate (seeded-defect discrimination per
         # MXG011-016 rule + clean sweep over zoo and composed configs)
         problems = spmd_check(repo_root)
-        say("ci_check[13/14] spmd gate: %d problem(s)" % len(problems))
+        say("ci_check[13/15] spmd gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("spmd: %s" % p)
             say("  " + p)
@@ -255,10 +269,20 @@ def run(repo_root=_ROOT, out=None):
         # stage 14: io observability gate (seeded slow stage ->
         # io_top --json names it; flight + counter verdicts agree)
         problems = ioview_check(repo_root)
-        say("ci_check[14/14] io observability: %d problem(s)"
+        say("ci_check[14/15] io observability: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("ioview: %s" % p)
+            say("  " + p)
+
+        # stage 15: overlap gate (2-process on/off A/B: fast rank's
+        # collective wait strictly smaller at bit-identical params,
+        # bucket flight events parseable)
+        problems = overlap_check(repo_root)
+        say("ci_check[15/15] overlap gate: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("overlap: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -515,7 +539,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/14] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/15] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -1242,6 +1266,62 @@ def plansearch_check(repo_root=_ROOT):
     return problems
 
 
+def overlap_check(repo_root=_ROOT):
+    """Overlap gate (stage 15): run ``tools/overlap_ab.py --json`` —
+    the 2-process seeded-slow-rank A/B — and require every gate in its
+    document: fast-rank wait and collective_wait share strictly
+    smaller with overlap on, bit-identical final params across the
+    modes, and parseable ``overlap`` bucket flight events on the on
+    leg.  Returns a list of problem strings (empty = clean)."""
+    import json
+    import subprocess
+
+    problems = []
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "overlap_ab.py"),
+             "--json"],
+            # > overlap_ab's own worst case: 2 timing-retry attempts
+            # x 2 legs x 300s per-leg timeout
+            capture_output=True, text=True, timeout=1300, cwd=repo_root)
+    except subprocess.TimeoutExpired:
+        return ["overlap A/B dry run timed out"]
+    if res.returncode not in (0, 1):
+        return ["overlap_ab.py crashed (%d): %s"
+                % (res.returncode, (res.stdout + res.stderr)[-800:])]
+    try:
+        doc = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return ["overlap_ab.py output is not parseable JSON: %s (%s)"
+                % (e, res.stdout[-400:])]
+    if doc.get("schema") != "mxtpu-overlap-ab/1":
+        problems.append("A/B schema %r != 'mxtpu-overlap-ab/1'"
+                        % doc.get("schema"))
+    on, off = doc.get("on") or {}, doc.get("off") or {}
+    if not (isinstance(on.get("wait_s"), (int, float))
+            and isinstance(off.get("wait_s"), (int, float))
+            and on["wait_s"] < off["wait_s"]):
+        problems.append(
+            "fast rank's mxtpu_collective_wait_seconds not strictly "
+            "smaller with overlap on: on=%r off=%r"
+            % (on.get("wait_s"), off.get("wait_s")))
+    if not (isinstance(on.get("share"), (int, float))
+            and isinstance(off.get("share"), (int, float))
+            and on["share"] < off["share"]):
+        problems.append(
+            "fast rank's collective_wait segment share not strictly "
+            "smaller with overlap on: on=%r off=%r"
+            % (on.get("share"), off.get("share")))
+    if not doc.get("params_bit_identical"):
+        problems.append("final params differ between overlap on/off: %r"
+                        % doc.get("params_by_rank"))
+    if not doc.get("overlap_flight_events"):
+        problems.append("no parseable 'overlap' bucket flight events "
+                        "in the on leg's dumps")
+    return problems
+
+
 def spmd_check(repo_root=_ROOT):
     """SPMD gate (stage 13).  Two legs:
 
@@ -1305,6 +1385,17 @@ def spmd_check(repo_root=_ROOT):
     rep = spmd.verify_spmd(None, {"data": 2}, analysis.build_config(
         kv_push=True, kv_push_ranks=[0]))
     expect("kv-subset", rep, "MXG011", "kv.push", "deadlock")
+    # bucketed overlap schedule (parallel/overlap.py): a seeded
+    # rank-divergent bucket launch order must be named as the first
+    # mismatched bucket; the plan-order schedule must verify clean
+    rep = spmd.verify_spmd(None, {"data": 2}, analysis.build_config(
+        kv_push=True, kv_buckets=[4096, 2048, 1024],
+        kv_bucket_order={1: [2, 1, 0]}))
+    expect("kv-bucket-order", rep, "MXG011", "kv.bucket", "diverges")
+    rep = spmd.verify_spmd(None, {"data": 2}, analysis.build_config(
+        kv_push=True, kv_buckets=[4096, 2048, 1024]))
+    if len(rep):
+        problems.append("clean bucketed kv schedule flagged: %s" % rep)
     rep = spmd.verify_spmd(
         ring_lm(18), {"data": 1, "model": 4},
         analysis.build_config(sequence_parallel=True,

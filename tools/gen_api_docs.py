@@ -64,6 +64,8 @@ HAND_WRITTEN = [
      "plansearch.md"),
     ("reshard (elastic training: checkpoint resharding, rank "
      "join/leave)", "reshard.md"),
+    ("overlap (bucketed async gradient allreduce overlapped with "
+     "backward, double-buffered staging)", "overlap.md"),
 ]
 
 # cross-links appended to generated pages (page key = module filename
@@ -109,7 +111,12 @@ SEE_ALSO = {
            "accounting through the prefetchers, time-weighted queue "
            "occupancy, producer-starved vs consumer-stalled "
            "attribution, and the `position()` API every iterator (and "
-           "wrapper) here implements — rendered by `tools/io_top.py`"],
+           "wrapper) here implements — rendered by `tools/io_top.py`",
+           "[overlap](overlap.md) — `DevicePrefetchIter`'s "
+           "double-buffered H2D staging (the worker holds one staged "
+           "batch aside of the queue so the next transfer dispatches "
+           "under backpressure) and the thread-free "
+           "`ShardedTrainer.staged_batches` sibling"],
     "model": ["[resilience](resilience.md) — atomic checkpoint writes, "
               "the manifest format, latest-checkpoint fallback",
               "[reshard](reshard.md) — manifest schema v2 mesh "
@@ -173,7 +180,15 @@ SEE_ALSO = {
                  "samples in-graph param/grad/fused-block stats inside "
                  "the jitted step, anomaly rules stop a strict run with "
                  "NaN provenance, and the per-step ledger feeds "
-                 "`tools/numdiff.py` divergence bisection"],
+                 "`tools/numdiff.py` divergence bisection",
+                 "[overlap](overlap.md) — communication overlap "
+                 "(`parallel.overlap`): size-targeted gradient buckets "
+                 "launched asynchronously as backward produces "
+                 "cotangents, the slowest-to-produce-first drain "
+                 "scheduler fed by the fleet-agreed skew histograms, "
+                 "the all-or-nothing drain contract chaos-tested "
+                 "through the `kvstore.collective` seam, and "
+                 "`staged_batches` double-buffered H2D staging"],
     "monitor": ["[telemetry](telemetry.md) — training-health numerics "
                 "(`telemetry.numerics`): the jit-safe stat machinery "
                 "the default Monitor path rides (`mxtpu_monitor_stat"
@@ -192,7 +207,14 @@ SEE_ALSO = {
                "[fusion](fusion.md) — the block-granularity fusion "
                "pass `eval_graph` lowers matched chains through"],
     "kvstore": ["[telemetry](telemetry.md) — push/pull byte counters "
-                "and the dist_async in-flight gauge"],
+                "and the dist_async in-flight gauge",
+                "[overlap](overlap.md) — bucketed async gradient "
+                "allreduce (parallel/overlap.py): `DistKVStore."
+                "push_bucketed`/`drain` replace the per-push "
+                "barrier-then-allreduce for trainer gradients under "
+                "`MXNET_TPU_OVERLAP`, launching size-targeted buckets "
+                "as backward produces cotangents and draining at the "
+                "optimizer boundary"],
     "profiler": ["[telemetry](telemetry.md) — spans feed these Chrome "
                  "traces; metrics/exporters live there, as do the "
                  "memory-plan gauges (`telemetry.memory`), the "
