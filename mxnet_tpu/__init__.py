@@ -58,6 +58,7 @@ from . import lr_scheduler
 from . import optimizer
 from . import metric
 from . import io
+from . import io_resume
 from . import callback
 from . import kvstore
 from . import kvstore as kv

@@ -146,6 +146,22 @@ _CATALOG = {
                                "the per-step block — stage metrics and "
                                "the bottleneck classifier keep "
                                "running)"),
+    "MXNET_TPU_DATA_RESUME": ("1", "honored",
+                              "write the tracked data iterator's "
+                              "durable state() into checkpoint "
+                              "manifests (meta.data_state) and restore "
+                              "it on resume, so a mid-epoch kill "
+                              "resumes at the exact next sample "
+                              "(mxnet_tpu.io_resume; 0 = legacy "
+                              "start-of-epoch resume)"),
+    "MXNET_TPU_BACKPRESSURE": ("0", "honored",
+                               "close the io_top sensor->actuator "
+                               "loop: fit() installs a backpressure "
+                               "controller that reads the bottleneck "
+                               "verdict per batch and retunes pipeline "
+                               "knobs (device prefetch depth) with "
+                               "hysteresis, telemetering every move "
+                               "(mxtpu_backpressure_adjust_total)"),
     "MXNET_TPU_IOVIEW_WINDOW": ("5", "honored",
                                 "ioview bottleneck-classifier window "
                                 "in seconds: per window, consumer-"

@@ -394,6 +394,53 @@ class ImageIter(io_mod.DataIter):
                 "num_shards": self.num_parts, "offset": int(offset),
                 "resyncs": int(getattr(self.imgrec, "resyncs", 0) or 0)}
 
+    def state(self):
+        """Durable state.  Key-list mode records the epoch's key ORDER
+        explicitly when shuffling (``reset`` shuffles from the global
+        ``random`` RNG, which no seed in this state could replay);
+        sequential-``.rec`` mode delegates to the reader's byte-exact
+        state.  Augmentation randomness is NOT part of the contract —
+        the durable thing is the sample stream, not the pixels."""
+        from . import io_resume
+        st = {"v": io_resume.STATE_VERSION, "kind": "image",
+              "epoch": self._epochs, "shard": self.part_index,
+              "num_shards": self.num_parts, "cur": int(self.cur)}
+        if self.seq is not None:
+            if self.shuffle:
+                st["seq"] = list(self.seq)
+        else:
+            st["rec"] = self.imgrec.state()
+        return st
+
+    def restore(self, state):
+        from . import io_resume
+        io_resume.check_state(state, "image")
+        if int(state["shard"]) != self.part_index or \
+                int(state["num_shards"]) != self.num_parts:
+            raise MXNetError(
+                "image state is for shard %s/%s, iterator is %d/%d"
+                % (state["shard"], state["num_shards"],
+                   self.part_index, self.num_parts))
+        if self.seq is not None:
+            seq = state.get("seq")
+            if seq is not None and len(seq) != len(self.seq):
+                raise MXNetError(
+                    "image state key list has %d entries, iterator has "
+                    "%d — different dataset?" % (len(seq),
+                                                 len(self.seq)))
+            cur = int(state["cur"])
+            limit = len(seq if seq is not None else self.seq)
+            if not 0 <= cur <= limit:
+                raise MXNetError("image cursor %d out of range [0, %d]"
+                                 % (cur, limit))
+            if seq is not None:
+                self.seq = list(seq)
+            self.cur = cur
+        else:
+            self.imgrec.restore(state["rec"])
+            self.cur = int(state["cur"])
+        self._epochs = int(state["epoch"])
+
     def next_sample(self):
         """Read + decode one sample."""
         if self.seq is not None:

@@ -114,11 +114,37 @@ class DataIter:
         ``{"epoch", "shard", "num_shards", "offset", "resyncs"}``, any
         subset — or None when the iterator tracks nothing.  Rides each
         sampled step's telemetry JSONL record and the checkpoint
-        manifest's ``data_position`` meta; wrappers delegate to their
-        inner iterator (prefetchers run AHEAD of the consumer by their
-        queue depth, so a wrapped position is the producer's, not the
-        trainer's — advisory, never used for control flow)."""
+        manifest meta.  Wrappers MUST report the next-UNDELIVERED
+        sample, not the inner reader's read-ahead position: a
+        prefetcher holding staged-but-undelivered batches reports the
+        position captured BEFORE those batches were fetched (the
+        ``restore(state()) => identical remaining stream`` contract
+        depends on it)."""
         return None
+
+    def state(self):
+        """Durable iterator state (``mxnet_tpu.io_resume``): a
+        JSON-able versioned dict ``{"v", "kind", ...}`` describing the
+        next-undelivered sample, or None when this iterator declares no
+        durable state.  ``restore(state())`` into a compatible iterator
+        must reproduce the identical remaining sample stream.  Wrappers
+        delegate inward, compensating for any prefetched-but-
+        undelivered batches they hold."""
+        return None
+
+    def restore(self, state):
+        """Restore a ``state()`` dict.  Validate-then-commit: a
+        rejected or failing restore must leave the iterator restartable
+        from the same state (the ``io.resume`` chaos seam in
+        ``io_resume.restore_iterator`` tests exactly that).  The base
+        accepts only None (nothing to restore)."""
+        if state is None:
+            return
+        raise MXNetError(
+            "%s declares no durable state and cannot restore %r — "
+            "resume with the iterator class that produced the state"
+            % (type(self).__name__, state.get("kind")
+               if isinstance(state, dict) else state))
 
 
 class ResizeIter(DataIter):
@@ -174,6 +200,37 @@ class ResizeIter(DataIter):
     def position(self):
         return self.data_iter.position()
 
+    def state(self):
+        from . import io_resume
+        return {"v": io_resume.STATE_VERSION, "kind": "resize",
+                "cur": self.cur, "inner": self.data_iter.state()}
+
+    def restore(self, state):
+        from . import io_resume
+        io_resume.check_state(state, "resize")
+        cur = int(state["cur"])
+        if not 0 <= cur <= self.size:
+            raise MXNetError("resize cursor %d out of range [0, %d]"
+                             % (cur, self.size))
+        # inner first (it validates its own state), cursor commits last
+        self.data_iter.restore(state["inner"])
+        self.cur = cur
+        self.current_batch = None
+
+
+def _safe_state(it):
+    """``it.state()`` when the duck-type fits, else None (raw values
+    that are not dicts are advisory noise, not durable state)."""
+    fn = getattr(it, "state", None)
+    st = fn() if callable(fn) else None
+    return st if isinstance(st, dict) else None
+
+
+def _safe_position(it):
+    fn = getattr(it, "position", None)
+    pos = fn() if callable(fn) else None
+    return pos if isinstance(pos, dict) else None
+
 
 class PrefetchingIter(DataIter):
     """Thread-prefetch over one or more iterators (reference io.py:319;
@@ -197,6 +254,12 @@ class PrefetchingIter(DataIter):
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
         self.prefetch_errors = [None for _ in range(self.n_iter)]
+        # inner state/position captured BEFORE each fetch: while the
+        # fetched batch is staged-but-undelivered, the wrapper's
+        # state()/position() must describe that batch (the next
+        # UNDELIVERED sample), not the reader's read-ahead point
+        self.next_state = [None for _ in range(self.n_iter)]
+        self.next_position = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -210,6 +273,12 @@ class PrefetchingIter(DataIter):
                                      time.perf_counter() - t_wait)
                 if not self.started:
                     break
+                try:
+                    self.next_state[i] = _safe_state(self.iters[i])
+                    self.next_position[i] = _safe_position(self.iters[i])
+                except Exception:  # mxlint: allow-broad-except(advisory capture from arbitrary user iterators must not kill the producer thread and hang the consumer on data_ready)
+                    self.next_state[i] = None
+                    self.next_position[i] = None
                 try:
                     # the io.prefetch fault seam: injected faults retry
                     # with backoff (transient-read semantics); a real —
@@ -285,6 +354,11 @@ class PrefetchingIter(DataIter):
             e.wait()
         for i in self.iters:
             i.reset()
+        for i in range(self.n_iter):
+            # pre-fetch captures are from the finished epoch; position()
+            # falls back to the live inner until the first new fetch
+            self.next_state[i] = None
+            self.next_position[i] = None
         for e in self.data_ready:
             e.clear()
         for e in self.data_taken:
@@ -308,6 +382,8 @@ class PrefetchingIter(DataIter):
             # silently mismatched data/labels for the rest of the epoch)
             for i in range(self.n_iter):
                 self.prefetch_errors[i] = None
+                self.next_state[i] = None
+                self.next_position[i] = None
                 self.data_ready[i].clear()
                 self.data_taken[i].set()
             raise errs[0]
@@ -326,6 +402,13 @@ class PrefetchingIter(DataIter):
             provide_label=self.provide_label)
         for e in self.data_ready:
             e.clear()
+        # the captures described the batch just taken; until the
+        # producer re-captures, the live inner position IS the next
+        # undelivered sample.  Nulled BEFORE data_taken re-arms the
+        # producer, so a fresh capture is never clobbered
+        for i in range(self.n_iter):
+            self.next_state[i] = None
+            self.next_position[i] = None
         for e in self.data_taken:
             e.set()
         _ioview.queue_tracker("host").set_depth(0)
@@ -349,10 +432,58 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
     def position(self):
-        """The FIRST wrapped iterator's position (composite iterators
-        advance in lockstep), advisory: the producer thread runs one
-        batch ahead of the consumer."""
-        return self.iters[0].position()
+        """Position of the next-UNDELIVERED batch: the first wrapped
+        iterator's position captured BEFORE the staged (or in-flight)
+        fetch — the producer thread runs one batch ahead of the
+        consumer, so the live inner position would over-report by that
+        batch.  Falls back to the live inner position before the first
+        fetch of an epoch (nothing is staged then)."""
+        pos = self.next_position[0]
+        return pos if pos is not None else self.iters[0].position()
+
+    def state(self):
+        """Durable state of the next-undelivered batch: the inner
+        state(s) captured before the staged fetch.  Quiesces first
+        (waits for the producers to finish staging, like ``reset``), so
+        the captures are stable."""
+        from . import io_resume
+        for e in self.data_ready:
+            e.wait()
+        if self.n_iter == 1:
+            return self.next_state[0]
+        return {"v": io_resume.STATE_VERSION, "kind": "prefetch",
+                "inner": list(self.next_state)}
+
+    def restore(self, state):
+        """Restore the wrapped iterator(s) and discard any staged
+        batch (it belongs to the abandoned stream).  The producer
+        threads then refetch from the restored state."""
+        from . import io_resume
+        if state is None:
+            return
+        if self.n_iter == 1:
+            states = [state]
+        else:
+            io_resume.check_state(state, "prefetch")
+            states = list(state["inner"])
+            if len(states) != self.n_iter:
+                raise MXNetError(
+                    "prefetch state has %d inner entries, wrapper has "
+                    "%d iterators" % (len(states), self.n_iter))
+        for e in self.data_ready:
+            e.wait()                 # quiesce: producers are parked
+        for it, st in zip(self.iters, states):
+            it.restore(st)           # each tier validates-then-commits
+        for i in range(self.n_iter):
+            self.prefetch_errors[i] = None
+            self.next_state[i] = None
+            self.next_position[i] = None
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        # the staged composite (if any) was discarded above
+        _ioview.queue_tracker("host").set_depth(0)
 
 
 def _init_data(data, allow_empty, default_name):
@@ -424,7 +555,30 @@ class DevicePrefetchIter:
         self._thread = None
         self._stop = False
         self._exhausted = False
+        # (state, position) of the inner iterator captured BEFORE each
+        # fetched-but-undelivered batch, oldest first: the wrapper's
+        # state()/position() report pending[0] — the next UNDELIVERED
+        # sample — never the inner reader's read-ahead point
+        from collections import deque
+        self._pending = deque()
+        self._plock = threading.Lock()
         self._start()
+
+    def depth(self):
+        """Current staging-queue depth bound (a backpressure knob)."""
+        return self._depth
+
+    def set_depth(self, depth):
+        """Retune the staging depth at runtime — the backpressure
+        controller's actuator (io_resume.BackpressureController).
+        Raising it lets the worker run further ahead; lowering it takes
+        effect as the consumer drains below the new bound (staged
+        batches are never discarded)."""
+        depth = max(1, int(depth))
+        with self._queue.mutex:
+            self._queue.maxsize = depth
+            self._queue.not_full.notify_all()
+        self._depth = depth
 
     def _to_host_dict(self, batch):
         out = {}
@@ -484,9 +638,26 @@ class DevicePrefetchIter:
                 "MXNET_TPU_OVERLAP", "1") in ("0", "false", "False") \
                 else 1
             try:
-                for batch in self._it:
+                src = iter(self._it)
+                while True:
                     if self._stop:
                         return
+                    # speculative pre-capture: every fetched-but-
+                    # undelivered batch must have its BEFORE-state on
+                    # the pending deque while it is in flight, or a
+                    # state() read during the fetch would skip it; the
+                    # entry is popped right back off when the fetch
+                    # turns out to be the end of the epoch
+                    pre = (_safe_state(self._it),
+                           _safe_position(self._it))
+                    with self._plock:
+                        self._pending.append(pre)
+                    try:
+                        batch = next(src)
+                    except StopIteration:
+                        with self._plock:
+                            self._pending.pop()
+                        break
                     # opportunistic flush: hand over anything the
                     # consumer made room for, without blocking
                     while held and self._try_put(held[0]):
@@ -571,22 +742,55 @@ class DevicePrefetchIter:
         # only staged items count toward occupancy (end/error control
         # messages were never tracked in)
         _ioview.queue_tracker("device").adjust(-1)
+        with self._plock:
+            if self._pending:
+                self._pending.popleft()
         return val
 
     next = __next__
 
     def position(self):
-        """The wrapped iterator's position — advisory: the worker runs
-        up to ``depth`` staged batches ahead of the consumer."""
+        """Position of the next-UNDELIVERED batch: the inner position
+        captured before the oldest staged (or in-flight) batch — the
+        worker runs up to ``depth``+held batches ahead of the consumer,
+        so the live inner position would over-report by that much.
+        Falls back to the live inner position when nothing is staged."""
+        with self._plock:
+            if self._pending:
+                return self._pending[0][1]
         return self._it.position() if hasattr(self._it, "position") \
             else None
 
-    def reset(self):
-        """Cancel the worker (at most ``depth`` staged batches are
-        discarded — a mid-epoch reset must not decode the rest of the
-        epoch), rewind the wrapped iterator, restart.  A worker error
-        that the consumer never saw is re-raised here rather than
-        silently dropped."""
+    def state(self):
+        """Durable state of the next-undelivered batch (pending[0]'s
+        pre-fetch capture), compensating for every staged batch the
+        worker ran ahead."""
+        with self._plock:
+            if self._pending:
+                return self._pending[0][0]
+        return _safe_state(self._it)
+
+    def restore(self, state):
+        """Cancel the worker, discard staged batches (they belong to
+        the abandoned stream — a stale worker error goes with them),
+        restore the wrapped iterator, restart.  The inner restore
+        validates-then-commits, so a failure here leaves the wrapped
+        iterator restorable from the same state (the worker is simply
+        stopped; a follow-up restore or reset revives it)."""
+        if state is None:
+            return
+        self._cancel_worker()
+        if not callable(getattr(self._it, "restore", None)):
+            raise MXNetError(
+                "%s wraps %s, which has no restore()"
+                % (type(self).__name__, type(self._it).__name__))
+        self._it.restore(state)
+        self._exhausted = False
+        self._start()
+
+    def _cancel_worker(self):
+        """Stop the worker and drain the queue (staged batches are
+        discarded); returns a worker error the consumer never saw."""
         import queue as _queue
         self._stop = True
         pending_error = None
@@ -598,7 +802,18 @@ class DevicePrefetchIter:
             except _queue.Empty:
                 pass
         self._thread.join()
+        with self._plock:
+            self._pending.clear()
         _ioview.queue_tracker("device").set_depth(0)
+        return pending_error
+
+    def reset(self):
+        """Cancel the worker (at most ``depth`` staged batches are
+        discarded — a mid-epoch reset must not decode the rest of the
+        epoch), rewind the wrapped iterator, restart.  A worker error
+        that the consumer never saw is re-raised here rather than
+        silently dropped."""
+        pending_error = self._cancel_worker()
         if pending_error is not None:
             self._exhausted = True
             raise pending_error
@@ -670,6 +885,32 @@ class NDArrayIter(DataIter):
         return {"epoch": self._epochs,
                 "offset": int(min(max(0, self.cursor + self.batch_size),
                                   self.num_data))}
+
+    def state(self):
+        """Durable state.  NOTE: a ``shuffle=True`` iterator permutes
+        ONCE at construction from the global numpy RNG — an exact
+        restore into a fresh process requires seeding ``np.random``
+        identically before reconstructing (the order is part of the
+        arrays, not of this state)."""
+        from . import io_resume
+        pos = self.position()
+        return {"v": io_resume.STATE_VERSION, "kind": "ndarray",
+                "epoch": pos["epoch"], "offset": pos["offset"],
+                "num_data": int(self.num_data)}
+
+    def restore(self, state):
+        from . import io_resume
+        io_resume.check_state(state, "ndarray")
+        if int(state["num_data"]) != int(self.num_data):
+            raise MXNetError(
+                "ndarray state is for %s samples, iterator has %d"
+                % (state["num_data"], self.num_data))
+        offset = int(state["offset"])
+        if not 0 <= offset <= self.num_data:
+            raise MXNetError("ndarray offset %d out of range [0, %d]"
+                             % (offset, self.num_data))
+        self._epochs = int(state["epoch"])
+        self.cursor = offset - self.batch_size
 
     def iter_next(self):
         self.cursor += self.batch_size
@@ -765,6 +1006,12 @@ class MNISTIter(DataIter):
         pos.update(shard=self._part_index, num_shards=self._num_parts)
         return pos
 
+    def state(self):
+        return self._inner.state()
+
+    def restore(self, state):
+        self._inner.restore(state)
+
 
 class CSVIter(DataIter):
     """CSV reader (reference src/io/iter_csv.cc)."""
@@ -807,6 +1054,12 @@ class CSVIter(DataIter):
 
     def position(self):
         return self._inner.position()
+
+    def state(self):
+        return self._inner.state()
+
+    def restore(self, state):
+        self._inner.restore(state)
 
 
 def ImageRecordIter(*args, **kwargs):

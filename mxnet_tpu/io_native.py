@@ -164,10 +164,47 @@ class NativeRecordIOReader:
             return bytes(bytearray(self._buf[:n]))
 
     def position(self):
-        """Advisory reader position (records read; the native thread
-        prefetches ahead of these consumer-side reads)."""
+        """Advisory reader position (records read by the CONSUMER — the
+        native thread's read-ahead never shows here, so this is already
+        the next-undelivered record)."""
         return {"offset": self.records_read,
                 "bad_records": self.bad_records}
+
+    def state(self):
+        from . import io_resume
+        return {"v": io_resume.STATE_VERSION, "kind": "native_recordio",
+                "offset": self.records_read}
+
+    def restore(self, state):
+        """Recreate the native handle and skip forward ``offset``
+        records (the native reader is sequential — no byte-seek ABI).
+        Validate-then-commit: the skip runs on a fresh handle and the
+        old one is only replaced when the cursor landed."""
+        from . import io_resume
+        from .base import MXNetError
+        io_resume.check_state(state, "native_recordio")
+        offset = int(state["offset"])
+        if offset < 0:
+            raise MXNetError("native recordio offset %d < 0" % offset)
+        handle = self._lib.MXTPURecordIOReaderCreate(
+            self._path.encode(), 64)
+        if not handle:
+            raise MXNetError("cannot reopen %s for restore" % self._path)
+        try:
+            for i in range(offset):
+                n = self._lib.MXTPURecordIOReaderNext(
+                    handle, self._buf, self._max_record)
+                if n == 0:
+                    raise MXNetError(
+                        "%s has only %d records; state expects >= %d — "
+                        "the file shrank since the checkpoint"
+                        % (self._path, i, offset))
+        except BaseException:  # mxlint: allow-broad-except(frees the native reader handle before re-raising — the open iterator is left untouched)
+            self._lib.MXTPURecordIOReaderFree(handle)
+            raise
+        self.close()
+        self._handle = handle
+        self.records_read = offset
 
     def read_float_batch(self, batch, record_floats):
         """Parse ``batch`` records of IRHeader+float32 payload into
@@ -286,9 +323,57 @@ class ImageRecordIter:
     def position(self):
         """{"epoch", "shard", "num_shards", "offset"} — records consumed
         by the python side (the native decoder threads run ahead of
-        this; advisory, see ``telemetry.ioview``)."""
+        this, but only CONSUMED records count: this is already the
+        next-undelivered offset; see ``telemetry.ioview``)."""
         return {"epoch": self._epoch, "shard": self._part_index,
                 "num_shards": self._num_parts, "offset": self._consumed}
+
+    def state(self):
+        from . import io_resume
+        return {"v": io_resume.STATE_VERSION, "kind": "image_record",
+                "epoch": self._epoch, "shard": self._part_index,
+                "num_shards": self._num_parts,
+                "offset": int(self._consumed)}
+
+    def restore(self, state):
+        """Reopen the native pipeline at the recorded epoch (the seed
+        is derived from seed+epoch, so shuffle/augment order reproduces
+        exactly) and skip forward to the recorded offset.  The skip
+        requests exactly the missing record counts, so offsets off a
+        batch boundary restore exactly too."""
+        from . import io_resume
+        from .base import MXNetError
+        io_resume.check_state(state, "image_record")
+        if int(state["shard"]) != self._part_index or \
+                int(state["num_shards"]) != self._num_parts:
+            raise MXNetError(
+                "image_record state is for shard %s/%s, iterator is "
+                "%d/%d — elastic resharding of the native pipeline is "
+                "not supported (use ShardedLedgerIter for elastic "
+                "resume)" % (state["shard"], state["num_shards"],
+                             self._part_index, self._num_parts))
+        offset = int(state["offset"])
+        if offset < 0:
+            raise MXNetError("image_record offset %d < 0" % offset)
+        self._epoch = int(state["epoch"])
+        self._consumed = 0
+        self._open()
+        import ctypes as ct
+        h, w = self.data_shape[1], self.data_shape[2]
+        labels = np.zeros(self.batch_size, np.float32)
+        raw = np.zeros((self.batch_size, h, w, 3), np.uint8)
+        while self._consumed < offset:
+            want = min(self.batch_size, offset - self._consumed)
+            n = self._lib.MXTPUImagePipelineNextBatch(
+                self._handle,
+                labels.ctypes.data_as(ct.POINTER(ct.c_float)),
+                raw.ctypes.data_as(ct.POINTER(ct.c_uint8)), want)
+            if n <= 0:
+                raise MXNetError(
+                    "%s: epoch has only %d records in this shard; "
+                    "state expects >= %d — the file shrank since the "
+                    "checkpoint" % (self._path, self._consumed, offset))
+            self._consumed += int(n)
 
     def next(self):
         from .io import DataBatch

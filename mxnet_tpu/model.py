@@ -156,14 +156,23 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     resilience.atomic_write(param_name,
                             lambda tmp: nd.save(tmp, save_dict),
                             fault_site="checkpoint.save")
-    # manifest meta carries the ADVISORY iterator position of the run's
-    # tracked data iterator (telemetry.ioview.track) — the observability
-    # half of mid-epoch resume; loaders that predate the key ignore it
+    # manifest meta carries the tracked data iterator's advisory
+    # position AND (schema v1 data_state, mxnet_tpu.io_resume) its
+    # durable state: load_checkpoint stashes the entry and fit()
+    # restores it, so a mid-epoch resume lands on the exact next
+    # sample; loaders that predate either key ignore it
     from .telemetry import ioview
+    from . import io_resume
+    meta = {}
     pos = ioview.current_position()
+    if pos is not None:
+        meta["data_position"] = pos
+    entry = io_resume.data_state_entry()
+    if entry is not None:
+        meta["data_state"] = entry
     resilience.write_manifest(
         prefix, epoch, [param_name], arrays=save_dict,
-        meta={"data_position": pos} if pos is not None else None)
+        meta=meta or None)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
@@ -179,7 +188,14 @@ def load_checkpoint(prefix, epoch):
     resilience.fault_point("checkpoint.load")
     sym_name = "%s-symbol.json" % prefix
     param_name = "%s-%04d.params" % (prefix, epoch)
-    resilience.verify_manifest(prefix, epoch)
+    manifest = resilience.verify_manifest(prefix, epoch)
+    if manifest is not None:
+        # stash any durable data-iterator state for the next fit() to
+        # restore (mxnet_tpu.io_resume): mid-epoch resume, exact sample
+        from . import io_resume
+        io_resume.note_loaded_state(
+            (manifest.get("meta") or {}).get("data_state"),
+            source="%s epoch %d" % (prefix, epoch))
     try:
         symbol = sym.load(sym_name)
     except FileNotFoundError as e:

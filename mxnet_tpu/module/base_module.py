@@ -185,11 +185,21 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
 
         from .. import telemetry
+        from .. import io_resume
         fetch_span = telemetry.span("data.fetch", category="io")
         # data-plane observability (telemetry.ioview): the training
-        # iterator's position() rides sampled step records and
-        # checkpoint manifests for the rest of the run
+        # iterator's position() AND durable state() ride sampled step
+        # records and checkpoint manifests for the rest of the run
         telemetry.ioview.track(train_data)
+        # mid-epoch resume (mxnet_tpu.io_resume): a checkpoint loaded
+        # before this fit may have stashed the iterator's durable
+        # state — restoring it here puts the FIRST epoch of the loop at
+        # the exact next sample instead of replaying from sample zero
+        io_resume.apply_pending(train_data)
+        # backpressure actuation (MXNET_TPU_BACKPRESSURE): the
+        # controller reads the ioview bottleneck verdict each batch and
+        # retunes pipeline knobs (device prefetch depth) with hysteresis
+        backpressure = io_resume.maybe_controller(train_data)
 
         for epoch in range(begin_epoch, num_epoch):
             started = time.time()
@@ -221,6 +231,8 @@ class BaseModule:
                 telemetry.step_end(
                     samples=_batch_samples(batch, train_data),
                     step_time=time.perf_counter() - step_t0)
+                if backpressure is not None:
+                    backpressure.tick()
                 nbatch += 1
 
             for name, val in eval_metric.get_name_value():

@@ -280,6 +280,17 @@ def manifest_mesh(manifest):
     return mesh if isinstance(mesh, dict) else None
 
 
+def manifest_data_state(manifest):
+    """The durable data-iterator state entry a checkpoint manifest
+    carries (``meta.data_state``, written by ``io_resume``), or None for
+    manifests saved without one — loading such checkpoints simply skips
+    the mid-epoch data resume."""
+    if not isinstance(manifest, dict):
+        return None
+    entry = (manifest.get("meta") or {}).get("data_state")
+    return entry if isinstance(entry, dict) else None
+
+
 def same_mesh(a, b):
     """True when two descriptors name the same device grid (size-1 axes
     ignored — ``{data:4, model:1}`` == ``{data:4}`` == 4 devices on one

@@ -2163,9 +2163,18 @@ class ShardedTrainer:
             # recorded half of mid-epoch resume (restore comes later)
             meta = {"mesh": self.mesh_descriptor()}
             from ..telemetry import ioview as _iov
+            from .. import io_resume as _ior
             pos = _iov.current_position()
             if pos is not None:
                 meta["data_position"] = pos
+            # data_state (mxnet_tpu.io_resume) is the RESTORED half:
+            # the tracked iterator's durable state, consumed by
+            # load_checkpoint -> restore_data_iter/fit.  Rank 0's
+            # iterator describes the fleet under the lockstep SPMD
+            # contract (ledger states remap per rank on load)
+            entry = _ior.data_state_entry()
+            if entry is not None:
+                meta["data_state"] = entry
             resilience.write_manifest(prefix, epoch, files, arrays=arrays,
                                       meta=meta)
         if self._multiproc:
@@ -2360,6 +2369,16 @@ class ShardedTrainer:
         # meta handling above also restored begin_num_update)
         self._resume_epoch = int(epoch)
         self._step_count = 0
+        # stash the durable data-iterator state for restore_data_iter /
+        # fit to consume (mxnet_tpu.io_resume): model state and data
+        # cursor resume from the SAME checkpoint, so a SIGKILL mid-epoch
+        # replays no sample and drops none — across a world-size change
+        # the ledger state re-cuts per rank (io.remap)
+        if manifest is not None:
+            from .. import io_resume as _ior
+            _ior.note_loaded_state(
+                _reshard.manifest_data_state(manifest),
+                source="%s epoch %d" % (prefix, epoch))
 
     def load_latest_checkpoint(self, prefix, load_optimizer_states=False):
         """Restore from the NEWEST complete checkpoint under ``prefix``,
@@ -2382,6 +2401,20 @@ class ShardedTrainer:
                 logging.warning("falling back past checkpoint epoch %d "
                                 "of %r: %s", ep, prefix, e)
         return None
+
+    def restore_data_iter(self, it):
+        """Restore ``it`` from the ``data_state`` entry the last
+        :meth:`load_checkpoint` found (``mxnet_tpu.io_resume``), and
+        register it as the run's tracked iterator so subsequent
+        checkpoints carry ITS state.  Returns the consumed manifest
+        entry, or None when the checkpoint carried no durable state.
+        A restore fault (the ``io.resume`` seam) propagates with the
+        entry still pending — retry with the same iterator after
+        clearing the fault."""
+        from .. import io_resume as _ior
+        from ..telemetry import ioview as _iov
+        _iov.track(it)
+        return _ior.apply_pending(it)
 
     def install_preemption_handler(self, prefix, save_optimizer_states=True,
                                    signals=None, exit_process=True):

@@ -18,6 +18,7 @@ from collections import namedtuple
 
 import numpy as np
 
+from .base import MXNetError
 from . import telemetry
 from .telemetry import ioview as _ioview
 
@@ -119,6 +120,35 @@ class MXRecordIO:
         except (OSError, ValueError, AttributeError):
             pass
         return pos
+
+    def state(self):
+        """Durable reader state (``mxnet_tpu.io_resume`` contract):
+        epoch, records read, and the exact byte offset of the next
+        unread record."""
+        if self.writable:
+            return None
+        from . import io_resume
+        return {"v": io_resume.STATE_VERSION, "kind": "recordio",
+                "epoch": self._epochs, "offset": self.records_read,
+                "byte": int(self.fid.tell())}
+
+    def restore(self, state):
+        """Reopen at the recorded byte offset (validate-then-commit: a
+        rejected state leaves the open reader untouched)."""
+        from . import io_resume
+        io_resume.check_state(state, "recordio")
+        if self.writable:
+            raise MXNetError("cannot restore a writable MXRecordIO")
+        byte = int(state["byte"])
+        if byte < 0 or byte % 4 != 0:
+            raise MXNetError(
+                "recordio byte offset %d is not a 4-aligned record "
+                "boundary in %s" % (byte, self.uri))
+        self.close()
+        self.open()
+        self.fid.seek(byte)
+        self._epochs = int(state["epoch"])
+        self.records_read = int(state["offset"])
 
     def tell(self):
         return self.fid.tell()

@@ -69,6 +69,11 @@ KNOWN_SITES = (
     # per-image decode seam (image.imdecode): kind=delay seeds a slow
     # decode stage for ioview bottleneck-attribution drills
     "io.decode",
+    # durable data-iterator restore (io_resume.restore_iterator): fires
+    # BEFORE any iterator mutation, so an injected fault leaves the
+    # iterator restartable from the very same state; io.remap fires in
+    # the elastic cursor re-cut (io_resume.remap_state) the same way
+    "io.resume", "io.remap",
     "trainer.step",
     # bucketed gradient allreduce (parallel/overlap.py,
     # docs/api/overlap.md): fires at every bucket launch — arming it

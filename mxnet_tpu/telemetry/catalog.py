@@ -95,6 +95,25 @@ CATALOG = {
                                   "producer-bound, consumer when the "
                                   "training loop binds, balanced "
                                   "otherwise)"),
+    "mxtpu_data_resume_total": (COUNTER, (),
+                                "durable data-iterator restores from a "
+                                "checkpoint manifest data_state entry "
+                                "(io_resume.restore_iterator — mid-"
+                                "epoch resume landed at the exact next "
+                                "sample)"),
+    "mxtpu_data_remap_samples": (GAUGE, (),
+                                 "globally-consumed samples carried "
+                                 "through the last elastic cursor "
+                                 "remap (io_resume.remap_state: the "
+                                 "permutation prefix re-cut for the "
+                                 "new world size)"),
+    "mxtpu_backpressure_adjust_total": (COUNTER, ("knob", "direction"),
+                                        "runtime pipeline-knob moves by "
+                                        "the backpressure controller "
+                                        "(io_resume."
+                                        "BackpressureController: "
+                                        "direction=raise|lower per "
+                                        "registered knob)"),
     # -------------------------------------------------------- kvstore
     "mxtpu_kvstore_push_bytes_total": (COUNTER, ("store",),
                                        "gradient bytes pushed "

@@ -467,9 +467,10 @@ class RunAggregator:
     def feed(self, r, rec):
         """Ingest one parsed JSONL record from rank ``r``.  Step records
         aggregate; worker EVENT records (``telemetry.jsonl_event`` —
-        reshard / rank_join / rank_leave breadcrumbs) pass through into
-        the timeline with the rank attached; anything else is
-        ignored."""
+        reshard / rank_join / rank_leave and the data-plane
+        data_resume / data_remap / backpressure_adjust breadcrumbs)
+        pass through into the timeline with the rank attached;
+        anything else is ignored."""
         step = rec.get("step")
         if not isinstance(step, (int, float)):
             if isinstance(rec.get("event"), str):

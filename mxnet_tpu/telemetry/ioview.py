@@ -342,6 +342,34 @@ def current_position():
     return pos if isinstance(pos, dict) else None
 
 
+def current_state():
+    """The tracked iterator's durable ``state()`` dict
+    (``mxnet_tpu.io_resume`` contract), or None when no iterator is
+    tracked or it declares no durable state.  Never raises: like
+    position, capture at checkpoint time is best-effort — restore-side
+    validation is where strictness lives."""
+    ref = _pos_ref[0]
+    it = ref() if ref is not None else None
+    if it is None:
+        return None
+    fn = getattr(it, "state", None)
+    if not callable(fn):
+        return None
+    try:
+        st = fn()
+    except Exception:  # mxlint: allow-broad-except(advisory state capture from arbitrary user iterators must never kill the checkpoint save that asked for it)
+        return None
+    return st if isinstance(st, dict) else None
+
+
+def tracked_iterator():
+    """The live tracked iterator object, or None — the restore side of
+    the loop (``io_resume.apply_pending``) needs the object itself, not
+    just its state."""
+    ref = _pos_ref[0]
+    return ref() if ref is not None else None
+
+
 # --------------------------------------------------- bottleneck classifier
 
 def _totals_locked():

@@ -52,11 +52,18 @@ def test_toy_nce_auc():
 def test_stochastic_depth_trains():
     import mxnet_tpu as mx
     import sd_mnist
-    # the stochastic gates make per-run accuracy noisy (0.82-0.99 over
-    # seeds); pin the RNGs and assert well above the 0.1 chance level
+    # pin the RNGs, but note the pinned trajectory is still chaotic:
+    # the stochastic gates + momentum amplify reduction-order rounding
+    # differences, so at 10 epochs the SAME seed lands anywhere in
+    # 0.65-0.83 depending on the XLA host-device/thread partition
+    # (conftest forces an 8-device CPU platform; a plain 1-device run
+    # scores 0.82 where the suite scored 0.65).  By 20 epochs training
+    # has converged through that transition on every measured
+    # partition (>= 0.94), so assert there instead of tuning the bar
+    # to one environment's rounding.
     mx.random.seed(42)
     np.random.seed(42)
-    acc = sd_mnist.train(epochs=10, batch_size=100, num_blocks=2)
+    acc = sd_mnist.train(epochs=20, batch_size=100, num_blocks=2)
     assert acc > 0.75, acc
 
 

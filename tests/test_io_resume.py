@@ -1,0 +1,570 @@
+"""Exactly-once data plane (mxnet_tpu/io_resume.py): durable iterator
+state, elastic cursor remap, and backpressure actuation (ISSUE 16).
+
+The spine of the file is one parametrized contract test — for EVERY
+iterator class in the stack, ``restore(state())`` on a fresh instance
+must reproduce the identical remaining sample stream — plus the
+accounting harness that PROVES the no-drop/no-double remap invariant,
+chaos tests for the ``io.resume``/``io.remap`` seams, and the
+backpressure controller's hysteresis.
+"""
+import io as _io
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io_resume as ior
+from mxnet_tpu import resilience as R
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry import ioview
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    R.clear_faults()
+    ior.clear_pending()
+    ioview.reset()
+    yield
+    R.clear_faults()
+    ior.clear_pending()
+    ioview.reset()
+
+
+def _pil_ok():
+    try:
+        import PIL  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _native_ok():
+    from mxnet_tpu import io_native
+    return io_native.available() and io_native.jpeg_available()
+
+
+# ------------------------------------------------------------ fingerprints
+
+def _fingerprint(batch):
+    """Order-sensitive content fingerprint of one delivered batch."""
+    if isinstance(batch, dict):          # DevicePrefetchIter host dicts
+        return tuple(
+            (k, float(np.asarray(batch[k], np.float64).sum()))
+            for k in sorted(batch))
+    data = tuple(float(np.asarray(a.asnumpy(), np.float64).sum())
+                 for a in batch.data)
+    label = tuple(float(np.asarray(a.asnumpy(), np.float64).sum())
+                  for a in (batch.label or []))
+    return (data, label, int(getattr(batch, "pad", 0) or 0))
+
+
+def _drain(it):
+    return [_fingerprint(b) for b in it]
+
+
+# ----------------------------------------------------- iterator factories
+#
+# Each factory returns a zero-arg builder for a FRESH, identically
+# configured iterator (the restore target must be reconstructible from
+# configuration alone — that is the contract the checkpoint path needs).
+
+def _nd_builder(tmp):
+    data = np.arange(54, dtype=np.float32).reshape(27, 2)
+    label = np.arange(27, dtype=np.float32)
+    return lambda: mx.io.NDArrayIter(data, label, batch_size=4)
+
+
+def _nd_discard_builder(tmp):
+    data = np.arange(54, dtype=np.float32).reshape(27, 2)
+    return lambda: mx.io.NDArrayIter(data, np.arange(27), batch_size=4,
+                                     last_batch_handle="discard")
+
+
+def _resize_builder(tmp):
+    data = np.arange(80, dtype=np.float32).reshape(40, 2)
+    return lambda: mx.io.ResizeIter(
+        mx.io.NDArrayIter(data, np.arange(40), batch_size=4), size=6)
+
+
+def _prefetch_builder(tmp):
+    data = np.arange(80, dtype=np.float32).reshape(40, 2)
+    return lambda: mx.io.PrefetchingIter(
+        mx.io.NDArrayIter(data, np.arange(40), batch_size=4))
+
+
+def _device_prefetch_builder(tmp):
+    data = np.arange(80, dtype=np.float32).reshape(40, 2)
+    return lambda: mx.io.DevicePrefetchIter(
+        mx.io.NDArrayIter(data, np.arange(40), batch_size=4),
+        lambda host: host, depth=2)
+
+
+def _csv_builder(tmp):
+    rng = np.random.RandomState(5)
+    data = rng.rand(23, 3).astype(np.float32)
+    dpath = os.path.join(tmp, "d.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    return lambda: mx.io.CSVIter(data_csv=dpath, data_shape=(3,),
+                                 batch_size=4)
+
+
+def _mnist_builder(tmp):
+    rng = np.random.RandomState(7)
+    n = 26
+    imgs = rng.randint(0, 255, (n, 6, 6), dtype=np.uint8)
+    labs = rng.randint(0, 10, (n,)).astype(np.uint8)
+    ipath = os.path.join(tmp, "imgs-idx3-ubyte")
+    lpath = os.path.join(tmp, "labs-idx1-ubyte")
+    with open(ipath, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 6, 6) + imgs.tobytes())
+    with open(lpath, "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labs.tobytes())
+    return lambda: mx.io.MNISTIter(image=ipath, label=lpath,
+                                   batch_size=4, shuffle=True, seed=3)
+
+
+def _write_jpeg_rec(path, n=10, size=8):
+    from PIL import Image
+    w = mx.recordio.MXRecordIO(str(path), "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=95)
+        w.write(mx.recordio.pack(
+            mx.recordio.IRHeader(0, float(i), i, 0), buf.getvalue()))
+    w.close()
+    return str(path)
+
+
+def _image_iter_builder(tmp):
+    rec = _write_jpeg_rec(os.path.join(tmp, "t.rec"), n=10)
+    return lambda: mx.image.ImageIter(batch_size=3, data_shape=(3, 8, 8),
+                                      path_imgrec=rec)
+
+
+def _image_record_builder(tmp):
+    rec = _write_jpeg_rec(os.path.join(tmp, "t.rec"), n=10)
+    return lambda: mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 8, 8), batch_size=3,
+        preprocess_threads=1)
+
+
+def _ledger_builder(tmp):
+    data = np.arange(58, dtype=np.float32).reshape(29, 2)
+    return lambda: ior.ShardedLedgerIter(data, np.arange(29),
+                                         batch_size=4, seed=2,
+                                         rank=0, world=2)
+
+
+_CASES = {
+    "ndarray": (_nd_builder, None),
+    "ndarray_discard": (_nd_discard_builder, None),
+    "resize": (_resize_builder, None),
+    "prefetch": (_prefetch_builder, None),
+    "device_prefetch": (_device_prefetch_builder, None),
+    "csv": (_csv_builder, None),
+    "mnist": (_mnist_builder, None),
+    "image": (_image_iter_builder, "pil"),
+    "image_record": (_image_record_builder, "native"),
+    "ledger": (_ledger_builder, None),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_CASES))
+@pytest.mark.parametrize("consume", [0, 1, 3])
+def test_restore_reproduces_remaining_stream(case, consume, tmp_path):
+    """THE durable-state contract: for every iterator class, restoring
+    ``state()`` into a fresh instance yields the identical remaining
+    sample stream — including mid-epoch states with prefetched-but-
+    undelivered batches in flight."""
+    builder, needs = _CASES[case]
+    if needs == "pil" and (not _pil_ok() or mx.image is None):
+        pytest.skip("PIL unavailable")
+    if needs == "native" and not _native_ok():
+        pytest.skip("no native JPEG pipeline")
+    build = builder(str(tmp_path))
+
+    it = build()
+    for _ in range(consume):
+        next(it)
+    st = it.state()
+    assert st is None or isinstance(st, dict)
+    if isinstance(st, dict):
+        assert st.get("v") == ior.STATE_VERSION and "kind" in st
+        import json
+        json.dumps(st)               # manifest entries must be JSON-able
+    expected = _drain(it)
+
+    fresh = build()
+    fresh.restore(st) if st is not None else None
+    got = _drain(fresh)
+    assert got == expected, (
+        "case %s consume %d: restored stream diverged" % (case, consume))
+
+
+@pytest.mark.parametrize("case", ["prefetch", "device_prefetch"])
+def test_wrapper_position_reports_next_undelivered(case, tmp_path):
+    """Satellite 1: wrappers holding prefetched-but-undelivered batches
+    must report the NEXT-UNDELIVERED sample, not the inner reader's
+    read-ahead point."""
+    import time
+    builder, _ = _CASES[case]
+    it = builder(str(tmp_path))()
+    next(it)                          # deliver batch 0 (samples 0..3)
+    time.sleep(0.3)                   # let the producer run far ahead
+    pos = it.position()
+    assert pos is not None and pos["offset"] == 4, pos
+    st = it.state()
+    assert st["offset"] == 4, st       # inner ndarray state, pre-fetch
+    # the inner reader HAS read ahead — the wrapper must not echo it
+    if hasattr(it, "_it"):
+        inner_pos = it._it.position()
+        assert inner_pos["offset"] > 4, (
+            "producer never ran ahead; test is vacuous")
+
+
+def test_base_dataiter_declares_no_state():
+    class Plain(mx.io.DataIter):
+        pass
+    it = Plain(batch_size=2)
+    assert it.state() is None
+    it.restore(None)                  # no-op
+    with pytest.raises(MXNetError, match="no durable state"):
+        it.restore({"v": 1, "kind": "ndarray"})
+
+
+def test_check_state_rejects_bad_states():
+    with pytest.raises(MXNetError, match="must be a dict"):
+        ior.check_state([1], "ndarray")
+    with pytest.raises(MXNetError, match="version"):
+        ior.check_state({"v": 99, "kind": "ndarray"}, "ndarray")
+    with pytest.raises(MXNetError, match="kind mismatch"):
+        ior.check_state({"v": 1, "kind": "recordio"}, "ndarray")
+
+
+def test_restore_validates_before_commit():
+    """A rejected state must leave the iterator untouched (validate-
+    then-commit), so the same iterator restores cleanly afterwards."""
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+    it = mx.io.NDArrayIter(data, np.arange(20), batch_size=4)
+    next(it)
+    good = it.state()
+    expected = _drain(it)
+    fresh = mx.io.NDArrayIter(data, np.arange(20), batch_size=4)
+    with pytest.raises(MXNetError):
+        fresh.restore({"v": 1, "kind": "ndarray", "epoch": 0,
+                       "offset": 4, "num_data": 999})
+    fresh.restore(good)
+    assert _drain(fresh) == expected
+
+
+# ------------------------------------------------------ ledger and remap
+
+def test_epoch_permutation_deterministic_and_complete():
+    a = ior.epoch_permutation(11, 3, 100)
+    b = ior.epoch_permutation(11, 3, 100)
+    np.testing.assert_array_equal(a, b)
+    assert sorted(a.tolist()) == list(range(100))
+    assert ior.epoch_permutation(11, 4, 100).tolist() != a.tolist()
+    assert ior.epoch_permutation(12, 3, 100).tolist() != a.tolist()
+
+
+def test_strided_rank_streams_cover_prefix():
+    """The remap invariant itself: lockstep cursors at ANY world size
+    consume exactly a contiguous prefix of the global permutation."""
+    led = ior.SampleLedger(37, seed=9)
+    perm = led.permutation(0)
+    for world in (1, 2, 3, 5):
+        for cursor in (0, 1, 4, 8):
+            union = []
+            for r in range(world):
+                union.extend(led.rank_ids(0, r, world)[:cursor].tolist())
+            g = led.global_consumed(cursor, world)
+            assert sorted(union) == sorted(perm[:g].tolist()), \
+                (world, cursor)
+
+
+@pytest.mark.parametrize("old_world,new_world",
+                         [(4, 1), (1, 4), (4, 2), (2, 3), (3, 5)])
+def test_remap_no_drop_no_double(old_world, new_world):
+    """The acceptance invariant: consume part of an epoch at one world
+    size, remap every new rank's cursor, finish at the new world size —
+    the union of consumed ids is exactly one epoch."""
+    n, cursor = 53, 7                 # deliberately not divisible
+    led = ior.SampleLedger(n, seed=1)
+    acct = ior.SampleAccountant(n)
+    for r in range(old_world):
+        acct.record(led.rank_ids(0, r, old_world)[:cursor])
+    st = {"v": 1, "kind": "ledger", "epoch": 0, "cursor": cursor,
+          "seed": 1, "rank": 0, "world": old_world, "num_samples": n}
+    for r in range(new_world):
+        new = ior.remap_state(st, r, new_world)
+        assert new["world"] == new_world and new["rank"] == r
+        acct.record(led.rank_ids(0, r, new_world)[new["cursor"]:])
+    v = acct.verdict()
+    assert v["ok"], v
+    assert v["consumed"] == n
+
+
+def test_remap_is_pure_and_telemetered():
+    from mxnet_tpu import telemetry
+    st = {"v": 1, "kind": "ledger", "epoch": 2, "cursor": 5, "seed": 0,
+          "rank": 1, "world": 4, "num_samples": 100}
+    snap = dict(st)
+    out = ior.remap_state(st, 0, 2)
+    assert st == snap                 # input not mutated
+    assert out["cursor"] == ior.remap_cursor(20, 0, 2)
+    assert telemetry.gauge("mxtpu_data_remap_samples").get() == 20
+
+
+def test_sharded_ledger_iter_restore_across_world_change():
+    """End-to-end through the iterator: rank 0-of-2 stops mid-epoch,
+    a single rank 0-of-1 resumes from its state — accounting over both
+    legs' batch.index is exactly one epoch."""
+    data = np.arange(106, dtype=np.float32).reshape(53, 2)
+    acct = ior.SampleAccountant(53)
+    its = [ior.ShardedLedgerIter(data, batch_size=4, seed=6, rank=r,
+                                 world=2) for r in range(2)]
+    for _ in range(3):                # lockstep: 3 steps on each rank
+        for it in its:
+            acct.record(next(it).index)
+    st = its[0].state()
+    solo = ior.ShardedLedgerIter(data, batch_size=4, seed=6, rank=0,
+                                 world=1)
+    solo.restore(st)                  # world 2 -> 1 via remap_state
+    for b in solo:
+        acct.record(b.index)
+    v = acct.verdict()
+    assert v["ok"], v
+
+
+def test_sharded_ledger_iter_rejects_wrong_ledger():
+    data = np.zeros((20, 2), np.float32)
+    it = ior.ShardedLedgerIter(data, batch_size=4, seed=1)
+    with pytest.raises(MXNetError, match="ledger state mismatch"):
+        it.restore({"v": 1, "kind": "ledger", "epoch": 0, "cursor": 0,
+                    "seed": 2, "rank": 0, "world": 1,
+                    "num_samples": 20})
+
+
+def test_accountant_flags_drop_and_double():
+    acct = ior.SampleAccountant(6)
+    acct.record([0, 1, 2, 2, 4, 5])
+    v = acct.verdict()
+    assert not v["ok"]
+    assert v["dropped"] == [3] and v["double"] == [2]
+
+
+# ---------------------------------------------------------- chaos seams
+
+@pytest.mark.chaos
+def test_io_resume_fault_leaves_iterator_restorable(tmp_path):
+    """Satellite 3: a fault injected during restore surfaces as a
+    descriptive MXNetError, the iterator is untouched, and the very
+    same state restores cleanly on the next attempt."""
+    build = _nd_builder(str(tmp_path))
+    it = build()
+    next(it)
+    st = it.state()
+    expected = _drain(it)
+    fresh = build()
+    R.configure_faults("io.resume:n=1")
+    with pytest.raises(MXNetError, match="iterator is unchanged"):
+        ior.restore_iterator(fresh, st)
+    # the fresh iterator was not mutated: a full epoch is still there
+    assert len(_drain(fresh)) == 7
+    fresh.reset()
+    R.clear_faults()
+    ior.restore_iterator(fresh, st)
+    assert _drain(fresh) == expected
+
+
+@pytest.mark.chaos
+def test_io_remap_fault_is_retryable():
+    st = {"v": 1, "kind": "ledger", "epoch": 0, "cursor": 5, "seed": 0,
+          "rank": 0, "world": 4, "num_samples": 40}
+    R.configure_faults("io.remap:n=1")
+    with pytest.raises(MXNetError, match="can be retried"):
+        ior.remap_state(st, 0, 2)
+    out = ior.remap_state(st, 0, 2)   # n=1 exhausted: retry succeeds
+    assert out["cursor"] == ior.remap_cursor(20, 0, 2)
+
+
+@pytest.mark.chaos
+def test_apply_pending_keeps_entry_across_fault(tmp_path):
+    """A chaos fault mid-apply leaves the manifest entry PENDING, so
+    the retry path restores from the same state."""
+    build = _nd_builder(str(tmp_path))
+    it = build()
+    next(it)
+    ior.note_loaded_state({"v": 1, "state": it.state(),
+                           "position": it.position()}, source="test")
+    expected = _drain(it)
+    fresh = build()
+    R.configure_faults("io.resume:n=1")
+    with pytest.raises(MXNetError):
+        ior.apply_pending(fresh)
+    assert ior.pending_state() is not None
+    R.clear_faults()
+    entry = ior.apply_pending(build())
+    assert entry is not None and ior.pending_state() is None
+    restored = build()
+    restored.restore(entry["state"])
+    assert _drain(restored) == expected
+
+
+# ----------------------------------------------- manifest <-> fit plumbing
+
+def test_data_state_entry_uses_tracked_iterator():
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+    it = mx.io.NDArrayIter(data, np.arange(20), batch_size=4)
+    ioview.track(it)
+    next(it)
+    entry = ior.data_state_entry()
+    assert entry["v"] == ior.STATE_VERSION
+    assert entry["state"]["kind"] == "ndarray"
+    assert entry["state"]["offset"] == 4
+    assert entry["position"]["offset"] == 4
+
+
+def test_data_state_entry_gated_by_env(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_DATA_RESUME", "0")
+    data = np.zeros((8, 2), np.float32)
+    it = mx.io.NDArrayIter(data, batch_size=4)
+    ioview.track(it)
+    assert ior.data_state_entry() is None
+    ior.note_loaded_state({"v": 1, "state": it.state()})
+    assert ior.pending_state() is None
+
+
+def test_note_loaded_state_drops_future_versions(caplog):
+    import logging
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.io_resume"):
+        ior.note_loaded_state({"v": ior.STATE_VERSION + 1, "state": {}},
+                              source="ck epoch 3")
+    assert ior.pending_state() is None
+    assert "cannot read" in caplog.text
+
+
+def test_checkpoint_manifest_carries_and_restores_data_state(tmp_path):
+    """Full loop through model.save_checkpoint/load_checkpoint: the
+    manifest carries the tracked iterator's durable state, the loader
+    stashes it, and a fresh iterator resumes mid-epoch."""
+    from mxnet_tpu.model import save_checkpoint, load_checkpoint
+    from mxnet_tpu.parallel import reshard
+
+    data = np.arange(54, dtype=np.float32).reshape(27, 2)
+
+    def build():
+        return mx.io.NDArrayIter(data, np.arange(27), batch_size=4)
+
+    it = build()
+    ioview.track(it)
+    next(it)
+    next(it)
+    # fingerprint of the remaining stream from offset 8
+    probe = build()
+    probe.restore(it.state())
+    expected = _drain(probe)
+
+    prefix = str(tmp_path / "ck")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4),
+        name="softmax")
+    args = {"fullyconnected0_weight": mx.nd.array(np.zeros((4, 2), "f")),
+            "fullyconnected0_bias": mx.nd.array(np.zeros(4, "f"))}
+    save_checkpoint(prefix, 1, net, args, {})
+
+    manifest = R.verify_manifest(prefix, 1)
+    entry = reshard.manifest_data_state(manifest)
+    assert entry is not None and entry["state"]["offset"] == 8
+
+    load_checkpoint(prefix, 1)
+    assert ior.pending_state() is not None
+    fresh = build()
+    ior.apply_pending(fresh)
+    assert _drain(fresh) == expected
+    from mxnet_tpu import telemetry
+    assert telemetry.counter("mxtpu_data_resume_total").get() >= 1
+
+
+# ------------------------------------------------- backpressure control
+
+def _knob(initial, lo=1, hi=4):
+    box = [initial]
+    return box, lambda: box[0], lambda v: box.__setitem__(0, v), lo, hi
+
+
+def test_backpressure_hysteresis_confirm_and_cooldown():
+    box, get, set_, lo, hi = _knob(2)
+    ctl = ior.BackpressureController(confirm=2, cooldown=1)
+    ctl.register("depth", get, set_, lo, hi)
+    pb = {"verdict": "producer-bound", "stage": "decode"}
+    assert ctl.tick(pb) is None        # streak 1 of 2: no move yet
+    adj = ctl.tick(pb)
+    assert adj and adj["direction"] == "raise" and box[0] == 3
+    assert ctl.tick(pb) is None        # cooldown tick
+    assert ctl.tick(pb) is None        # streak 1 again
+    adj = ctl.tick(pb)
+    assert adj and box[0] == 4
+    # balanced verdicts reset the streaks
+    ctl2 = ior.BackpressureController(confirm=2, cooldown=0)
+    box2, get2, set2, lo2, hi2 = _knob(2)
+    ctl2.register("depth", get2, set2, lo2, hi2)
+    ctl2.tick(pb)
+    ctl2.tick({"verdict": "balanced"})
+    assert ctl2.tick(pb) is None       # streak restarted
+    assert box2[0] == 2
+
+
+def test_backpressure_lowers_on_consumer_bound_and_clamps():
+    box, get, set_, lo, hi = _knob(2, lo=1, hi=8)
+    ctl = ior.BackpressureController(confirm=1, cooldown=0)
+    ctl.register("depth", get, set_, lo, hi)
+    cb = {"verdict": "consumer-bound", "stage": "train_step"}
+    assert ctl.tick(cb)["direction"] == "lower" and box[0] == 1
+    assert ctl.tick(cb) is None        # clamped at lo: no move recorded
+    assert box[0] == 1
+
+
+def test_backpressure_adjust_telemetry():
+    from mxnet_tpu import telemetry
+    box, get, set_, lo, hi = _knob(2)
+    ctl = ior.BackpressureController(confirm=1, cooldown=0)
+    ctl.register("depth", get, set_, lo, hi)
+    c = telemetry.counter("mxtpu_backpressure_adjust_total").labels(
+        knob="depth", direction="raise")
+    before = c.get()
+    ctl.tick({"verdict": "producer-bound", "stage": "decode"})
+    assert c.get() == before + 1
+    assert ctl.adjustments[-1]["knob"] == "depth"
+
+
+def test_controller_attach_finds_device_prefetch_depth(tmp_path):
+    it = _device_prefetch_builder(str(tmp_path))()
+    ctl = ior.BackpressureController(confirm=1, cooldown=0)
+    assert ctl.attach(it) == 1
+    assert it.depth() == 2
+    ctl.tick({"verdict": "producer-bound", "stage": "decode"})
+    assert it.depth() == 3             # the live queue bound moved
+    for _ in it:                       # drain; worker honors new depth
+        pass
+
+
+def test_maybe_controller_env_gate(tmp_path, monkeypatch):
+    it = _device_prefetch_builder(str(tmp_path))()
+    monkeypatch.delenv("MXNET_TPU_BACKPRESSURE", raising=False)
+    assert ior.maybe_controller(it) is None          # default off
+    monkeypatch.setenv("MXNET_TPU_BACKPRESSURE", "1")
+    ctl = ior.maybe_controller(it)
+    assert ctl is not None
+    # no tunable knob in the chain -> not installed even when enabled
+    plain = mx.io.NDArrayIter(np.zeros((8, 2), np.float32), batch_size=4)
+    assert ior.maybe_controller(plain) is None
+    for _ in it:
+        pass

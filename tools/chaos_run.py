@@ -55,6 +55,14 @@ def sample_spec(rng):
         parts.append("reshard.gather:n=1,after=%d" % rng.randrange(4))
     if rng.random() < 0.3:
         parts.append("elastic.rejoin:n=1")
+    # the exactly-once data plane (docs/api/io_resume.md): the resume
+    # leg restores the reader's durable state and remaps a ledger
+    # cursor, so mid-restore faults must leave both retryable from the
+    # very same state (n=1: one shot, the in-harness retry must land)
+    if rng.random() < 0.4:
+        parts.append("io.resume:n=1")
+    if rng.random() < 0.3:
+        parts.append("io.remap:n=1")
     return ";".join(parts)
 
 
@@ -180,7 +188,36 @@ def main():
         assert resumed in eps, (resumed, eps)
     else:
         assert resumed == eps[-1], (resumed, eps)
+    # ---- exactly-once data plane under chaos: leg 2's reader resumes
+    # the byte offset leg 1 stopped at via the io.resume seam, and a
+    # ledger cursor is remapped across a world-size change via the
+    # io.remap seam.  The chaos contract for both: an injected fault
+    # surfaces as MXNetError BEFORE any mutation, so ONE retry from the
+    # very same state must succeed.
+    from mxnet_tpu import io_resume as ior
+    data_state = reader.state()
     reader2 = rec.MXRecordIO(path, "r", skip_bad_records=quota)
+    for attempt in (1, 2):
+        try:
+            ior.restore_iterator(reader2, data_state)
+            break
+        except MXNetError as e:
+            assert attempt == 1, "io.resume retry did not land: %s" % e
+            print("io.resume fault (%s); retrying from the same state"
+                  % e)
+    assert reader2.state()["byte"] == data_state["byte"], \
+        "reader resumed at the wrong byte offset"
+    ledger_state = {"v": 1, "kind": "ledger", "epoch": 0, "cursor": 3,
+                    "seed": opts.seed, "rank": 0, "world": 2,
+                    "num_samples": 16 * opts.batch}
+    for attempt in (1, 2):
+        try:
+            remapped = ior.remap_state(ledger_state, 0, 1)
+            break
+        except MXNetError as e:
+            assert attempt == 1, "io.remap retry did not land: %s" % e
+            print("io.remap fault (%s); retrying the same remap" % e)
+    assert remapped["cursor"] == 6 and remapped["world"] == 1, remapped
     losses = run_leg(trainer2, reader2, prefix, resumed, opts.steps)
     skipped += reader2.bad_records
 

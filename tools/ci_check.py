@@ -157,7 +157,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/15] mxlint: %d finding(s) over %s"
+        say("ci_check[1/16] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -166,7 +166,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/15] registry selfcheck: %d problem(s)"
+        say("ci_check[2/16] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -180,14 +180,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/15] verify model %-22s %s" % (name, status))
+            say("ci_check[3/16] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/15] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/16] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -195,7 +195,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/15] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/16] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -203,7 +203,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/15] distview smoke: %d problem(s)"
+        say("ci_check[6/16] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -211,14 +211,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/15] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/16] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/15] perf ground truth: %d problem(s)"
+        say("ci_check[8/16] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -226,7 +226,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/15] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/16] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -234,7 +234,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/15] reshard gate: %d problem(s)"
+        say("ci_check[10/16] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -243,7 +243,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/15] numerics gate: %d problem(s)"
+        say("ci_check[11/16] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -252,7 +252,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/15] plan search: %d problem(s)"
+        say("ci_check[12/16] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
@@ -261,7 +261,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 13: SPMD gate (seeded-defect discrimination per
         # MXG011-016 rule + clean sweep over zoo and composed configs)
         problems = spmd_check(repo_root)
-        say("ci_check[13/15] spmd gate: %d problem(s)" % len(problems))
+        say("ci_check[13/16] spmd gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("spmd: %s" % p)
             say("  " + p)
@@ -269,7 +269,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 14: io observability gate (seeded slow stage ->
         # io_top --json names it; flight + counter verdicts agree)
         problems = ioview_check(repo_root)
-        say("ci_check[14/15] io observability: %d problem(s)"
+        say("ci_check[14/16] io observability: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("ioview: %s" % p)
@@ -279,10 +279,20 @@ def run(repo_root=_ROOT, out=None):
         # collective wait strictly smaller at bit-identical params,
         # bucket flight events parseable)
         problems = overlap_check(repo_root)
-        say("ci_check[15/15] overlap gate: %d problem(s)"
+        say("ci_check[15/16] overlap gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("overlap: %s" % p)
+            say("  " + p)
+
+        # stage 16: exactly-once data plane gate (fleet SIGKILL
+        # mid-epoch -> world-size-1 resume with no sample dropped or
+        # doubled; seeded slow producer -> backpressure depth raise)
+        problems = io_resume_check(repo_root)
+        say("ci_check[16/16] io resume gate: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("io_resume: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -539,7 +549,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/15] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/16] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -1581,6 +1591,213 @@ def ioview_check(repo_root=_ROOT):
                 os.environ[k] = v
         resilience.clear_faults()
         ioview.reset()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def _scrubbed_launch_env(extra):
+    """Worker env for a launch.py CPU fleet: one device per process,
+    no inherited rank identity, no TPU-tunnel site plugins (the same
+    scrub every multi-process launch in the repo performs)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXNET_TPU_NUM_PROCESSES", None)
+    env.pop("MXNET_TPU_PROCESS_ID", None)
+    if "PYTHONPATH" in env:
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if "axon" not in p]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            env.pop("PYTHONPATH")
+    env.update(extra)
+    return env
+
+
+def io_resume_check(repo_root=_ROOT):
+    """Exactly-once data plane gate (stage 16, docs/api/io_resume.md).
+
+    Leg A — mid-epoch fleet death and elastic resume: a 2-process
+    ``launch.py`` fleet (``tests/dist_ioresume_worker.py``) consuming
+    one :class:`~mxnet_tpu.io_resume.ShardedLedgerIter` epoch SIGKILLs
+    itself mid-epoch, after a checkpoint whose manifest carries the
+    ledger ``data_state``; a 1-process relaunch resumes via
+    ``load_latest_checkpoint`` + ``restore_data_iter`` (cursor remap
+    world 2 -> 1 through the ``io.remap`` path).  The accounting
+    harness over both legs' consumed-id logs must prove the union —
+    checkpointed leg-A steps plus the whole resume leg — is EXACTLY
+    one epoch: nothing dropped, nothing double-consumed.
+
+    Leg B — backpressure actuation: a seeded slow producer
+    (``io.prefetch`` ``kind=delay``) under ``MXNET_TPU_BACKPRESSURE=1``
+    must flip the live verdict producer-bound and the controller must
+    raise the device prefetch depth — visible in the
+    ``mxtpu_backpressure_adjust_total`` counter, a
+    ``backpressure_adjust`` flight event, AND a jsonl event record
+    (the run-timeline route).  Returns problem strings (empty = clean).
+    """
+    import json
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import io as io_mod, io_resume, resilience, telemetry
+    from mxnet_tpu.model import find_checkpoints
+    from mxnet_tpu.telemetry import flight, ioview
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_ioresume_gate_")
+    try:
+        # ---------------- leg A: fleet kill + world-size-1 resume
+        prefix = os.path.join(tmpdir, "job")
+        idlog = os.path.join(tmpdir, "ids.jsonl")
+        worker = os.path.join(repo_root, "tests",
+                              "dist_ioresume_worker.py")
+        env = _scrubbed_launch_env({
+            "IORESUME_PHASE": "train", "IORESUME_CKPT": prefix,
+            "IORESUME_IDLOG": idlog, "IORESUME_KILL_STEP": "5",
+            "IORESUME_CKPT_EVERY": "2"})
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "launch.py"),
+             "-n", "2", "--launcher", "local",
+             sys.executable, worker],
+            capture_output=True, text=True, timeout=300,
+            cwd=repo_root, env=env)
+        if res.returncode == 0:
+            problems.append("leg A fleet was SIGKILLed mid-epoch but "
+                            "launch.py exited 0")
+            return problems
+        eps = find_checkpoints(prefix)
+        if not eps:
+            problems.append("leg A left no complete checkpoint: %s"
+                            % (res.stdout + res.stderr)[-600:])
+            return problems
+        env = _scrubbed_launch_env({
+            "IORESUME_PHASE": "resume", "IORESUME_CKPT": prefix,
+            "IORESUME_IDLOG": idlog})
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(repo_root, "tools", "launch.py"),
+             "-n", "1", "--launcher", "local",
+             sys.executable, worker],
+            capture_output=True, text=True, timeout=300,
+            cwd=repo_root, env=env)
+        out = res.stdout + res.stderr
+        if res.returncode != 0:
+            problems.append("resume leg failed (%d): %s"
+                            % (res.returncode, out[-800:]))
+            return problems
+        if "ioresume worker 0/1 OK phase=resume" not in out:
+            problems.append("resume leg printed no OK line: %s"
+                            % out[-400:])
+
+        # the manifest must carry a versioned ledger data_state saved
+        # at the old world size
+        resumed = eps[-1]
+        manifest = resilience.verify_manifest(prefix, resumed)
+        entry = ((manifest or {}).get("meta") or {}).get("data_state")
+        st = (entry or {}).get("state") or {}
+        if st.get("kind") != "ledger" or st.get("world") != 2:
+            problems.append("checkpoint manifest data_state is not a "
+                            "world-2 ledger state (got %r)" % (st,))
+
+        # accounting: checkpoint-covered train steps (step < resumed
+        # epoch, both ranks — the post-checkpoint tail was consumed
+        # but rolled back by the kill, so the resume leg re-consumes
+        # those samples) plus the whole resume leg must cover the
+        # epoch exactly once
+        acct = io_resume.SampleAccountant(96)
+        for rank in (0, 1):
+            path = "%s.rank%d" % (idlog, rank)
+            if not os.path.exists(path):
+                problems.append("missing consumed-id log %r" % path)
+                return problems
+            for line in open(path):
+                rec = json.loads(line)
+                if rec["phase"] == "resume" or rec["step"] < resumed:
+                    acct.record(rec["ids"])
+        v = acct.verdict()
+        if not v["ok"]:
+            problems.append(
+                "exactly-once accounting failed across the kill/resume "
+                "legs: consumed=%d dropped=%s double=%s"
+                % (v["consumed"], v["dropped"][:8], v["double"][:8]))
+
+        # ---------------- leg B: seeded slow producer -> depth raise
+        saved = {k: os.environ.get(k)
+                 for k in ("MXNET_TPU_TELEMETRY_JSONL",
+                           "MXNET_TPU_FAULTS", "MXNET_TPU_IOVIEW_EVERY",
+                           "MXNET_TPU_IOVIEW_WINDOW",
+                           "MXNET_TPU_BACKPRESSURE")}
+        log_path = os.path.join(tmpdir, "bp.jsonl")
+        try:
+            ioview.reset()
+            os.environ["MXNET_TPU_TELEMETRY_JSONL"] = log_path
+            os.environ["MXNET_TPU_IOVIEW_EVERY"] = "1"
+            os.environ["MXNET_TPU_IOVIEW_WINDOW"] = "0.01"
+            os.environ["MXNET_TPU_BACKPRESSURE"] = "1"
+            os.environ["MXNET_TPU_FAULTS"] = \
+                "io.prefetch:kind=delay,delay=0.02"
+            x = np.zeros((240, 4), np.float32)
+            it = io_mod.DevicePrefetchIter(
+                io_mod.NDArrayIter(x, np.zeros(240, np.float32),
+                                   batch_size=8),
+                lambda host: host, depth=2)
+            ioview.track(it)
+            ctl = io_resume.maybe_controller(it)
+            if ctl is None:
+                problems.append("maybe_controller installed nothing "
+                                "over a DevicePrefetchIter chain")
+                return problems
+            base = telemetry.counter(
+                "mxtpu_backpressure_adjust_total").labels(
+                    knob="device_prefetch_depth",
+                    direction="raise").get()
+            for _batch in it:
+                telemetry.step_end(samples=8, step_time=0.001)
+                ctl.tick()
+            if it.depth() <= 2:
+                problems.append("seeded slow producer did not raise "
+                                "the prefetch depth (still %d; "
+                                "adjustments %r)"
+                                % (it.depth(), ctl.adjustments))
+            got = telemetry.counter(
+                "mxtpu_backpressure_adjust_total").labels(
+                    knob="device_prefetch_depth",
+                    direction="raise").get()
+            if got <= base:
+                problems.append("mxtpu_backpressure_adjust_total{raise}"
+                                " did not advance")
+            if not any(e.get("kind") == "backpressure_adjust"
+                       for e in flight.events()):
+                problems.append("no backpressure_adjust flight event")
+            events = []
+            if os.path.exists(log_path):
+                for line in open(log_path):
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "backpressure_adjust":
+                        events.append(rec)
+            if not events:
+                problems.append("no backpressure_adjust jsonl event "
+                                "(run-timeline route) in the step-log")
+        finally:
+            for k, val in saved.items():
+                if val is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = val
+            resilience.clear_faults()
+            ioview.reset()
+    except subprocess.TimeoutExpired:
+        problems.append("io_resume gate timed out")
+    finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 

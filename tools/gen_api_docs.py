@@ -66,6 +66,8 @@ HAND_WRITTEN = [
      "join/leave)", "reshard.md"),
     ("overlap (bucketed async gradient allreduce overlapped with "
      "backward, double-buffered staging)", "overlap.md"),
+    ("io_resume (exactly-once data plane: durable iterator state, "
+     "elastic cursor remap, backpressure)", "io_resume.md"),
 ]
 
 # cross-links appended to generated pages (page key = module filename
@@ -116,7 +118,12 @@ SEE_ALSO = {
            "double-buffered H2D staging (the worker holds one staged "
            "batch aside of the queue so the next transfer dispatches "
            "under backpressure) and the thread-free "
-           "`ShardedTrainer.staged_batches` sibling"],
+           "`ShardedTrainer.staged_batches` sibling",
+           "[io_resume](io_resume.md) — the durable `state()`/"
+           "`restore()` contract every tier here implements "
+           "(wrappers report the next *undelivered* sample), the "
+           "checkpoint `meta.data_state` entry, and the backpressure "
+           "controller actuating `DevicePrefetchIter.set_depth`"],
     "model": ["[resilience](resilience.md) — atomic checkpoint writes, "
               "the manifest format, latest-checkpoint fallback",
               "[reshard](reshard.md) — manifest schema v2 mesh "
@@ -127,7 +134,12 @@ SEE_ALSO = {
               "(`telemetry.ioview`): `save_checkpoint` records the "
               "tracked data iterator's `position()` in the manifest "
               "meta as advisory `data_position` — the recorded half "
-              "of mid-epoch resume"],
+              "of mid-epoch resume",
+              "[io_resume](io_resume.md) — exact mid-epoch resume: "
+              "`save_checkpoint` also writes the tracked iterator's "
+              "durable `state()` as `meta.data_state`, and "
+              "`fit`/`load_checkpoint` restore it so training resumes "
+              "at the exact next sample"],
     "module": ["[resilience](resilience.md) — fault injection, "
                "preemption-safe training, chaos testing",
                "[analysis](analysis.md) — `Module.bind(..., "
@@ -140,7 +152,12 @@ SEE_ALSO = {
                  "resync counters this reader emits, the ioview "
                  "`read` stage accounting per record, and the "
                  "reader's `position()` (epoch/offset/resyncs) riding "
-                 "step records and checkpoint manifests"],
+                 "step records and checkpoint manifests",
+                 "[io_resume](io_resume.md) — the reader's durable "
+                 "`state()` (`kind=recordio`: byte offset + epoch + "
+                 "resync count) restored by `restore_iterator` for "
+                 "exact mid-epoch resume, chaos-gated through the "
+                 "`io.resume` seam"],
     "parallel": ["[resilience](resilience.md) — multihost init/barrier "
                  "timeouts, watchdog restarts, preemption handler",
                  "[analysis](analysis.md) — MXG007 sharding-coverage "
@@ -188,7 +205,14 @@ SEE_ALSO = {
                  "scheduler fed by the fleet-agreed skew histograms, "
                  "the all-or-nothing drain contract chaos-tested "
                  "through the `kvstore.collective` seam, and "
-                 "`staged_batches` double-buffered H2D staging"],
+                 "`staged_batches` double-buffered H2D staging",
+                 "[io_resume](io_resume.md) — exactly-once data "
+                 "plane: `ShardedTrainer.save_checkpoint` carries the "
+                 "tracked iterator's durable state in the manifest, "
+                 "`restore_data_iter` applies it on resume, and the "
+                 "`ShardedLedgerIter` cursor remaps exactly across "
+                 "world-size changes (the data-plane half of elastic "
+                 "training)"],
     "monitor": ["[telemetry](telemetry.md) — training-health numerics "
                 "(`telemetry.numerics`): the jit-safe stat machinery "
                 "the default Monitor path rides (`mxtpu_monitor_stat"

@@ -109,7 +109,10 @@ def _sup_event(record, agg=None):
 def _make_aggregator(opts):
     """RunAggregator over the per-rank streams (None when the step-log
     is off or distview cannot load).  The timeline lands beside the
-    supervisor JSONL as ``<base>.run``."""
+    supervisor JSONL as ``<base>.run``; besides per-step fleet rows it
+    carries worker event breadcrumbs (reshard, rank_join/rank_leave,
+    and the exactly-once data plane's data_resume / data_remap /
+    backpressure_adjust — docs/api/io_resume.md)."""
     base = _supervisor_jsonl()
     if not base or opts.launcher != "local":
         return None

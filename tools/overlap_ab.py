@@ -372,14 +372,17 @@ def _run_leg(mode, workdir, steps, slow_s, timeout=300):
     if res.returncode != 0:
         raise RuntimeError("overlap_ab %s leg failed (%d):\n%s"
                            % (mode, res.returncode, out[-2000:]))
+    # regex over the whole capture, not splitlines: the local
+    # launcher's merged stream can butt two ranks' OK records together
+    # with no newline between them
+    import re
+    pat = re.compile(r"overlap-ab worker (\d+)/\d+ OK mode=%s "
+                     r"wait_s=([0-9.eE+-]+?) share=([0-9.eE+-]+?)"
+                     r"(?=overlap-ab|\s|$)" % re.escape(mode))
     ranks = {}
-    for line in out.splitlines():
-        if "overlap-ab worker" in line and "OK mode=%s" % mode in line:
-            r = int(line.split("overlap-ab worker ", 1)[1].split("/")[0])
-            fields = dict(f.split("=", 1) for f in line.split()
-                          if "=" in f)
-            ranks[r] = {"wait_s": float(fields["wait_s"]),
-                        "share": float(fields["share"])}
+    for m in pat.finditer(out):
+        ranks[int(m.group(1))] = {"wait_s": float(m.group(2)),
+                                  "share": float(m.group(3))}
     if sorted(ranks) != [0, 1]:
         raise RuntimeError("overlap_ab %s leg: missing worker OK lines"
                            ":\n%s" % (mode, out[-2000:]))
