@@ -24,6 +24,14 @@ Two engines (ISSUE 2; see docs/api/analysis.md for the full catalog):
   Exposed as ``verify_symbol(mesh=..., parallel=...)``,
   ``ShardedTrainer(strict=True)`` / ``MXNET_TPU_STRICT_BIND=1`` and
   the CLI's ``--mesh/--pipeline/--sequence`` flags.
+* the **static memory-liveness analyzer** (:mod:`.memlive`,
+  MXG017-021): bind-time liveness intervals over the composed train
+  step — predicted peak-HBM watermark with a per-category breakdown,
+  budget gating before any compile, remat-candidate ranking,
+  ZeRO-shardable optimizer-state audit, and a donation audit.
+  Exposed as ``verify_symbol(memory=...)`` / ``Symbol.verify``,
+  budget-armed strict binds, the CLI's ``--memory`` flag and
+  ``tools/mem_top.py``.
 """
 from __future__ import annotations
 
@@ -33,18 +41,22 @@ from ..base import MXNetError
 from .verifier import (Diagnostic, Report, verify_symbol, verify_json,
                        verify_model, infer_node_shapes)
 from . import fusion
+from . import memlive
 from . import perf
 from . import plansearch
 from . import spmd
 from .fusion import plan_block_fusion, last_plan_summary
+from .memlive import LivenessAnalysis, analyze_memory, check_memory
 from .perf import check_predicted_slow
 from .spmd import verify_spmd, build_config
 
 __all__ = ["Diagnostic", "Report", "verify_symbol", "verify_json",
            "verify_model", "infer_node_shapes", "load_mxlint",
-           "registry_selfcheck", "fusion", "perf", "plansearch",
-           "spmd", "plan_block_fusion", "last_plan_summary",
-           "check_predicted_slow", "verify_spmd", "build_config"]
+           "registry_selfcheck", "fusion", "memlive", "perf",
+           "plansearch", "spmd", "plan_block_fusion",
+           "last_plan_summary", "check_predicted_slow", "verify_spmd",
+           "build_config", "LivenessAnalysis", "analyze_memory",
+           "check_memory"]
 
 
 def registry_selfcheck():

@@ -24,6 +24,15 @@ Usage::
     python -m mxnet_tpu.analysis --model mlp --mesh data=2,model=4 \
         --sequence [--seq-axis model] [--kv-push]
 
+    # static memory-liveness pass (MXG017-021): predicted peak HBM,
+    # remat/ZeRO/donation advice, optional budget gate
+    python -m mxnet_tpu.analysis --model resnet50 --memory \
+        [--opt-slots 2] [--mem-budget BYTES] [--mem-tol 0.6] [--eval]
+
+    # machine-readable diagnostics (schema mxtpu-analysis/1): every
+    # rule family MXG001-021 + lint findings as JSON on stdout
+    python -m mxnet_tpu.analysis --model mlp --memory --json
+
     # registry self-check only
     python -m mxnet_tpu.analysis --registry
 
@@ -106,6 +115,30 @@ def main(argv=None):
     ap.add_argument("--kv-push", action="store_true",
                     help="include the DistKVStore push collective in "
                          "the verified schedule")
+    ap.add_argument("--memory", action="store_true",
+                    help="run the static memory-liveness pass "
+                         "(MXG017-021): predicted peak HBM with "
+                         "per-category breakdown, remat/ZeRO/donation "
+                         "advice, and the budget gate when one is "
+                         "armed (analysis.memlive)")
+    ap.add_argument("--eval", dest="mem_eval", action="store_true",
+                    help="--memory models the inference schedule "
+                         "instead of the default fwd+bwd+update step")
+    ap.add_argument("--opt-slots", type=int, default=2, metavar="N",
+                    help="--memory: float32 optimizer slots per "
+                         "parameter (default 2, the Adam layout; SGD "
+                         "momentum uses 1, plain SGD 0)")
+    ap.add_argument("--mem-budget", type=int, default=None,
+                    metavar="BYTES",
+                    help="--memory: explicit MXG017 peak budget in "
+                         "bytes (default: device capacity x "
+                         "MXNET_TPU_MEMORY_BUDGET when known)")
+    ap.add_argument("--mem-tol", type=float, default=None,
+                    help="--memory: MXG018 drift tolerance override "
+                         "(default MXNET_TPU_MEMLIVE_TOL)")
+    ap.add_argument("--json", dest="json_out", action="store_true",
+                    help="emit machine-readable diagnostics (schema "
+                         "mxtpu-analysis/1) on stdout instead of text")
     args = ap.parse_args(argv)
 
     if args.plan and not args.cost_model:
@@ -150,12 +183,45 @@ def main(argv=None):
         batch += (-batch) % denom
 
     failed = warned = False
+    out_doc = None
+    if args.json_out:
+        out_doc = {"schema": "mxtpu-analysis/1", "targets": [],
+                   "registry_problems": [], "lint": []}
+
+    def mem_opts(program):
+        """check_memory options for one target (None: --memory off)."""
+        if not args.memory:
+            return None
+        return {"is_train": not args.mem_eval,
+                "n_slots": 0 if args.mem_eval else args.opt_slots,
+                "mesh": mesh_axes,
+                "budget_bytes": args.mem_budget,
+                "advice": True, "record": True,
+                "program": program}
+
+    def mem_summary(program):
+        if not args.memory:
+            return None
+        from ..telemetry.memory import static_prediction
+        return static_prediction(program)
+
+    def fmt_peak(info):
+        from ..telemetry.memory import _fmt_bytes
+        bd = ", ".join("%s=%s" % (c, _fmt_bytes(v))
+                       for c, v in (info.get("breakdown") or {}).items()
+                       if v)
+        return ("  predicted peak %s at %s (%s)"
+                % (_fmt_bytes(info.get("peak_bytes", 0)),
+                   info.get("peak_node", "?"), bd or "empty"))
 
     if args.registry:
         problems = registry_selfcheck()
-        for p in problems:
-            print("MXG008 [error] <registry>: %s" % p)
-        print("registry selfcheck: %d problem(s)" % len(problems))
+        if out_doc is not None:
+            out_doc["registry_problems"] = list(problems)
+        else:
+            for p in problems:
+                print("MXG008 [error] <registry>: %s" % p)
+            print("registry selfcheck: %d problem(s)" % len(problems))
         failed = failed or bool(problems)
 
     models = args.model
@@ -163,6 +229,7 @@ def main(argv=None):
         from .. import models as _zoo
         models = list(_zoo._MODELS)
     for name in models:
+        program = "model:%s" % name
         _net, report = verify_model(name, batch=batch,
                                     tp_size=args.tp,
                                     cost_model=args.cost_model,
@@ -170,8 +237,19 @@ def main(argv=None):
                                     plan=args.plan,
                                     plan_layout=args.layout,
                                     mesh=mesh_axes,
-                                    parallel=parallel_cfg)
-        print("model %-20s %s" % (name, report))
+                                    parallel=parallel_cfg,
+                                    memory=mem_opts(program))
+        info = mem_summary(program)
+        if out_doc is not None:
+            rec = {"target": name, "kind": "model", "ok": report.ok,
+                   "diagnostics": [d.as_dict() for d in report]}
+            if info:
+                rec["memory"] = info
+            out_doc["targets"].append(rec)
+        else:
+            print("model %-20s %s" % (name, report))
+            if info:
+                print(fmt_peak(info))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)
 
@@ -184,12 +262,24 @@ def main(argv=None):
             shapes["softmax_label"] = (_parse_shape(args.label)
                                        if args.label
                                        else (shapes["data"][0],))
+        program = "graph:%s" % os.path.basename(path)
         report = verify_json(js, shapes=shapes or None, tp_size=args.tp,
                              cost_model=args.cost_model,
                              slow_factor=args.slow_factor,
                              plan=args.plan, plan_layout=args.layout,
-                             mesh=mesh_axes, parallel=parallel_cfg)
-        print("%s: %s" % (path, report))
+                             mesh=mesh_axes, parallel=parallel_cfg,
+                             memory=mem_opts(program))
+        info = mem_summary(program)
+        if out_doc is not None:
+            rec = {"target": path, "kind": "json", "ok": report.ok,
+                   "diagnostics": [d.as_dict() for d in report]}
+            if info:
+                rec["memory"] = info
+            out_doc["targets"].append(rec)
+        else:
+            print("%s: %s" % (path, report))
+            if info:
+                print(fmt_peak(info))
         failed = failed or not report.ok
         warned = warned or bool(report.warnings)
 
@@ -202,12 +292,23 @@ def main(argv=None):
             paths = [os.path.join(root, d)
                      for d in mxlint.DEFAULT_LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        for f in findings:
-            print(f)
-        print("mxlint: %d finding(s)" % len(findings))
+        if out_doc is not None:
+            out_doc["lint"] = [
+                {"path": f.path, "line": f.line, "rule": f.rule,
+                 "message": f.message} for f in findings]
+        else:
+            for f in findings:
+                print(f)
+            print("mxlint: %d finding(s)" % len(findings))
         failed = failed or bool(findings)
 
-    return 1 if (failed or (warned and args.strict_warnings)) else 0
+    code = 1 if (failed or (warned and args.strict_warnings)) else 0
+    if out_doc is not None:
+        import json as _json
+        out_doc["ok"] = code == 0
+        print(_json.dumps(out_doc, indent=2, sort_keys=False,
+                          default=str))
+    return code
 
 
 if __name__ == "__main__":

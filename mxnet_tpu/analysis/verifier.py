@@ -35,7 +35,20 @@ MXG010    warning   predicted-slow node: the learned cost model
                     than ``slow_factor`` x the node's roofline-
                     attainable time (opt-in: runs only when a
                     ``cost_model`` is supplied; see :mod:`.perf`)
+MXG017    error     predicted peak HBM exceeds the armed memory budget
+                    at bind time, before any compile (opt-in via
+                    ``memory=``; see :mod:`.memlive`)
+MXG018    warning   static-peak vs XLA ``memory_analysis`` drift beyond
+                    ``MXNET_TPU_MEMLIVE_TOL`` (:mod:`.memlive`)
+MXG019    warning   remat candidate: residual-heavy chain ranked by
+                    bytes-freed-at-peak per recompute FLOP
+MXG020    warning   ZeRO-shardable replicated optimizer state with the
+                    projected per-rank saving
+MXG021    warning   step input dead after first use but not donated
 ========  ========  ====================================================
+
+MXG011-016 (distributed/SPMD) live in :mod:`.spmd`; MXG017-021 (memory
+liveness, all opt-in via ``memory=``) in :mod:`.memlive`.
 
 Entry points: :func:`verify_symbol` (the engine), :meth:`Symbol.verify`,
 ``Symbol.bind(..., strict=True)``, :func:`verify_json` (adds real
@@ -56,15 +69,25 @@ _SEVERITIES = ("error", "warning")
 
 class Diagnostic:
     """One verifier finding, attributed to a node where possible."""
-    __slots__ = ("rule", "severity", "node", "op", "message")
+    __slots__ = ("rule", "severity", "node", "op", "message", "advice")
 
-    def __init__(self, rule, severity, message, node=None, op=None):
+    def __init__(self, rule, severity, message, node=None, op=None,
+                 advice=None):
         assert severity in _SEVERITIES, severity
         self.rule = rule
         self.severity = severity
         self.message = message
         self.node = node          # offending node name (str | None)
         self.op = op              # op name (str | None)
+        self.advice = advice      # machine-readable payload (dict | None)
+
+    def as_dict(self):
+        """JSON-ready form (``python -m mxnet_tpu.analysis --json``)."""
+        d = {"rule": self.rule, "severity": self.severity,
+             "node": self.node, "op": self.op, "message": self.message}
+        if self.advice is not None:
+            d["advice"] = self.advice
+        return d
 
     def __repr__(self):
         return "<Diagnostic %s %s>" % (self.rule, self.node or "<graph>")
@@ -469,7 +492,7 @@ def _registry_diagnostics(report):
 def verify_symbol(sym, shapes=None, types=None, tp_size=1,
                   check_registry=False, report=None, cost_model=None,
                   slow_factor=3.0, plan=False, plan_layout="NCHW",
-                  mesh=None, parallel=None):
+                  mesh=None, parallel=None, memory=None):
     """Verify a Symbol graph; returns a :class:`Report`.
 
     ``shapes``: {input_name: shape} (same keys as ``infer_shape`` kwargs;
@@ -488,6 +511,10 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
     ({axis: size} descriptor) additionally runs the distributed-
     correctness pass (:mod:`.spmd`, MXG011-016) with ``parallel`` — a
     :func:`.spmd.build_config` dict describing the composed step.
+    ``memory`` (True or a dict of :func:`.memlive.check_memory`
+    options) additionally runs the static memory-liveness pass
+    (MXG017-021), reusing this call's shape pass; like MXG010 it is
+    opt-in and never runs on a plain verify.
     """
     report = report if report is not None else Report()
     shapes = dict(shapes or {})
@@ -546,6 +573,15 @@ def verify_symbol(sym, shapes=None, types=None, tp_size=1,
             from .perf import check_predicted_slow
             check_predicted_slow(topo, structs, cost_model,
                                  factor=slow_factor, report=report)
+    if memory:
+        from . import memlive as _memlive
+        mopts = dict(memory) if isinstance(memory, dict) else {}
+        if mesh and "mesh" not in mopts:
+            mopts["mesh"] = dict(mesh)
+        # hand over this call's shape pass — memlive would otherwise
+        # re-trace the whole graph
+        _memlive.check_memory(sym, shapes, types, report=report,
+                              topo=topo, structs=structs, **mopts)
     return report
 
 
@@ -571,7 +607,7 @@ def infer_node_shapes(sym, shapes=None, types=None):
 def verify_json(json_str, shapes=None, types=None, tp_size=1,
                 check_registry=False, cost_model=None,
                 slow_factor=3.0, plan=False, plan_layout="NCHW",
-                mesh=None, parallel=None):
+                mesh=None, parallel=None, memory=None):
     """Verify a serialized symbol (the reference JSON graph layout).
 
     Runs every :func:`verify_symbol` check *plus* true dead-node
@@ -622,7 +658,7 @@ def verify_json(json_str, shapes=None, types=None, tp_size=1,
                          check_registry=check_registry, report=report,
                          cost_model=cost_model, slow_factor=slow_factor,
                          plan=plan, plan_layout=plan_layout,
-                         mesh=mesh, parallel=parallel)
+                         mesh=mesh, parallel=parallel, memory=memory)
 
 
 # default verification inputs per model-zoo entry: (data kwargs)
@@ -636,7 +672,7 @@ _DEFAULT_IMAGE = {"data": (2, 3, 224, 224)}
 def verify_model(name, batch=2, tp_size=1, num_classes=10,
                  cost_model=None, slow_factor=3.0, plan=False,
                  plan_layout="NCHW", mesh=None, parallel=None,
-                 **model_kwargs):
+                 memory=None, **model_kwargs):
     """Build a model-zoo symbol and verify it with its canonical input
     shape.  Returns (symbol, Report).  ``cost_model`` additionally
     runs the MXG010 predicted-slow check (:mod:`.perf`); ``plan=True``
@@ -651,4 +687,5 @@ def verify_model(name, batch=2, tp_size=1, num_classes=10,
                               cost_model=cost_model,
                               slow_factor=slow_factor, plan=plan,
                               plan_layout=plan_layout,
-                              mesh=mesh, parallel=parallel)
+                              mesh=mesh, parallel=parallel,
+                              memory=memory)

@@ -192,6 +192,11 @@ _CATALOG = {
                                   "device-capacity override for the "
                                   "memory budget check on backends "
                                   "without memory_stats (CPU tests)"),
+    "MXNET_TPU_MEMLIVE_TOL": ("0.25", "honored",
+                              "MXG018 drift tolerance: the static "
+                              "memory-liveness peak may differ from a "
+                              "compiled plan's total by this fraction "
+                              "before the analyzer flags it"),
     "MXNET_TPU_COSTDB": ("", "honored",
                          "persist the op/block cost database "
                          "(telemetry.costdb, schema mxtpu-costdb/1) "

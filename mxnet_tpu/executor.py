@@ -123,8 +123,29 @@ class Executor:
             types = {n: self.arg_dict[n].dtype for n in self._arg_names}
             types.update({n: self.aux_dict[n].dtype
                           for n in self._aux_names})
-            verify_symbol(symbol, shapes=shapes,
-                          types=types).raise_if_errors("bind strict=True")
+            # memory-liveness leg (analysis.memlive, MXG017-021): armed
+            # only when a budget signal exists — device capacity (or
+            # MXNET_TPU_HBM_LIMIT_BYTES) with MXNET_TPU_MEMORY_BUDGET
+            # > 0 — so an over-budget graph is rejected HERE, naming
+            # its peak node, before any XLA compile is attempted.
+            memory = None
+            from .telemetry import memory as _tmem
+            if _tmem.budget_fraction() > 0 \
+                    and _tmem.device_capacity_bytes():
+                is_train = any(req != "null"
+                               for req in self._grad_req.values())
+                memory = {
+                    "is_train": is_train,
+                    "inputs": {n for n in self._arg_names
+                               if self._grad_req.get(n) == "null"},
+                    "donate": (),
+                    "record": True,
+                    "program": ("executor.fused" if is_train
+                                else "executor.forward"),
+                }
+            verify_symbol(symbol, shapes=shapes, types=types,
+                          memory=memory).raise_if_errors(
+                              "bind strict=True")
 
         # block-granularity fusion (analysis.fusion): the enable flag is
         # captured at bind time (trace flags are read when jit traces,

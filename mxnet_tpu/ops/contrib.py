@@ -75,6 +75,7 @@ def _iou(boxes_a, boxes_b):
                   "negative_mining_ratio": -1.0, "negative_mining_thresh": 0.5,
                   "minimum_negative_samples": 0, "variances": (0.1, 0.1, 0.2, 0.2)},
           aliases=("MultiBoxTarget",))
+# mxlint: allow-dtype-widening(detection/loss reference math runs in f32 by contract)
 def multibox_target(attrs, ctx, anchor, label, cls_pred):
     """Anchor matching + target encoding.
 
@@ -158,6 +159,7 @@ def multibox_target(attrs, ctx, anchor, label, cls_pred):
                   "nms_threshold": 0.5, "force_suppress": False,
                   "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1},
           aliases=("MultiBoxDetection",))
+# mxlint: allow-dtype-widening(detection/loss reference math runs in f32 by contract)
 def multibox_detection(attrs, ctx, cls_prob, loc_pred, anchor):
     """Decode + class-wise NMS, static-shape (masked) formulation.
 
@@ -232,6 +234,7 @@ def multibox_detection(attrs, ctx, cls_prob, loc_pred, anchor):
           num_outputs=1, params={"use_data_lengths": False,
                                  "use_label_lengths": False, "blank_label": "first"},
           aliases=("CTCLoss", "ctc_loss"), is_loss=True)
+# mxlint: allow-dtype-widening(detection/loss reference math runs in f32 by contract)
 def ctc_loss(attrs, ctx, data, label):
     """CTC loss (reference: src/operator/contrib/ctc_loss.cc via warpctc).
 
@@ -295,6 +298,7 @@ def quantize(attrs, ctx, data, min_range, max_range):
 
 @register("_contrib_dequantize", arg_names=("data", "min_range", "max_range"),
           params={"out_type": "float32"})
+# mxlint: allow-dtype-widening(detection/loss reference math runs in f32 by contract)
 def dequantize(attrs, ctx, data, min_range, max_range):
     info = jnp.iinfo(data.dtype)
     scale = (max_range - min_range) / (float(info.max) - float(info.min))
@@ -303,6 +307,7 @@ def dequantize(attrs, ctx, data, min_range, max_range):
 
 
 @register("_contrib_fft", params={"compute_size": 128})
+# mxlint: allow-dtype-widening(detection/loss reference math runs in f32 by contract)
 def fft(attrs, ctx, data):
     """Reference: src/operator/contrib/fft.cc — rfft packed as interleaved re/im."""
     out = jnp.fft.fft(data.astype(jnp.float32), axis=-1)

@@ -217,6 +217,7 @@ def _fused_conv_bn(eps, momentum, relu=False, interpret=False):
     one fused dispatch each way (analysis.fusion).  ``interpret`` runs
     the Pallas GEMM in interpreter mode (autotuner A/B on CPU)."""
 
+    # mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
     def fwd_math(x, w, gamma, beta, mm, mv):
         nb, h, wd, k = x.shape
         nout = w.shape[0]
@@ -291,6 +292,7 @@ def _fused_conv_bn(eps, momentum, relu=False, interpret=False):
     return f
 
 
+# mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
 def fused_conv_bn_apply(conv_attrs, bn_attrs, is_train, x, w, gamma,
                         beta, mm, mv):
     """Evaluate the fused pair; returns BatchNorm-op-shaped outputs
@@ -357,6 +359,7 @@ def _conv2d_fn(conv_key, layout):
     return conv
 
 
+# mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
 def _bn_epilogue_fwd(yf, gamma, beta, mm, mv, red, bshape, eps,
                      momentum, train_stats, act):
     """Shared BN(+act) forward epilogue over a pre-computed f32 tensor.
@@ -388,6 +391,7 @@ def _bn_epilogue_fwd(yf, gamma, beta, mm, mv, red, bshape, eps,
     return out, new_mm, new_mv, mean, inv
 
 
+# mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
 def _bn_epilogue_bwd(dout, yf, gamma, beta, mean, inv, mm, red, bshape,
                      momentum, train_stats, act, dmm_o, dmv_o):
     """Shared BN(+act) backward: cotangent of the epilogue's input
@@ -511,6 +515,7 @@ def _fused_bn_act_xla(eps, momentum, train_stats, ch, ndim, act):
     f(x, gamma, beta, mm, mv) -> (out, new_mm, new_mv)."""
     red = tuple(i for i in range(ndim) if i != ch)
 
+    # mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
     def fwd_math(x, gamma, beta, mm, mv):
         bshape = tuple(1 if i != ch else x.shape[ch] for i in range(ndim))
         xf = x.astype(jnp.float32)
@@ -620,6 +625,7 @@ def _fused_fc_act_xla(act, flatten, has_bias):
     return f
 
 
+# mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
 def fused_block_conv_bn_act(conv_attrs, bn_attrs, layout, is_train, act,
                             pallas, x, w, b, gamma, beta, mm, mv,
                             interpret=False):
@@ -651,6 +657,7 @@ def fused_block_conv_bn_act(conv_attrs, bn_attrs, layout, is_train, act,
     return out, new_mm.astype(mm.dtype), new_mv.astype(mv.dtype)
 
 
+# mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
 def fused_block_bn_act(bn_attrs, ch, is_train, act, x, gamma, beta, mm,
                        mv):
     """Evaluate a planned BN(->act) block; returns
@@ -979,6 +986,7 @@ def _tap_range(a, stride, pad_lo, dilate, size_in, size_out):
     return lo, hi
 
 
+# mxlint: allow-dtype-widening(bn epilogue folds statistics in f32 by contract)
 def _dx_channel_sums(dy, w_hwio, strides, padding, dilate, in_h, in_w):
     """Exact (C,) sums over n,h,w of the conv's backward-data cotangent,
     via rectangle sums on the integral image of the batch-reduced dY."""

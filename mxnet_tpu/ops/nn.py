@@ -331,6 +331,7 @@ def _bn_core(eps, momentum, train_stats, bshape_key):
     bshape = tuple(bshape_key)
     red = tuple(i for i, s in enumerate(bshape) if s == 1)
 
+    # mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
     def fwd_math(x, gamma, beta, mm, mv):
         xf = x.astype(jnp.float32)
         if train_stats:
@@ -419,6 +420,7 @@ def _bn_core(eps, momentum, train_stats, bshape_key):
                   "use_global_stats": False, "output_mean_var": False,
                   "axis": 1, "cudnn_off": False},
           aliases=("batch_norm", "BatchNorm_v1"))
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def batch_norm(attrs, ctx, data, gamma, beta, moving_mean, moving_var):
     """Batch normalization with functional aux-state threading.
 
@@ -452,6 +454,7 @@ def batch_norm(attrs, ctx, data, gamma, beta, moving_mean, moving_var):
 @register("LayerNorm", arg_names=("data", "gamma", "beta"),
           num_outputs=lambda a: 3 if a.get("output_mean_var") else 1,
           params={"axis": -1, "eps": 1e-5, "output_mean_var": False})
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def layer_norm(attrs, ctx, data, gamma, beta):
     """Layer normalization over ``axis`` (the transformer workhorse;
     post-reference-era op — the 0.10.1 reference predates attention —
@@ -505,6 +508,7 @@ def l2_normalization(attrs, ctx, data):
 
 
 @register("LRN", params={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5})
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def lrn(attrs, ctx, data):
     """Local response norm across channels.  Reference: src/operator/lrn-inl.h."""
     nsize = int(attrs["nsize"])
@@ -580,6 +584,7 @@ def dropout(attrs, ctx, data):
 
 
 # ------------------------------------------------------------------ softmax
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def _softmax(x, axis):
     return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
 
@@ -594,6 +599,7 @@ def softmax_op(attrs, ctx, data):
 
 
 @register("log_softmax", params={"axis": -1})
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def log_softmax_op(attrs, ctx, data):
     return jax.nn.log_softmax(data.astype(jnp.float32),
                               axis=int(attrs["axis"])).astype(data.dtype)
@@ -930,6 +936,7 @@ def sequence_reverse(attrs, ctx, data, sequence_length=None):
 
 
 @register("softmax_cross_entropy", arg_names=("data", "label"))
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def softmax_cross_entropy(attrs, ctx, data, label):
     """Scalar cross entropy of softmax(data) against integer labels
     (reference loss_binary_op.cc:11-60)."""
@@ -943,6 +950,7 @@ def softmax_cross_entropy(attrs, ctx, data, label):
           aux_names=("moving_avg",),
           params={"sparseness_target": 0.1, "penalty": 0.001,
                   "momentum": 0.9})
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def identity_attach_kl_sparse_reg(attrs, ctx, data, moving_avg):
     """Identity forward; backward adds a KL sparseness penalty against a
     running mean activation (identity_attach_KL_sparse_reg-inl.h:60-110;
@@ -979,6 +987,7 @@ def identity_attach_kl_sparse_reg(attrs, ctx, data, moving_avg):
 @register("LSoftmax", arg_names=("data", "weight", "label"),
           params={"num_hidden": 0, "margin": 2, "beta": 1.0,
                   "beta_min": 0.0, "scale": 1.0, "verbose": False})
+# mxlint: allow-dtype-widening(normalization/softmax statistics accumulate in f32 by contract)
 def lsoftmax(attrs, ctx, data, weight, label):
     """Large-margin softmax inner product (reference lsoftmax.cc /
     lsoftmax.cu — GPU-only there; this jnp formulation runs on every

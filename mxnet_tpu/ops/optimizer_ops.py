@@ -16,6 +16,7 @@ from .registry import register
 _COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0}
 
 
+# mxlint: allow-dtype-widening(f32 master-math is the optimizer update contract)
 def _prep_grad(grad, weight, attrs):
     g = grad.astype(jnp.float32) * attrs["rescale_grad"]
     if attrs["clip_gradient"] > 0:
@@ -24,6 +25,7 @@ def _prep_grad(grad, weight, attrs):
 
 
 @register("sgd_update", arg_names=("weight", "grad"), params=dict(_COMMON))
+# mxlint: allow-dtype-widening(f32 master-math is the optimizer update contract)
 def sgd_update(attrs, ctx, weight, grad):
     g = _prep_grad(grad, weight, attrs)
     return (weight.astype(jnp.float32) - attrs["lr"] * g).astype(weight.dtype)
@@ -31,6 +33,7 @@ def sgd_update(attrs, ctx, weight, grad):
 
 @register("sgd_mom_update", arg_names=("weight", "grad", "mom"),
           params={**_COMMON, "momentum": 0.0}, mutate=("mom",))
+# mxlint: allow-dtype-widening(f32 master-math is the optimizer update contract)
 def sgd_mom_update(attrs, ctx, weight, grad, mom):
     """Returns new_weight; mom is updated in place (reference FMutateInputs)."""
     g = _prep_grad(grad, weight, attrs)
@@ -42,6 +45,7 @@ def sgd_mom_update(attrs, ctx, weight, grad, mom):
 @register("adam_update", arg_names=("weight", "grad", "mean", "var"),
           params={**_COMMON, "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
           mutate=("mean", "var"))
+# mxlint: allow-dtype-widening(f32 master-math is the optimizer update contract)
 def adam_update(attrs, ctx, weight, grad, mean, var):
     """Returns new_weight; mean/var updated in place.
 
@@ -59,6 +63,7 @@ def adam_update(attrs, ctx, weight, grad, mean, var):
 @register("rmsprop_update", arg_names=("weight", "grad", "n"),
           params={**_COMMON, "gamma1": 0.95, "epsilon": 1e-8,
                   "clip_weights": -1.0}, mutate=("n",))
+# mxlint: allow-dtype-widening(f32 master-math is the optimizer update contract)
 def rmsprop_update(attrs, ctx, weight, grad, n):
     g = _prep_grad(grad, weight, attrs)
     g1 = attrs["gamma1"]
@@ -72,6 +77,7 @@ def rmsprop_update(attrs, ctx, weight, grad, n):
 @register("rmspropalex_update", arg_names=("weight", "grad", "n", "g", "delta"),
           params={**_COMMON, "gamma1": 0.95, "gamma2": 0.9, "epsilon": 1e-8,
                   "clip_weights": -1.0}, mutate=("n", "g", "delta"))
+# mxlint: allow-dtype-widening(f32 master-math is the optimizer update contract)
 def rmspropalex_update(attrs, ctx, weight, grad, n, g, delta):
     """RMSProp (Graves 2013 variant); n/g/delta updated in place."""
     gr = _prep_grad(grad, weight, attrs)

@@ -112,6 +112,7 @@ def _cell_step(mode, state_size):
                   "mode": "lstm", "p": 0.0, "state_outputs": False,
                   "lstm_state_clip_min": None, "lstm_state_clip_max": None},
           stochastic=True)
+# mxlint: allow-dtype-widening(recurrent cell math runs in f32 by contract)
 def rnn(attrs, ctx, data, parameters, state, state_cell=None):
     """Fused stacked RNN.  data: [T, B, I] (TNC, reference layout).
 

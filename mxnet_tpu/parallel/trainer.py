@@ -555,6 +555,30 @@ class ShardedTrainer:
                 tp_rules=tp_rules, dtype=self.dtype,
                 arg_shapes=self._arg_shapes,
             ).raise_if_errors("ShardedTrainer strict bind")
+            # static memory-liveness pass (analysis.memlive): predict
+            # the step's peak HBM from liveness intervals — sharding-
+            # and donation-aware (the step jits donate params/opt/aux)
+            # — and record it so budget checks and OOM reports compare
+            # the static peak against the XLA plan (MXG018 drift
+            # gauge).  With a budget armed, an over-budget step is
+            # rejected HERE (MXG017), before any compile.
+            from ..analysis import memlive as _memlive
+            from ..analysis.verifier import Report as _Report
+            try:
+                axes = {str(k): int(v)
+                        for k, v in dict(mesh.shape).items()}
+            except Exception:  # mxlint: allow-broad-except(mesh.shape drifted across jax versions; an unknown mesh just disables sharding-aware byte division)
+                axes = {}
+            mem_report = _Report()
+            _memlive.check_memory(
+                symbol,
+                shapes={**dict(data_shapes), **dict(label_shapes or {})},
+                report=mem_report, is_train=True, mesh=axes,
+                tp_rules=dict(tp_rules), n_slots=self._n_slots,
+                donate=True, advice=False, record=True,
+                program="trainer.step")
+            mem_report.raise_if_errors(
+                "ShardedTrainer strict bind (memory)")
 
         def param_spec(name):
             shp = self._store_shapes.get(name, self._aux_shapes.get(name))

@@ -595,7 +595,7 @@ class Symbol:
     # -------------------------------------------------------- verification
     def verify(self, shapes=None, types=None, tp_size=1,
                check_registry=False, mesh=None, parallel=None,
-               **shape_kwargs):
+               memory=None, **shape_kwargs):
         """Statically verify the graph BEFORE any compile/device time.
 
         Runs the :mod:`mxnet_tpu.analysis` graph verifier: per-node
@@ -617,6 +617,15 @@ class Symbol:
                        parallel=analysis.build_config(
                            pipeline_stages=2, data_shapes=...))
 
+        ``memory`` (True or an ``analysis.memlive.check_memory``
+        options dict) additionally runs the static memory-liveness
+        pass (MXG017-021): predicted peak HBM vs the armed budget,
+        remat/ZeRO/donation advice — all before any compile::
+
+            net.verify(data=(32, 3, 224, 224),
+                       memory={"is_train": True, "n_slots": 2,
+                               "mesh": {"data": 8}})
+
         Returns an :class:`mxnet_tpu.analysis.Report`.
         """
         from .analysis import verify_symbol
@@ -625,7 +634,8 @@ class Symbol:
         return verify_symbol(self, shapes=known, types=types,
                              tp_size=tp_size,
                              check_registry=check_registry,
-                             mesh=mesh, parallel=parallel)
+                             mesh=mesh, parallel=parallel,
+                             memory=memory)
 
     # ------------------------------------------------------------- binding
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",

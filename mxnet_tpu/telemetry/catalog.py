@@ -179,6 +179,21 @@ CATALOG = {
     "mxtpu_oom_total": (COUNTER, ("program",),
                         "RESOURCE_EXHAUSTED errors annotated with the "
                         "memory plan and live-bytes snapshot"),
+    "mxtpu_predicted_peak_bytes": (GAUGE, ("program", "category"),
+                                   "bind-time static liveness peak-HBM "
+                                   "prediction (analysis.memlive; "
+                                   "category=params|activations|"
+                                   "residuals|optimizer|workspace|"
+                                   "total)"),
+    "mxtpu_remat_candidate_bytes": (GAUGE, ("program",),
+                                    "residual bytes freeable at the "
+                                    "predicted peak by the ranked "
+                                    "MXG019 remat candidates"),
+    "mxtpu_memlive_drift_ratio": (GAUGE, ("program",),
+                                  "(static predicted peak - XLA "
+                                  "memory_analysis total) / total for "
+                                  "the last MXG018 comparison "
+                                  "(MXNET_TPU_MEMLIVE_TOL bounds it)"),
     # ------------------------------------------------ flight recorder
     "mxtpu_flight_events_total": (COUNTER, ("kind",),
                                   "structured events recorded into the "
@@ -240,7 +255,7 @@ CATALOG = {
     # ------------------------- static verification (mxnet_tpu.analysis)
     "mxtpu_verify_findings_total": (COUNTER, ("rule",),
                                     "verifier diagnostics reported, by "
-                                    "rule id (MXG001-016; every "
+                                    "rule id (MXG001-021; every "
                                     "Report.add increments — bind-time "
                                     "strict checks, CLI runs and "
                                     "ci_check sweeps all count)"),

@@ -188,6 +188,36 @@ def plan_of(compiled, program):
 
 _plans_lock = threading.Lock()
 _PLANS = {}
+_STATIC = {}   # program -> analysis.memlive prediction dict
+
+
+def note_static_prediction(program, info):
+    """Record a bind-time static liveness prediction for ``program``
+    (pushed by :func:`mxnet_tpu.analysis.memlive.record_prediction` —
+    the dependency points this way so the telemetry layer never imports
+    the analysis package).  The budget check and :class:`annotate_oom`
+    fold it into their reports, and :func:`register_plan` publishes the
+    MXG018 drift gauge once both peaks are known."""
+    with _plans_lock:
+        _STATIC[program] = dict(info)
+        plan = _PLANS.get(program)
+    if plan is not None:
+        _publish_drift(program, info, plan)
+
+
+def static_prediction(program):
+    """The recorded static prediction for a program name, or None."""
+    with _plans_lock:
+        return _STATIC.get(program)
+
+
+def _publish_drift(program, info, plan):
+    """``mxtpu_memlive_drift_ratio{program}`` — (static - plan)/plan."""
+    peak = int(info.get("peak_bytes") or 0)
+    total = int(plan.total_bytes or 0)
+    if total > 0:
+        gauge("mxtpu_memlive_drift_ratio").labels(program=program).set(
+            (peak - total) / float(total))
 
 
 def register_plan(plan):
@@ -198,6 +228,9 @@ def register_plan(plan):
     overwrites (a rebind IS a new plan)."""
     with _plans_lock:
         _PLANS[plan.program] = plan
+        static = _STATIC.get(plan.program)
+    if static is not None:
+        _publish_drift(plan.program, static, plan)
     g = gauge("mxtpu_memory_plan_bytes")
     for c in CATEGORIES:
         if c in plan.memory:
@@ -227,9 +260,11 @@ def plans_dict():
 
 
 def clear_plans():
-    """Forget every registered plan (telemetry.reset calls this)."""
+    """Forget every registered plan and static prediction
+    (telemetry.reset calls this)."""
     with _plans_lock:
         _PLANS.clear()
+        _STATIC.clear()
 
 
 # ------------------------------------------------------------ live memory
@@ -330,14 +365,42 @@ def check_budget(plan, capacity=None, fraction=None, device=None):
     raise MXNetError(
         "memory budget check: compiled program %r needs %s of device "
         "memory but only %s is budgeted (capacity %s x "
-        "MXNET_TPU_MEMORY_BUDGET=%.2f).  Plan breakdown: %s.  "
+        "MXNET_TPU_MEMORY_BUDGET=%.2f).  Plan breakdown: %s.%s  "
         "Options: reduce the per-device batch size, enable "
         "rematerialization (MXNET_BACKWARD_DO_MIRROR=1), shard more "
         "state over the mesh (tp_rules / pipeline_stages), or raise "
         "the budget fraction if the headroom is intentional."
         % (plan.program, _fmt_bytes(plan.total_bytes),
            _fmt_bytes(budget), _fmt_bytes(capacity), fraction,
-           plan.breakdown()))
+           plan.breakdown(), _static_summary(plan.program)))
+
+
+def _static_summary(program):
+    """One sentence comparing the bind-time static prediction with the
+    registered XLA plan — both peaks come from the same predictor
+    (analysis.memlive), so budget failures name where the bytes go."""
+    info = static_prediction(program)
+    if not info:
+        return ""
+    parts = ["  Static liveness prediction: peak %s at %s"
+             % (_fmt_bytes(info.get("peak_bytes", 0)),
+                info.get("peak_node", "?"))]
+    bd = info.get("breakdown") or {}
+    cats = ", ".join("%s=%s" % (c, _fmt_bytes(v))
+                     for c, v in bd.items() if v)
+    if cats:
+        parts.append(" (%s)" % cats)
+    remats = info.get("remat_candidates") or ()
+    if remats:
+        r = remats[0]
+        parts.append("; top remat candidate %s frees %s at peak"
+                     % (r.get("node"),
+                        _fmt_bytes(r.get("bytes_freed", 0))))
+    zero = int(info.get("zero_saving_per_rank") or 0)
+    if zero > 0:
+        parts.append("; ZeRO-sharding replicated optimizer state "
+                     "would save %s per rank" % _fmt_bytes(zero))
+    return "".join(parts) + "."
 
 
 # ------------------------------------------------------- planned dispatch
@@ -497,6 +560,9 @@ class annotate_oom:
             lines.append("largest live buffers: %s." % "; ".join(
                 "%s %s %s" % (_fmt_bytes(b), shape, dtype)
                 for b, shape, dtype in buffers))
+        static = _static_summary(self.program)
+        if static:
+            lines.append(static.strip())
         lines.append(
             "Advice: reduce the per-device batch size, enable "
             "rematerialization (MXNET_BACKWARD_DO_MIRROR=1), or shard "

@@ -1,0 +1,341 @@
+"""Static memory-liveness analyzer (analysis.memlive, MXG017-021).
+
+Interval oracles are hand-computed on a tiny fc->relu chain: the topo
+is [data, fc_weight, fc_bias, fc, act] (N=5), so the train timeline is
+forward t=0..4, backward t=5..9 (node i's backward at 2N-1-i), update
+t=10.  Seeded-defect tests then assert each rule names the offending
+node, and the drift regression pins the static prediction to the XLA
+memory_analysis total on a real zoo model (satellite: the telemetry
+budget check and the analyzer must agree within MXNET_TPU_MEMLIVE_TOL).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.analysis import memlive
+from mxnet_tpu.analysis.verifier import Report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny():
+    d = sym.var("data")
+    fc = sym.FullyConnected(d, num_hidden=4, name="fc")
+    return sym.Activation(fc, act_type="relu", name="act")
+
+
+def _buf(analysis, name):
+    hits = [b for b in analysis.buffers if b.name == name]
+    assert hits, "no buffer %r in %s" % (name, analysis.buffers)
+    return hits[0]
+
+
+def _rules(report):
+    return [d.rule for d in report]
+
+
+def _find(report, rule):
+    return [d for d in report if d.rule == rule]
+
+
+# ------------------------------------------------- interval oracles
+
+def test_eval_intervals_tiny_chain():
+    a = memlive.analyze(_tiny(), shapes={"data": (2, 8)},
+                        is_train=False, fuse=False)
+    assert a.n_nodes == 5
+    # data (2,8)f32=64B is read once, by fc at t=3, and dies there
+    d = _buf(a, "data")
+    assert (d.start, d.end, d.first_use) == (0, 3, 3) and d.is_input
+    # params live to the end of the forward (t = N-1 = 4)
+    assert (_buf(a, "fc_weight").start, _buf(a, "fc_weight").end) == (0, 4)
+    # fc's output (2,4)f32=32B is born at its position, read by act
+    assert (_buf(a, "fc").start, _buf(a, "fc").end) == (3, 4)
+    assert (_buf(a, "act").start, _buf(a, "act").end) == (4, 4)
+    # peak is at fc's position: data64 + weight128 + bias16 + fc32
+    assert a.peak_bytes == 240 and a.peak_pos == 3
+    assert a.peak_node == "fc"
+    # eval mode has no residuals and no optimizer state
+    assert not any(b.category in ("residuals", "optimizer")
+                   for b in a.buffers)
+
+
+def test_train_intervals_tiny_chain():
+    a = memlive.analyze(_tiny(), shapes={"data": (2, 8)},
+                        is_train=True, n_slots=2, fuse=False)
+    # input is a residual of fc's backward: last = 2N-1-3 = 6
+    assert (_buf(a, "data").start, _buf(a, "data").end) == (0, 6)
+    # fc's output is act's residual: saved at t=3 until act's backward
+    # at 2N-1-4 = 5
+    fc = _buf(a, "fc")
+    assert (fc.category, fc.start, fc.end) == ("residuals", 3, 5)
+    # cotangent of fc is born at act's backward, consumed at fc's own
+    # backward (t=6)
+    assert (_buf(a, "d(fc)").start, _buf(a, "d(fc)").end) == (5, 6)
+    # weight gradient lives from fc's backward to the update (t=2N=10)
+    assert (_buf(a, "d(fc_weight)").start,
+            _buf(a, "d(fc_weight)").end) == (6, 10)
+    # Adam: 2 f32 slots per param, alive the whole step
+    w_opt = _buf(a, "fc_weight.opt")
+    assert (w_opt.category, w_opt.nbytes) == ("optimizer", 2 * 128)
+    assert (w_opt.start, w_opt.end) == (0, 10)
+    # the un-donated update writes double-buffer at the update slot
+    assert (_buf(a, "fc_weight'").start,
+            _buf(a, "fc_weight'").end) == (10, 10)
+
+
+def test_peak_equals_live_sum():
+    a = memlive.analyze(_tiny(), shapes={"data": (2, 8)},
+                        is_train=True, n_slots=2, fuse=False)
+    assert a.peak_bytes == sum(b.nbytes for b in a.live_at_peak)
+    # the sweep found the true maximum over every timeline slot
+    assert a.peak_bytes == max(
+        sum(b.nbytes for b in a.live_at(t))
+        for t in range(2 * a.n_nodes + 1))
+
+
+def test_donation_arms_update_in_place():
+    # trainer convention (donate=True): params/opt updated in place,
+    # no "name'" double-buffers, so the update-slot peak drops
+    plain = memlive.analyze(_tiny(), shapes={"data": (2, 8)},
+                            is_train=True, n_slots=2, fuse=False)
+    donated = memlive.analyze(_tiny(), shapes={"data": (2, 8)},
+                              is_train=True, n_slots=2, fuse=False,
+                              donate=True)
+    assert donated.peak_bytes < plain.peak_bytes
+    assert not any(b.name.endswith("'") for b in donated.buffers)
+
+
+def test_sharding_divides_bytes():
+    full = memlive.analyze(_tiny(), shapes={"data": (4, 8)},
+                           is_train=False, fuse=False)
+    shard = memlive.analyze(_tiny(), shapes={"data": (4, 8)},
+                            is_train=False, fuse=False,
+                            mesh={"data": 4})
+    # batch-dim buffers (input + op outputs) shrink 4x; params don't
+    assert _buf(shard, "data").nbytes == _buf(full, "data").nbytes // 4
+    assert _buf(shard, "fc_weight").nbytes == _buf(full,
+                                                   "fc_weight").nbytes
+
+
+# --------------------------------------------------- seeded defects
+
+def test_mxg017_over_budget_names_peak_node():
+    report = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (2, 8)},
+                         report=report, budget_bytes=100,
+                         is_train=False, advice=False, fuse=False)
+    bad = _find(report, "MXG017")
+    assert bad and bad[0].severity == "error", str(report)
+    assert bad[0].node == "fc"                 # the peak position
+    assert bad[0].advice["peak_bytes"] == 240
+    assert bad[0].advice["budget_bytes"] == 100
+    assert "fc" in bad[0].message and "breakdown" in bad[0].message
+    with pytest.raises(Exception):
+        report.raise_if_errors("test")
+
+
+def test_mxg017_within_budget_is_silent():
+    report = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (2, 8)},
+                         report=report, budget_bytes=10**9,
+                         is_train=False, advice=False, fuse=False)
+    assert not _rules(report), str(report)
+
+
+def test_mxg018_drift_fires_and_respects_tol():
+    report = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (2, 8)},
+                         report=report, is_train=False, advice=False,
+                         fuse=False, plan_total=240 * 100, tol=0.5)
+    bad = _find(report, "MXG018")
+    assert bad and bad[0].advice["static_peak_bytes"] == 240
+    assert bad[0].advice["plan_total_bytes"] == 24000
+    # same drift inside a huge tolerance: silent
+    report2 = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (2, 8)},
+                         report=report2, is_train=False, advice=False,
+                         fuse=False, plan_total=240 * 100, tol=1e6)
+    assert not _find(report2, "MXG018")
+
+
+def test_mxg019_remat_ranked_by_score():
+    # fc's 32B residual costs 2*2*8*4 = 128 recompute FLOPs
+    a = memlive.analyze(_tiny(), shapes={"data": (2, 8)},
+                        is_train=True, n_slots=0, fuse=False)
+    cands = a.remat_candidates()
+    assert cands and cands[0]["node"] == "fc"
+    assert cands[0]["bytes_freed"] == 32
+    assert cands[0]["recompute_flops"] == 128
+    scores = [c["score"] for c in cands]
+    assert scores == sorted(scores, reverse=True)
+    report = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (2, 8)},
+                         report=report, is_train=True, n_slots=0,
+                         fuse=False)
+    hits = _find(report, "MXG019")
+    assert hits and hits[0].node == "fc"
+    assert hits[0].advice["kind"] == "remat"
+
+
+def test_mxg020_zero_audit_replicated_slots():
+    report = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (4, 8)},
+                         report=report, is_train=True, n_slots=2,
+                         mesh={"data": 4}, fuse=False)
+    bad = _find(report, "MXG020")
+    assert bad, str(report)
+    adv = bad[0].advice
+    assert adv["kind"] == "zero"
+    # 2 slots x (128+16)B params = 288B replicated; 3/4 saved per rank
+    assert adv["total_slot_bytes"] == 288
+    assert adv["total_saving_per_rank"] == 216
+    assert bad[0].node == "fc_weight"          # largest slot named
+    # no data axis -> nothing to shard -> silent
+    report2 = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (4, 8)},
+                         report=report2, is_train=True, n_slots=2,
+                         fuse=False)
+    assert not _find(report2, "MXG020")
+
+
+def test_mxg021_undonated_dead_input():
+    report = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (2, 8)},
+                         report=report, is_train=False, fuse=False)
+    bad = _find(report, "MXG021")
+    assert bad, str(report)
+    assert bad[0].advice["input"] == "data"
+    assert bad[0].advice["bytes"] == 64
+    # donating it silences the finding
+    report2 = Report()
+    memlive.check_memory(_tiny(), shapes={"data": (2, 8)},
+                         report=report2, is_train=False, fuse=False,
+                         donate=("data",))
+    assert not _find(report2, "MXG021")
+
+
+def test_fusion_plan_removes_interior_edges():
+    # with fusion on, interior edges of fused blocks never materialize:
+    # the fused analysis can only be <= the unfused one, and whatever
+    # it dropped is accounted in skipped_bytes
+    from mxnet_tpu import models
+    net = models.get_model("lenet", num_classes=10)
+    shapes = {"data": (2, 1, 28, 28), "softmax_label": (2,)}
+    unfused = memlive.analyze(net, shapes, is_train=True, fuse=False)
+    fused = memlive.analyze(net, shapes, is_train=True, fuse=True)
+    assert fused.peak_bytes <= unfused.peak_bytes
+    assert fused.skipped_bytes > 0
+
+
+# ------------------------------------------ verify() / bind wiring
+
+def test_symbol_verify_memory_opt_in():
+    net = _tiny()
+    # plain verify: no memory rules at all
+    report = net.verify(data=(2, 8))
+    assert not any(r.startswith("MXG02") or r == "MXG017"
+                   for r in _rules(report))
+    # opt-in via the memory dict
+    report = net.verify(data=(2, 8),
+                        memory={"is_train": False, "advice": False,
+                                "budget_bytes": 100, "fuse": False})
+    assert _find(report, "MXG017"), str(report)
+
+
+def test_bind_strict_memory_budget(monkeypatch):
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    # a 100-byte HBM "device": the strict bind must reject the graph
+    # at bind time, before any compile, naming the peak
+    monkeypatch.setenv("MXNET_TPU_HBM_LIMIT_BYTES", "100")
+    net = _tiny()
+    with pytest.raises(MXNetError, match="MXG017"):
+        net.simple_bind(mx.cpu(), grad_req="null", strict=True,
+                        data=(2, 8))
+    # without the budget signal the same strict bind stays green
+    monkeypatch.delenv("MXNET_TPU_HBM_LIMIT_BYTES")
+    net.simple_bind(mx.cpu(), grad_req="null", strict=True,
+                    data=(2, 8))
+
+
+# ------------------------------------------- drift regression (MXG018)
+
+@pytest.mark.slow
+def test_static_matches_xla_plan_mlp():
+    """Satellite 3: the static predictor and the XLA memory_analysis
+    agree within MXNET_TPU_MEMLIVE_TOL on a real zoo model, and the
+    telemetry drift gauge carries the residual."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import models
+    from mxnet_tpu.symbol import eval_graph, _classify_vars
+    from mxnet_tpu.analysis.verifier import (_topo_from_entries,
+                                             _shape_pass)
+    from mxnet_tpu.telemetry import memory as tmem
+
+    net = models.get_model("mlp", num_classes=10)
+    shapes = {"data": (2, 784), "softmax_label": (2,)}
+    topo = _topo_from_entries(net._entries)
+    arg_shapes, structs = _shape_pass(net, topo, shapes, {}, Report())
+    args_v, aux_v = _classify_vars(topo)
+    avals = {id(n): jax.ShapeDtypeStruct(tuple(arg_shapes[n.name]),
+                                         jnp.float32)
+             for n in args_v + aux_v}
+
+    def fwd(vals):
+        outs, _ = eval_graph(topo, net._entries, vals, is_train=False)
+        return outs
+
+    compiled = jax.jit(fwd).lower(avals).compile()
+    plan = tmem.plan_of(compiled, "test_memlive.mlp")
+    assert plan.total_bytes > 0
+
+    report = Report()
+    analysis = memlive.check_memory(
+        net, shapes, report=report, is_train=False, advice=False,
+        plan_total=plan, topo=topo, structs=structs,
+        record=True, program="test_memlive.mlp")
+    assert not _find(report, "MXG018"), str(report)
+    drift = abs(analysis.peak_bytes - plan.total_bytes) \
+        / float(plan.total_bytes)
+    assert drift <= memlive.memlive_tolerance()
+    # the static-prediction slot and gauge side of the dedup
+    rec = tmem.static_prediction("test_memlive.mlp")
+    assert rec and rec["peak_bytes"] == analysis.peak_bytes
+
+
+# --------------------------------------------------- CLI + mem_top
+
+@pytest.mark.slow
+def test_mem_top_json_advice_records():
+    """Acceptance: an over-budget model's mem_top --json carries at
+    least one ranked remat candidate and one ZeRO advice record."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mem_top.py"),
+         "--model", "mlp", "--mesh", "data=8", "--opt-slots", "2",
+         "--budget", "1000000", "--json"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 1, out.stderr      # over budget
+    doc = json.loads(out.stdout)
+    assert doc["schema"] == "mxtpu-memtop/1"
+    assert doc["over_budget"] is True
+    kinds = {r["kind"] for r in doc["advice"]}
+    assert "remat" in kinds and "zero" in kinds
+    assert doc["buffers"] and doc["live_at_peak"]
+    # worst-liveness-first: byte-steps non-increasing
+    steps = [b["byte_steps"] for b in doc["buffers"]]
+    assert steps == sorted(steps, reverse=True)
+
+
+def test_mem_top_usage_error():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mem_top.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 2
+    assert "exactly one of" in out.stderr

@@ -3,10 +3,10 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Fifteen stages, all of which must be clean:
+Seventeen stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
-   the TPU-hazard rules MXL001-006; pragmas with reasons are the only
+   the TPU-hazard rules MXL001-007; pragmas with reasons are the only
    accepted suppressions.
 2. **op-registry self-check** — alias/hook/TP-rule drift
    (:func:`mxnet_tpu.ops.registry.selfcheck`).
@@ -123,6 +123,29 @@ Fifteen stages, all of which must be clean:
     ``mxtpu_overlap_*`` metrics automatically; stage 13 additionally
     discriminates a seeded bucket-order mismatch via MXG011.)
 
+16. **io resume gate** — the exactly-once data plane
+    (``mxnet_tpu/io_resume.py``, docs/api/io_resume.md): a 2-process
+    fleet SIGKILLed mid-epoch must resume as a 1-process fleet (cursor
+    remap world 2 -> 1) with the consumed-id union EXACTLY one epoch —
+    nothing dropped, nothing doubled — and a seeded slow producer must
+    drive a ``backpressure_adjust`` depth raise visible in the
+    counter, the flight box, and the run timeline.
+
+17. **memory gate** — the static memory-liveness analyzer
+    (``mxnet_tpu.analysis.memlive``, MXG017-021, docs/api/
+    memlive.md): the static eval-schedule peak must agree with the
+    XLA ``memory_analysis`` total of the aval-compiled forward within
+    ``MXNET_TPU_MEMLIVE_TOL`` on EVERY zoo model (no MXG018); seeded
+    fixtures must fire MXG017 (over budget, peak node NAMED, error
+    severity), MXG019 (remat candidate), MXG020 (replicated optimizer
+    state) and MXG021 (un-donated dead input); and ``tools/mem_top.py
+    --json`` over an over-budget sharded train config must emit a
+    strict-parseable ``mxtpu-memtop/1`` document with at least one
+    remat and one ZeRO advice record.  (The stage-4 drift guard
+    covers the new ``mxtpu_predicted_peak_bytes`` /
+    ``mxtpu_remat_candidate_bytes`` / ``mxtpu_memlive_drift_ratio``
+    metrics automatically.)
+
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
 """
@@ -157,7 +180,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/16] mxlint: %d finding(s) over %s"
+        say("ci_check[1/17] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -166,7 +189,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/16] registry selfcheck: %d problem(s)"
+        say("ci_check[2/17] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -180,14 +203,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/16] verify model %-22s %s" % (name, status))
+            say("ci_check[3/17] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/16] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/17] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -195,7 +218,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/16] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/17] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -203,7 +226,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/16] distview smoke: %d problem(s)"
+        say("ci_check[6/17] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -211,14 +234,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/16] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/17] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/16] perf ground truth: %d problem(s)"
+        say("ci_check[8/17] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -226,7 +249,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/16] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/17] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -234,7 +257,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/16] reshard gate: %d problem(s)"
+        say("ci_check[10/17] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -243,7 +266,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/16] numerics gate: %d problem(s)"
+        say("ci_check[11/17] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -252,7 +275,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/16] plan search: %d problem(s)"
+        say("ci_check[12/17] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
@@ -261,7 +284,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 13: SPMD gate (seeded-defect discrimination per
         # MXG011-016 rule + clean sweep over zoo and composed configs)
         problems = spmd_check(repo_root)
-        say("ci_check[13/16] spmd gate: %d problem(s)" % len(problems))
+        say("ci_check[13/17] spmd gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("spmd: %s" % p)
             say("  " + p)
@@ -269,7 +292,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 14: io observability gate (seeded slow stage ->
         # io_top --json names it; flight + counter verdicts agree)
         problems = ioview_check(repo_root)
-        say("ci_check[14/16] io observability: %d problem(s)"
+        say("ci_check[14/17] io observability: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("ioview: %s" % p)
@@ -279,7 +302,7 @@ def run(repo_root=_ROOT, out=None):
         # collective wait strictly smaller at bit-identical params,
         # bucket flight events parseable)
         problems = overlap_check(repo_root)
-        say("ci_check[15/16] overlap gate: %d problem(s)"
+        say("ci_check[15/17] overlap gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("overlap: %s" % p)
@@ -289,10 +312,20 @@ def run(repo_root=_ROOT, out=None):
         # mid-epoch -> world-size-1 resume with no sample dropped or
         # doubled; seeded slow producer -> backpressure depth raise)
         problems = io_resume_check(repo_root)
-        say("ci_check[16/16] io resume gate: %d problem(s)"
+        say("ci_check[16/17] io resume gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("io_resume: %s" % p)
+            say("  " + p)
+
+        # stage 17: memory-liveness gate (zoo-wide MXG018 drift bound
+        # vs aval-compiled XLA plans; seeded MXG017/019/020/021
+        # fixtures; mem_top --json strict parse)
+        problems = memlive_check(repo_root)
+        say("ci_check[17/17] memory gate: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("memlive: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -549,7 +582,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/16] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/17] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -1799,6 +1832,136 @@ def io_resume_check(repo_root=_ROOT):
         problems.append("io_resume gate timed out")
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def memlive_check(repo_root=_ROOT):
+    """Stage 17: static memory-liveness gate (analysis.memlive,
+    MXG017-021, docs/api/memlive.md).
+
+    Three legs: (1) zoo-wide drift bound — the static eval-schedule
+    peak must agree with the XLA ``memory_analysis`` total of the
+    aval-compiled forward within ``MXNET_TPU_MEMLIVE_TOL`` on EVERY
+    model (no MXG018, no errors); (2) seeded defects — an over-budget
+    fixture must be rejected via MXG017 NAMING the peak node, and the
+    remat/ZeRO/donation advice rules (MXG019/020/021) must each fire
+    on a fixture built to deserve them; (3) ``tools/mem_top.py
+    --json`` over an over-budget sharded train config must emit a
+    strict-parseable ``mxtpu-memtop/1`` document carrying at least one
+    remat and one ZeRO advice record.  The aval-only compile never
+    touches a device and costs seconds, not minutes — infer_shape is
+    deliberately bypassed in favor of the verifier's shape pass."""
+    import contextlib
+    import importlib.util
+    import io as _io
+    import json
+
+    problems = []
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.symbol import eval_graph, _classify_vars
+    from mxnet_tpu.analysis import memlive
+    from mxnet_tpu.analysis.verifier import (Report, _DEFAULT_IMAGE,
+                                             _MODEL_SHAPES, _shape_pass,
+                                             _topo_from_entries)
+    from mxnet_tpu.models import _MODELS, get_model
+    from mxnet_tpu.telemetry import memory as tmem
+
+    # ---- leg 1: zoo-wide MXG018 drift bound
+    for name in _MODELS:
+        try:
+            net = get_model(name, num_classes=10)
+            shapes = dict(_MODEL_SHAPES.get(name, _DEFAULT_IMAGE))
+            shapes = {k: (2,) + tuple(v[1:]) for k, v in shapes.items()}
+            shapes["softmax_label"] = (2,)
+            topo = _topo_from_entries(net._entries)
+            arg_shapes, structs = _shape_pass(net, topo, shapes, {},
+                                              Report())
+            args_v, aux_v = _classify_vars(topo)
+            avals = {id(n): jax.ShapeDtypeStruct(
+                tuple(arg_shapes[n.name]), jnp.float32)
+                for n in args_v + aux_v}
+
+            def fwd(vals, _topo=topo, _entries=net._entries):
+                outs, _ = eval_graph(_topo, _entries, vals,
+                                     is_train=False)
+                return outs
+
+            compiled = jax.jit(fwd).lower(avals).compile()
+            plan = tmem.plan_of(compiled, "ci.memlive.%s" % name)
+            report = Report()
+            memlive.check_memory(net, shapes, report=report,
+                                 is_train=False, advice=False,
+                                 plan_total=plan, topo=topo,
+                                 structs=structs)
+            for d in report:
+                problems.append("drift %s: %s" % (name, d))
+        except Exception as exc:  # mxlint: allow-broad-except(the gate reports any per-model failure as a finding rather than aborting the sweep)
+            problems.append("drift %s: %r" % (name, exc))
+
+    # ---- leg 2: seeded defects, one per rule
+    d = sym.var("data")
+    fc = sym.FullyConnected(d, num_hidden=4, name="fc")
+    tiny = sym.Activation(fc, act_type="relu", name="act")
+    tiny_shapes = {"data": (4, 8)}
+
+    report = Report()
+    memlive.check_memory(tiny, tiny_shapes, report=report,
+                         budget_bytes=100, is_train=False,
+                         advice=False, fuse=False)
+    hits = [x for x in report if x.rule == "MXG017"]
+    if not hits:
+        problems.append("seeded over-budget fixture: MXG017 missing")
+    elif hits[0].node != "fc" or hits[0].severity != "error":
+        problems.append("MXG017 must name the peak node as an error, "
+                        "got %s" % hits[0])
+
+    report = Report()
+    memlive.check_memory(tiny, tiny_shapes, report=report,
+                         is_train=True, n_slots=2, mesh={"data": 4},
+                         fuse=False)
+    rules = {x.rule for x in report}
+    for want in ("MXG019", "MXG020"):
+        if want not in rules:
+            problems.append("seeded advice fixture: %s missing "
+                            "(got %s)" % (want, sorted(rules)))
+    report = Report()
+    memlive.check_memory(tiny, tiny_shapes, report=report,
+                         is_train=False, fuse=False)
+    if "MXG021" not in {x.rule for x in report}:
+        problems.append("seeded un-donated-input fixture: MXG021 "
+                        "missing")
+
+    # ---- leg 3: mem_top --json strict parse (in-process: same
+    # interpreter, no second jax import)
+    spec = importlib.util.spec_from_file_location(
+        "mem_top", os.path.join(repo_root, "tools", "mem_top.py"))
+    mem_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mem_top)
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mem_top.main(["--model", "mlp", "--mesh", "data=8",
+                           "--opt-slots", "2", "--budget", "1000000",
+                           "--json"])
+    if rc != 1:
+        problems.append("mem_top over-budget run: expected exit 1, "
+                        "got %d" % rc)
+    try:
+        doc = json.loads(buf.getvalue())
+    except ValueError as exc:
+        problems.append("mem_top --json unparseable: %s" % exc)
+    else:
+        if doc.get("schema") != "mxtpu-memtop/1":
+            problems.append("mem_top schema drift: %r"
+                            % doc.get("schema"))
+        kinds = {r.get("kind") for r in doc.get("advice", [])}
+        if "remat" not in kinds:
+            problems.append("mem_top advice: no remat candidate")
+        if "zero" not in kinds:
+            problems.append("mem_top advice: no ZeRO record")
+        if not doc.get("over_budget"):
+            problems.append("mem_top: over_budget flag not set")
     return problems
 
 

@@ -68,6 +68,8 @@ HAND_WRITTEN = [
      "backward, double-buffered staging)", "overlap.md"),
     ("io_resume (exactly-once data plane: durable iterator state, "
      "elastic cursor remap, backpressure)", "io_resume.md"),
+    ("memlive (static memory-liveness: bind-time peak-HBM prediction, "
+     "remat ranking, donation/ZeRO audit)", "memlive.md"),
 ]
 
 # cross-links appended to generated pages (page key = module filename

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""mem_top — rank a program's worst-liveness buffers before any compile.
+
+The memory-side sibling of ``perf_top``: runs the static liveness
+analyzer (:mod:`mxnet_tpu.analysis.memlive`, MXG017-021) over a
+model-zoo symbol or a serialized ``-symbol.json`` graph and prints the
+buffers ranked worst liveness first (byte-steps = bytes x timeline
+span), the predicted peak-HBM watermark with its per-category
+breakdown and the live set at the peak, plus the advice rows a failing
+run would otherwise only learn post-OOM: remat candidates
+(bytes-freed-at-peak vs recompute FLOPs), ZeRO-shardable replicated
+optimizer state (saving per data rank), and dead-after-first-use
+inputs that should be donated.
+
+Unlike ``perf_top`` this tool needs jax (the analyzer rides the
+verifier's shape pass), but it never compiles or touches a device —
+everything here is bind-time static analysis.  Usage::
+
+    python tools/mem_top.py --model resnet [--batch N] [--eval]
+                            [--mesh data=8,model=2] [--opt-slots N]
+                            [--budget BYTES] [--top N] [--json]
+    python tools/mem_top.py --graph net-symbol.json --data 32,3,224,224
+
+``--json`` emits one machine-readable document (schema
+``mxtpu-memtop/1``) whose ``advice`` list carries the remat/zero/
+donate records — what the ci_check memory gate parses.  Exit codes:
+0 ok, 1 predicted peak over ``--budget``, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return ("%.2f %s" % (n, unit)) if unit != "B" \
+                else ("%d B" % int(n))
+        n /= 1024.0
+
+
+def load_target(args):
+    """(symbol, shapes, label) from --model or --graph."""
+    if args.model:
+        from mxnet_tpu import models
+        from mxnet_tpu.analysis.verifier import (_DEFAULT_IMAGE,
+                                                 _MODEL_SHAPES)
+        net = models.get_model(args.model, num_classes=args.classes)
+        shapes = dict(_MODEL_SHAPES.get(args.model, _DEFAULT_IMAGE))
+        shapes = {k: (args.batch,) + tuple(v[1:])
+                  for k, v in shapes.items()}
+        shapes["softmax_label"] = (args.batch,)
+        return net, shapes, "model:%s" % args.model
+    from mxnet_tpu import symbol as _symbol
+    net = _symbol.load(args.graph)
+    shapes = {}
+    if args.data:
+        shapes["data"] = tuple(int(d) for d in args.data.split(","))
+        shapes["softmax_label"] = (shapes["data"][0],)
+    return net, shapes, "graph:%s" % os.path.basename(args.graph)
+
+
+def advice_rows(analysis):
+    """Flat remat/zero/donate advice records, one dict per row."""
+    rows = []
+    for cand in analysis.remat_candidates():
+        rows.append({"kind": "remat", "node": cand["node"],
+                     "members": list(cand["members"]),
+                     "bytes_freed": int(cand["bytes_freed"]),
+                     "recompute_flops": int(cand["recompute_flops"])})
+    for ent in analysis.zero_audit():
+        rows.append({"kind": "zero", "param": ent["param"],
+                     "slot_bytes": int(ent["slot_bytes"]),
+                     "saving_per_rank": int(ent["saving_per_rank"]),
+                     "data_size": int(ent["data_size"])})
+    for ent in analysis.donation_audit():
+        rows.append({"kind": "donate", "input": ent["input"],
+                     "bytes": int(ent["bytes"]),
+                     "last_use": ent["last_use"]})
+    return rows
+
+
+def print_table(analysis, rows, top, budget):
+    a = analysis
+    print("mem_top — static liveness for %s (%s)"
+          % (a.program or "<graph>",
+             "train" if a.is_train else "eval"))
+    print("  predicted peak : %s at %s (pos %d/%d)"
+          % (fmt_bytes(a.peak_bytes), a.peak_node, a.peak_pos,
+             2 * a.n_nodes if a.is_train else a.n_nodes - 1))
+    print("  breakdown      : " + "  ".join(
+        "%s=%s" % (c, fmt_bytes(v))
+        for c, v in sorted(a.breakdown.items(), key=lambda kv: -kv[1])
+        if v))
+    if budget:
+        over = a.peak_bytes > budget
+        print("  budget         : %s (%s)"
+              % (fmt_bytes(budget),
+                 "OVER by %s" % fmt_bytes(a.peak_bytes - budget)
+                 if over else "ok, %.0f%% headroom"
+                 % (100.0 * (1 - a.peak_bytes / budget))))
+    if a.skipped_bytes:
+        print("  fusion saved   : %s (interior edges never materialize)"
+              % fmt_bytes(a.skipped_bytes))
+    ranked = sorted(a.buffers,
+                    key=lambda b: -(b.nbytes * b.span))[:top]
+    print()
+    print("  %-28s %-11s %10s %7s %13s %s"
+          % ("buffer", "category", "bytes", "span", "byte-steps",
+             "live"))
+    peak_live = {id(b) for b in a.live_at_peak}
+    for b in ranked:
+        print("  %-28s %-11s %10s %7d %13s [%d,%d]%s"
+              % (b.name[:28], b.category, fmt_bytes(b.nbytes), b.span,
+                 fmt_bytes(b.nbytes * b.span), b.start, b.end,
+                 "  <-peak" if id(b) in peak_live else ""))
+    if rows:
+        print()
+        print("  advice:")
+        for r in rows:
+            if r["kind"] == "remat":
+                print("    remat  %-22s frees %s at the residual peak"
+                      " (recompute %s FLOPs, chain %s)"
+                      % (r["node"], fmt_bytes(r["bytes_freed"]),
+                         "{:,}".format(r["recompute_flops"]),
+                         "+".join(r["members"])))
+            elif r["kind"] == "zero":
+                print("    zero   %-22s %s of replicated optimizer"
+                      " state; sharding over data=%d saves %s/rank"
+                      % (r["param"], fmt_bytes(r["slot_bytes"]),
+                         r["data_size"],
+                         fmt_bytes(r["saving_per_rank"])))
+            else:
+                print("    donate %-22s %s dead after first use"
+                      " (%s) — donate_argnums reclaims it"
+                      % (r["input"], fmt_bytes(r["bytes"]),
+                         r["last_use"]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mem_top",
+        description="Rank worst-liveness buffers and print remat/ZeRO/"
+                    "donation advice from the static memory analyzer.")
+    ap.add_argument("--model", help="model-zoo name (models.get_model)")
+    ap.add_argument("--graph", help="serialized -symbol.json path")
+    ap.add_argument("--data", help="input shape for --graph, e.g. "
+                                   "32,3,224,224")
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--eval", dest="is_eval", action="store_true",
+                    help="forward-only schedule (default: full train "
+                         "step with residuals + optimizer slots)")
+    ap.add_argument("--mesh", default="",
+                    help="axes spec, e.g. data=8,model=2")
+    ap.add_argument("--opt-slots", type=int, default=2,
+                    help="float32 optimizer slots per param "
+                         "(2 = Adam; ignored with --eval)")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="HBM budget in bytes; predicted peak above "
+                         "it exits 1")
+    ap.add_argument("--top", type=int, default=20,
+                    help="buffer rows to print (default 20)")
+    ap.add_argument("--json", dest="json_out", action="store_true",
+                    help="emit one mxtpu-memtop/1 document")
+    args = ap.parse_args(argv)
+
+    if bool(args.model) == bool(args.graph):
+        print("mem_top: exactly one of --model/--graph is required",
+              file=sys.stderr)
+        return 2
+    if args.graph and not os.path.exists(args.graph):
+        print("mem_top: no such graph file: %s" % args.graph,
+              file=sys.stderr)
+        return 2
+    try:
+        mesh = {}
+        if args.mesh:
+            from mxnet_tpu.parallel.reshard import parse_axes
+            mesh = parse_axes(args.mesh)
+    except ValueError as exc:
+        print("mem_top: %s" % exc, file=sys.stderr)
+        return 2
+
+    try:
+        net, shapes, label = load_target(args)
+    except Exception as exc:  # mxlint: allow-broad-except(CLI boundary)
+        print("mem_top: cannot load target: %s" % exc, file=sys.stderr)
+        return 2
+
+    from mxnet_tpu.analysis import memlive
+    analysis = memlive.analyze(
+        net, shapes=shapes or None, is_train=not args.is_eval,
+        mesh=mesh or None, n_slots=0 if args.is_eval else args.opt_slots,
+        program=label)
+    rows = advice_rows(analysis)
+    over = bool(args.budget) and analysis.peak_bytes > args.budget
+
+    if args.json_out:
+        doc = dict(analysis.as_dict())
+        doc.update({
+            "schema": "mxtpu-memtop/1",
+            "target": label,
+            "mesh": mesh,
+            "opt_slots": 0 if args.is_eval else args.opt_slots,
+            "budget_bytes": args.budget,
+            "over_budget": over,
+            "peak_pos": int(analysis.peak_pos),
+            "live_at_peak": [b.as_dict()
+                             for b in analysis.live_at_peak],
+            "buffers": [dict(b.as_dict(),
+                             byte_steps=b.nbytes * b.span)
+                        for b in sorted(
+                            analysis.buffers,
+                            key=lambda b: -(b.nbytes * b.span))
+                        [:args.top]],
+            "advice": rows,
+        })
+        print(json.dumps(doc, indent=2, sort_keys=False, default=str))
+    else:
+        print_table(analysis, rows, args.top, args.budget)
+    return 1 if over else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

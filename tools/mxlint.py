@@ -39,15 +39,29 @@ MXL006   collective inside a rank-conditioned branch: a collective
          variable.  Only SOME ranks reach the collective; the rest
          block its peers forever — the SPMD divergence class the
          graph-level MXG012 rule checks in jaxprs.
+MXL007   dtype widening hazard: device-side float64 (``jnp.float64``,
+         or a ``"float64"``/``"double"`` dtype string handed to a
+         ``jnp.*`` call — TPUs have no f64 units; jax silently
+         computes in f32 unless x64 is enabled, and then everything
+         doubles), or — in ``ops/`` files — an entire function
+         *parameter* widened wholesale via ``.astype(jnp.float32)``
+         at entry.  The widening silently doubles HBM traffic for
+         bf16 inputs; thread an accumulation-dtype parameter instead.
+         Casting *loaded tiles or intermediates* to f32 (the MXU
+         accumulate-in-f32 idiom, e.g. ``x_ref[0].astype(f32)``) is
+         the correct pattern and is deliberately NOT flagged.
 =======  ============================================================
 
 Pragmas: ``# mxlint: allow-broad-except(reason)`` (and the analogous
 ``allow-host-sync`` / ``allow-recompile-hazard`` /
 ``allow-capture-mutation`` / ``allow-missing-donate`` /
-``allow-rank-collective``) or the generic
+``allow-rank-collective`` / ``allow-dtype-widening``) or the generic
 ``# mxlint: disable=MXL002(reason)``, placed on the offending line or
 the line above it.  A non-empty reason is required — a bare pragma is
-itself reported (MXL000).
+itself reported (MXL000).  For MXL007's input-widening leg only, a
+pragma on a function's ``def`` line (or the line above it) blesses the
+whole body — "this kernel computes in f32 by contract" is a
+per-function statement, not a per-cast one.
 
 Usage: ``python tools/mxlint.py [paths...]`` (default: mxnet_tpu/
 tools/ examples/ relative to the repo root); exits 1 on findings.
@@ -73,6 +87,8 @@ RULES = {
     "MXL005": "train-step wrapper jitted without donate_argnums",
     "MXL006": "collective inside a rank-conditioned branch (SPMD "
               "divergence: only some ranks reach it)",
+    "MXL007": "dtype widening hazard (device-side float64, or "
+              "unparameterized input widening to float32)",
 }
 
 DEFAULT_LINT_DIRS = ("mxnet_tpu", "tools", "examples")
@@ -84,6 +100,7 @@ _PRAGMA_NAMES = {
     "allow-capture-mutation": "MXL004",
     "allow-missing-donate": "MXL005",
     "allow-rank-collective": "MXL006",
+    "allow-dtype-widening": "MXL007",
 }
 
 _PRAGMA_RE = re.compile(
@@ -711,6 +728,111 @@ def _check_rank_collective(tree, findings, pragmas, path):
                     % (d, node.test.lineno)))
 
 
+# ---- MXL007: dtype widening hazards
+
+_JNP_MODULES = {"jnp", "jax.numpy"}
+_F64_STRINGS = {"float64", "double"}
+_F32_REFS = {"float32"}
+
+
+def _is_f32_ref(node):
+    """``jnp.float32`` / ``np.float32`` / ``"float32"`` as an astype arg."""
+    if isinstance(node, ast.Attribute) and node.attr in _F32_REFS:
+        return True
+    return isinstance(node, ast.Constant) and node.value in _F32_REFS
+
+
+def _check_dtype_widening(tree, findings, pragmas, path):
+    """MXL007: two legs.
+
+    (a) device-side float64 anywhere: ``jnp.float64`` attribute refs,
+    or a ``"float64"``/``"double"`` string argument to a ``jnp.*``
+    call.  Host-side ``np.float64`` (gradient checking, timestamps) is
+    deliberately exempt — the hazard is f64 *on device*.
+
+    (b) wholesale input widening in ``ops/`` files: a bare function
+    *parameter* cast with ``.astype(jnp.float32)``.  Intermediates and
+    subscripted loads (``x_ref[0].astype(f32)`` — the MXU
+    accumulate-in-f32 idiom) stay exempt.
+    """
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "float64":
+            base = _dotted(node.value)
+            if base in _JNP_MODULES:
+                if not _suppressed(pragmas, node.lineno, "MXL007"):
+                    findings.append(Finding(
+                        path, node.lineno, "MXL007",
+                        "device-side float64 (%s.float64): TPUs have "
+                        "no f64 units — jax silently computes this in "
+                        "f32 (or doubles every buffer under x64); use "
+                        "float32/bfloat16, or annotate with "
+                        "'# mxlint: allow-dtype-widening(reason)'"
+                        % base))
+        elif isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d and d.split(".", 1)[0] in _JNP_MODULES | {"jax"}:
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    if isinstance(arg, ast.Constant) and \
+                            arg.value in _F64_STRINGS:
+                        if _suppressed(pragmas, node.lineno, "MXL007"):
+                            continue
+                        findings.append(Finding(
+                            path, node.lineno, "MXL007",
+                            "float64 dtype string %r passed to %s(): "
+                            "TPUs have no f64 units; use float32/"
+                            "bfloat16, or annotate with '# mxlint: "
+                            "allow-dtype-widening(reason)'"
+                            % (arg.value, d)))
+
+    if "ops" not in os.path.normpath(path).split(os.sep):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        a = fn.args
+        params = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        for p in (a.vararg, a.kwarg):
+            if p is not None:
+                params.add(p.arg)
+        # a pragma on the ``def`` line blesses the whole body: the
+        # natural unit for "this kernel computes in f32 by contract"
+        if _suppressed(pragmas, fn.lineno, "MXL007"):
+            continue
+        # shallow walk: a cast belongs to its INNERMOST function (the
+        # one whose parameter list it widens); nested defs get their
+        # own visit from the outer ast.walk
+        stack = list(ast.iter_child_nodes(fn))
+        body = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            body.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        for node in body:
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "astype"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in params
+                    and len(node.args) == 1 and not node.keywords
+                    and _is_f32_ref(node.args[0])):
+                continue
+            if _suppressed(pragmas, node.lineno, "MXL007"):
+                continue
+            findings.append(Finding(
+                path, node.lineno, "MXL007",
+                "input %r widened wholesale to float32 at function "
+                "entry: a bf16 caller silently pays double the HBM "
+                "traffic with no way to opt out; thread an "
+                "accumulation-dtype parameter (cast loaded tiles/"
+                "intermediates instead), or annotate with "
+                "'# mxlint: allow-dtype-widening(reason)'"
+                % f.value.id))
+
+
 def lint_source(source, path="<string>"):
     """Lint one source string; returns a list of Findings."""
     findings = []
@@ -725,6 +847,7 @@ def lint_source(source, path="<string>"):
     _check_jit_hazards(tree, findings, pragmas, path)
     _check_missing_donate(tree, findings, pragmas, path)
     _check_rank_collective(tree, findings, pragmas, path)
+    _check_dtype_widening(tree, findings, pragmas, path)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -757,7 +880,7 @@ def lint_paths(paths):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        prog="mxlint", description="TPU-hazard source linter (MXL001-005)")
+        prog="mxlint", description="TPU-hazard source linter (MXL001-007)")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: mxnet_tpu/ "
                          "tools/ examples/ next to this script)")
