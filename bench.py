@@ -40,6 +40,14 @@ dry-run artifact: the 2-process bucketed-overlap on/off A/B
 (``tools/overlap_ab.py`` — fast rank's collective wait + segment share
 with overlap on vs off at bit-identical final params, ROADMAP item 4;
 docs/api/overlap.md).
+
+``--serve`` (or BENCH_SERVE=1) runs the serving-tier closed-loop load
+test instead of the training bench: an in-process batch-ladder replica
+driven by closed-loop HTTP clients plus a deadline-starved burst; the
+artifact's ``serving`` block carries p50/p99 latency, shed rate, rung
+occupancy, and ``compiles_after_warmup`` (asserted 0 — the request
+path never compiles; docs/api/serving.md).  BENCH_SERVE_FLEET=1 adds
+the 2-replica kill/restart leg under ``tools/launch.py --fleet``.
 """
 from __future__ import annotations
 
@@ -55,6 +63,10 @@ BASELINE_IMG_S = 45.52  # reference ResNet-50 train, 1x K80, batch 32
 
 def main():
     import threading
+
+    if "--serve" in sys.argv[1:] or \
+            os.environ.get("BENCH_SERVE", "0") == "1":
+        return _serve_bench()
 
     dry_run = "--dry-run" in sys.argv[1:] or \
         os.environ.get("BENCH_DRYRUN", "0") == "1"
@@ -254,6 +266,252 @@ def main():
                "summary": trainer.fusion_summary()})
 
 
+def _serve_bench():
+    """``--serve`` (or BENCH_SERVE=1): the serving-tier closed-loop
+    load test (docs/api/serving.md).
+
+    Stands up ONE in-process replica — tiny MLP predictor, batch
+    ladder AOT-compiled at 1/4/8, continuous batcher, HTTP front door
+    on an ephemeral port — then drives it with BENCH_SERVE_CLIENTS
+    closed-loop HTTP clients for BENCH_SERVE_SECONDS, follows with a
+    32-wide burst under a 1 ms deadline (forcing the load shedder),
+    and emits the ``serving`` BENCH block: client-side p50/p99 latency,
+    shed rate, per-rung occupancy, the hot rung, and — the AOT
+    contract — ``compiles_after_warmup`` (the process-wide backend
+    compile counter's delta across the whole load phase, asserted 0
+    by ci_check / tests).  BENCH_SERVE_FLEET=1 appends a fleet leg:
+    a 2-replica ``tools/launch.py --fleet`` job, rank 0 SIGKILLed
+    mid-load, evidence that the peer keeps answering and the watchdog
+    restart lands in the supervisor timeline (never raises — failures
+    report as an error field, like the overlap leg)."""
+    import threading
+    import urllib.request
+    import urllib.error
+
+    from mxnet_tpu import models, module, predictor, telemetry
+    from mxnet_tpu import initializer, context
+    from mxnet_tpu.serving import BatchLadder, Batcher, Server
+
+    features = 64
+    net = models.get_model("mlp", num_classes=10)
+    mod = module.Module(net, context=context.cpu())
+    label_names = [n for n in net.list_arguments() if n.endswith("label")]
+    mod.bind(data_shapes=[("data", (1, features))],
+             label_shapes=[(n, (1,)) for n in label_names])
+    mod.init_params(initializer.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2.0))
+    arg_params, aux_params = mod.get_params()
+    params = dict(arg_params)
+    params.update(aux_params)
+    pred = predictor.Predictor(net.tojson(), params,
+                               {"data": (1, features)})
+
+    ladder = BatchLadder(pred, rungs=(1, 4, 8))
+    batcher = Batcher(ladder, window_ms=2.0, queue_depth=8,
+                      default_deadline_ms=500.0)
+    server = Server(ladder, batcher=batcher, port=0).start()
+    url = "http://127.0.0.1:%d/predict" % server.port
+
+    compile_counter = telemetry.counter("mxtpu_compile_total")
+    compiles_before = compile_counter.get()
+
+    def post(rows, deadline_ms, lat, codes):
+        doc = {"data": [[0.1] * features] * rows,
+               "deadline_ms": deadline_ms}
+        body = json.dumps(doc).encode()
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+                codes.append(r.status)
+        except urllib.error.HTTPError as e:
+            e.read()
+            codes.append(e.code)
+        except OSError:
+            codes.append(-1)
+        lat.append(time.perf_counter() - t0)
+
+    # closed loop: each client issues its next request the moment the
+    # previous one answers — the arrival rate adapts to service rate
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "3"))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    lat, codes = [], []
+    stop_at = time.monotonic() + seconds
+
+    def client():
+        while time.monotonic() < stop_at:
+            post(1, 400.0, lat, codes)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # burst: 32 concurrent requests under a 1 ms deadline against a
+    # depth-8 queue — the load shedder MUST refuse some of these
+    burst_codes = []
+    burst = [threading.Thread(target=post,
+                              args=(1, 1.0, [], burst_codes))
+             for _ in range(32)]
+    for t in burst:
+        t.start()
+    for t in burst:
+        t.join()
+
+    compiles_after = compile_counter.get()
+    server.close()
+
+    lat_ok = sorted(l for l, c in zip(lat, codes) if c == 200)
+
+    def pct(q):
+        if not lat_ok:
+            return None
+        return round(
+            lat_ok[min(len(lat_ok) - 1, int(q * len(lat_ok)))] * 1e3, 3)
+
+    all_codes = codes + burst_codes
+    sheds = sum(1 for c in all_codes if c == 503)
+    servetop = _servetop_doc()
+    serving = {
+        "requests": len(all_codes),
+        "ok": sum(1 for c in all_codes if c == 200),
+        "shed": sheds,
+        "shed_rate": round(sheds / len(all_codes), 4)
+        if all_codes else 0.0,
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "rungs": list(ladder.rungs),
+        "hot_rung": servetop.get("hot_rung"),
+        "rung_occupancy": servetop.get("rung_occupancy"),
+        "dominant_shed_reason": servetop.get("dominant_shed_reason"),
+        "compiles_after_warmup": int(compiles_after - compiles_before)
+        if telemetry.compile.installed() else None,
+        "clients": n_clients,
+        "seconds": seconds,
+    }
+    if os.environ.get("BENCH_SERVE_FLEET", "0") == "1":
+        serving["fleet"] = _serve_fleet_leg()
+    _emit({
+        "metric": "serve_mlp_p99_ms",
+        "value": serving["p99_ms"] or 0,
+        "unit": "ms",
+        "vs_baseline": 0,
+    }, serving=serving)
+
+
+def _servetop_doc():
+    """The server-side metric roll-up for the serve bench: render the
+    in-process registry and summarize it through tools/serve_top.py
+    (loaded by file path — it is a stdlib tool, not a package).  Empty
+    dict when either half fails; the bench block then simply lacks the
+    server-side fields."""
+    try:
+        import importlib.util
+        from mxnet_tpu import telemetry
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "serve_top.py")
+        spec = importlib.util.spec_from_file_location("mxtpu_servetop",
+                                                      path)
+        st = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(st)
+        return st.summarize(st.parse_prom(telemetry.render_prom()))
+    except Exception as e:  # mxlint: allow-broad-except(the roll-up is bench evidence, not the benchmark; a failure must not kill the artifact)
+        return {"error": str(e)[:200]}
+
+
+def _serve_fleet_leg():
+    """The optional fleet leg (BENCH_SERVE_FLEET=1): a 2-replica
+    ``tools/launch.py --fleet`` job on ephemeral ports; rank 0 is
+    SIGKILLed once both replicas answer, and the leg reports whether
+    the PEER kept serving through the kill and whether the watchdog's
+    ``replica_restart`` landed in the supervisor timeline.  Never
+    raises."""
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    def healthz(port, timeout=3):
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/healthz" % port,
+                timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    tmp = tempfile.mkdtemp(prefix="mxtpu_serve_fleet_")
+    jsonl = os.path.join(tmp, "sup.jsonl")
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    base_port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["MXNET_TPU_TELEMETRY_JSONL"] = jsonl
+    here = os.path.dirname(os.path.abspath(__file__))
+    sup = None
+    try:
+        sup = subprocess.Popen(
+            [sys.executable, os.path.join(here, "tools", "launch.py"),
+             "--fleet", "-n", "2", "--restart-budget", "2",
+             "%s -m mxnet_tpu.serving --model mlp --data-shape 64 "
+             "--port %d --ladder 1,4 --window-ms 5"
+             % (sys.executable, base_port)],
+            env=env, cwd=here)
+        ports = (base_port, base_port + 1)
+        deadline = time.time() + 180
+        up = set()
+        while time.time() < deadline and len(up) < 2:
+            for p in ports:
+                try:
+                    if healthz(p)[0] == 200:
+                        up.add(p)
+                except OSError:
+                    pass
+            time.sleep(0.5)
+        if len(up) < 2:
+            return {"error": "fleet never became healthy"}
+        starts = {}
+        with open(jsonl) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "worker_start":
+                    starts[rec["rank"]] = rec["pid"]
+        os.killpg(os.getpgid(starts[0]), signal.SIGKILL)
+        peer_ok = healthz(ports[1])[0] == 200       # peer still serving
+        restarted = False
+        deadline = time.time() + 120
+        while time.time() < deadline and not restarted:
+            try:
+                st, doc = healthz(ports[0])
+                restarted = st == 200 and doc["pid"] != starts[0]
+            except OSError:
+                pass
+            time.sleep(0.5)
+        events = []
+        with open(jsonl) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") in ("replica_restart",
+                                        "worker_death"):
+                    events.append(rec["event"])
+        return {"replicas": 2, "killed_rank": 0,
+                "peer_served_through_kill": peer_ok,
+                "killed_replica_restarted": restarted,
+                "supervisor_events": events}
+    except Exception as e:  # mxlint: allow-broad-except(the fleet leg is bench evidence, not the benchmark; a failure must not kill the artifact)
+        return {"error": str(e)[:200]}
+    finally:
+        if sup is not None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(20)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+
+
 def _overlap_ab():
     """The dry-run overlap leg (``BENCH_OVERLAP_AB=1``; off by default
     — it launches two 2-process jobs, which the ci_check dry-run legs
@@ -316,7 +574,7 @@ def _step_program_eqns(trainer, batch_dict):
         return None
 
 
-def _emit(result, fusion=None, overlap=None):
+def _emit(result, fusion=None, overlap=None, serving=None):
     """Attach the standardized telemetry report (step-time percentiles,
     throughput, compile count, and the HBM block: static memory plans
     per compiled program + peak live memory_stats — the BENCH
@@ -336,6 +594,11 @@ def _emit(result, fusion=None, overlap=None):
         # the bucketed-overlap on/off A/B (BENCH_OVERLAP_AB=1,
         # tools/overlap_ab.py) — ROADMAP item 4's trajectory evidence
         result["overlap"] = overlap
+    if serving is not None:
+        # the serving-tier closed-loop load test (--serve /
+        # BENCH_SERVE=1): client p50/p99, shed rate, rung occupancy,
+        # and the zero-compile-after-warmup evidence
+        result["serving"] = serving
     cost = costdb.summary()
     cost["flushed_to"] = costdb.flush()
     result["costdb"] = cost

@@ -83,6 +83,7 @@ from . import contrib
 from . import config
 from . import predictor
 from .predictor import Predictor
+from . import serving
 
 # optional: image pipelines need PIL
 try:

@@ -243,6 +243,42 @@ _CATALOG = {
     "MXNET_TPU_MIN_WORKERS": ("1", "honored",
                               "floor for elastic shrinking in "
                               "tools/launch.py --elastic"),
+    "MXNET_TPU_FLEET": ("0", "honored",
+                        "tools/launch.py --fleet default: supervise "
+                        "workers as INDEPENDENT serving replicas — a "
+                        "dead replica is restarted alone (up to "
+                        "--restart-budget times each) while its peers "
+                        "keep serving, instead of the collective "
+                        "all-ranks teardown"),
+    # serving tier (docs/api/serving.md)
+    "MXNET_TPU_SERVE_LADDER": ("1,4,16,64", "honored",
+                               "batch-ladder rungs the serving tier "
+                               "AOT-compiles at startup; requests pad "
+                               "to the nearest rung, so the request "
+                               "path never compiles"),
+    "MXNET_TPU_SERVE_WINDOW_MS": ("5", "honored",
+                                  "batching window: how long the "
+                                  "batcher holds the oldest queued "
+                                  "request while coalescing toward "
+                                  "the largest rung"),
+    "MXNET_TPU_SERVE_QUEUE_DEPTH": ("64", "honored",
+                                    "bounded request-queue depth; a "
+                                    "submit beyond it is shed "
+                                    "immediately (queue_full)"),
+    "MXNET_TPU_SERVE_DEADLINE_MS": ("1000", "honored",
+                                    "default per-request deadline; a "
+                                    "request whose remaining deadline "
+                                    "cannot cover the estimated rung "
+                                    "wall is shed early (deadline)"),
+    "MXNET_TPU_SERVE_PORT": ("8080", "honored",
+                             "serving replica base port; each replica "
+                             "binds port+MXNET_TPU_PROCESS_ID under "
+                             "the fleet launcher"),
+    "MXNET_TPU_SERVE_COST_MODEL": ("", "honored",
+                                   "path to a fitted autotune cost "
+                                   "model used to price rung walls "
+                                   "for the deadline scheduler before "
+                                   "warm-up measurements exist"),
     "MXNET_TPU_RESHARD_RULES": ("", "honored",
                                 "match_partition_rules table "
                                 "(parallel.reshard grammar: "

@@ -16,7 +16,32 @@ from . import ndarray as nd
 from . import symbol as sym_mod
 from .context import cpu
 
-__all__ = ["Predictor"]
+__all__ = ["Predictor", "pad_batch"]
+
+
+def pad_batch(value, batch):
+    """Zero-pad ``value`` along axis 0 to ``batch`` rows.
+
+    The shared pad half of the predict path's pad-and-slice contract:
+    :meth:`Predictor.forward` pads partial batches up to its bound
+    shape (so the compiled program's avals never change — zero
+    retraces) and :meth:`Predictor.get_output` slices the pad rows
+    back off; the serving batch ladder
+    (:mod:`mxnet_tpu.serving.ladder`) uses the same helper to fill the
+    tail of a coalesced batch up to the selected rung.  Padding is
+    zeros: inference graphs are row-independent, so pad rows cost
+    compute but never leak into real rows' outputs."""
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        raise MXNetError("pad_batch needs a batched array, got a scalar")
+    rows = arr.shape[0]
+    if rows == batch:
+        return arr
+    if rows > batch:
+        raise MXNetError("pad_batch: %d rows exceed the target batch %d"
+                         % (rows, batch))
+    pad = np.zeros((batch - rows,) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
 
 
 class Predictor:
@@ -90,13 +115,48 @@ class Predictor:
             else:
                 aux[name] = nd.zeros(shape, ctx=ctx)
         self._input_names = list(input_shapes)
+        self._partial_rows = {}
         self._executor = symbol.bind(ctx, args, grad_req="null",
                                      aux_states=aux)
 
     def set_input(self, name, value):
+        """Stage one named input.  A value whose batch dim (axis 0) is
+        SMALLER than the bound shape is zero-padded up to it
+        (:func:`pad_batch`) and the pad rows are sliced off every
+        output by :meth:`get_output` — the compiled program keeps its
+        bound avals, so partial batches never retrace or recompile (the
+        executor dispatches through the AOT executable
+        ``telemetry.memory.planned_executable`` cached on first use).
+        A LARGER batch is a loud error pointing at :meth:`reshaped` /
+        the serving batch ladder instead of a silent per-shape
+        recompile."""
         if name not in self._input_names:
             raise MXNetError("unknown input %r" % name)
         arr = self._executor.arg_dict[name]
+        bound = tuple(arr.shape)
+        value = np.asarray(value)
+        if value.ndim == len(bound) and value.shape != bound:
+            if value.shape[1:] != bound[1:]:
+                raise MXNetError(
+                    "input %r: non-batch dims %r do not match the bound "
+                    "shape %r — reshape the predictor (reshaped()) for "
+                    "a different feature shape" % (name, value.shape,
+                                                   bound))
+            rows, cap = value.shape[0], bound[0]
+            if rows > cap:
+                raise MXNetError(
+                    "input %r: batch %d exceeds the bound batch %d; a "
+                    "bigger batch needs its own executable — use "
+                    "reshaped({%r: %r}) for a second handle, or the "
+                    "serving batch ladder (mxnet_tpu.serving) which "
+                    "AOT-compiles a rung per batch size"
+                    % (name, rows, cap, name, (rows,) + bound[1:]))
+            value = pad_batch(value, cap)
+            self._partial_rows[name] = rows
+        else:
+            # a full-shape restage clears the input's partial marker, so
+            # slicing state can never leak across forwards
+            self._partial_rows.pop(name, None)
         arr[:] = value
 
     def forward(self, **inputs):
@@ -106,13 +166,22 @@ class Predictor:
         return self
 
     def get_output(self, index=0):
-        return self._executor.outputs[index].asnumpy()
+        """Fetch one output; pad rows staged by a partial-batch
+        :meth:`set_input` are sliced off (the slice half of
+        pad-and-slice)."""
+        out = self._executor.outputs[index].asnumpy()
+        partial = getattr(self, "_partial_rows", None)
+        rows = min(partial.values()) if partial else None
+        if rows is not None and out.ndim and out.shape[0] >= rows:
+            out = out[:rows]
+        return out
 
     def reshape(self, input_shapes):
         # the C predict API reallocates freely on reshape
         # (c_predict_api.cc MXPredReshape), so growing inputs is
         # allowed; partial_shaping covers implied changes (an inert
         # label head's batch dim follows the data input)
+        self._partial_rows = {}
         self._executor = self._executor.reshape(allow_up_sizing=True,
                                                 partial_shaping=True,
                                                 **input_shapes)
@@ -129,6 +198,7 @@ class Predictor:
         """
         clone = object.__new__(Predictor)
         clone._symbol = self._symbol
+        clone._partial_rows = {}
         # partial reshape keeps the full input set (reference allows
         # reshaping a subset of inputs; the others keep their shapes)
         clone._input_names = list(self._input_names)

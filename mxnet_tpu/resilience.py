@@ -89,6 +89,11 @@ KNOWN_SITES = (
     # trainer poisons a data input with NaNs instead of raising — the
     # numerics detection + provenance path is the thing under test
     "numerics.nonfinite",
+    # serving batcher (serving/batcher.py, docs/api/serving.md): fires
+    # immediately before a coalesced batch is dispatched on its ladder
+    # rung — every request of the batch must fail FAST with the
+    # injected error while the scheduler keeps draining the queue
+    "serve.dispatch",
 )
 
 

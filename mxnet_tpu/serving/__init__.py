@@ -1,0 +1,41 @@
+"""Production serving tier: batch-ladder AOT on the predict path.
+
+The deployment story so far was ``predictor.py``'s one-shot
+MXPredCreate/Forward/GetOutput surface; this package turns it into a
+serving runtime in the spirit of full-program TPU compilation
+(arXiv:1810.09868 — compile everything ahead of time, dispatch only):
+
+* :class:`~mxnet_tpu.serving.ladder.BatchLadder` — AOT-compiles the
+  model at a configured ladder of batch sizes at STARTUP via
+  ``telemetry.memory.planned_executable`` (each rung picks up the
+  tuned-kernel cache and the committed ``graph_plan`` entry, whose
+  digest is batch-size-independent by design), memlive-budget-checks
+  the largest rung BEFORE any compile, and never compiles again:
+  partial batches pad to the nearest rung and slice outputs
+  (:func:`mxnet_tpu.predictor.pad_batch`);
+* :class:`~mxnet_tpu.serving.batcher.Batcher` — a thread-safe request
+  queue that coalesces requests into the largest rung that fills
+  within a batching window, schedules earliest-deadline-first, and
+  sheds load EARLY (bounded queue depth; a request whose remaining
+  deadline cannot cover the estimated rung wall is refused before
+  burning TPU time);
+* :class:`~mxnet_tpu.serving.server.Server` — the stdlib HTTP front
+  door (``POST /predict``, ``GET /healthz``, the Prometheus
+  ``/metrics`` exposition), run standalone or as a multi-replica fleet
+  under ``tools/launch.py --fleet`` supervision (a killed replica is
+  restarted alone; its in-flight requests fail fast, peers keep
+  serving).
+
+``python -m mxnet_tpu.serving --model mlp`` starts a replica on a zoo
+model; ``tools/serve_top.py`` names the hot rung and the dominant shed
+reason from the exported metrics; ``bench.py --serve`` is the
+closed-loop load test.  See docs/api/serving.md.
+"""
+from __future__ import annotations
+
+from .ladder import BatchLadder, ladder_rungs, DEFAULT_RUNGS
+from .batcher import Batcher, RequestShed
+from .server import Server
+
+__all__ = ["BatchLadder", "ladder_rungs", "DEFAULT_RUNGS",
+           "Batcher", "RequestShed", "Server"]

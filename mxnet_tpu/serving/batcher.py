@@ -1,0 +1,352 @@
+"""Continuous batcher: coalescing, EDF deadlines, load shedding.
+
+The request path between the HTTP front door and the batch ladder:
+
+* **coalescing** — requests accumulate in a bounded queue; the single
+  scheduler thread waits at most one batching window
+  (``MXNET_TPU_SERVE_WINDOW_MS``, anchored at the oldest queued
+  request) for the largest rung to fill, then dispatches the largest
+  rung the queued rows reach (partial fill pads —
+  ``mxtpu_serve_rung_occupancy`` records the real-rows fraction);
+* **deadline scheduling** — earliest-deadline-first within the queue;
+  before dispatch each selected request's remaining deadline is
+  checked against the ladder's estimated rung wall
+  (:meth:`~mxnet_tpu.serving.ladder.BatchLadder.estimate_wall`) and
+  hopeless requests are shed THEN, not after burning TPU time;
+* **load shedding** — a submit over the bounded depth
+  (``MXNET_TPU_SERVE_QUEUE_DEPTH``) is refused immediately with
+  :class:`RequestShed` (``reason="queue_full"``); the deadline check
+  sheds with ``reason="deadline"``.  Sheds count on
+  ``mxtpu_serve_shed_total`` and leave a ``request_shed`` flight
+  event; dispatches leave ``rung_dispatch``;
+* **fail fast** — a dispatch error (the ``serve.dispatch`` chaos seam
+  included) fails every request of that batch immediately and the
+  scheduler moves on; the queue is never wedged behind a poisoned
+  batch.
+
+Per-request latency lands in the ``mxtpu_serve_request_seconds``
+histogram split into queue/pad/dispatch/total segments.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from ..predictor import pad_batch
+
+__all__ = ["Batcher", "RequestShed"]
+
+
+class RequestShed(MXNetError):
+    """A request refused by the load shedder (never dispatched).
+
+    ``reason``: ``"queue_full"`` (bounded queue at depth) or
+    ``"deadline"`` (remaining deadline below the estimated rung wall).
+    The serving front door maps this to HTTP 503."""
+
+    def __init__(self, reason, detail):
+        super().__init__("request shed (%s): %s" % (reason, detail))
+        self.reason = reason
+
+
+def _env_float(name, default):
+    try:
+        v = float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+class _Request:
+    __slots__ = ("rid", "feed", "rows", "deadline", "enqueue_t",
+                 "dequeue_t", "done", "outputs", "error")
+
+    def __init__(self, rid, feed, rows, deadline, now):
+        self.rid = rid
+        self.feed = feed
+        self.rows = rows
+        self.deadline = deadline
+        self.enqueue_t = now
+        self.dequeue_t = None
+        self.done = threading.Event()
+        self.outputs = None
+        self.error = None
+
+
+class Batcher:
+    """Thread-safe request queue + single scheduler thread over a
+    :class:`~mxnet_tpu.serving.ladder.BatchLadder` (or any object with
+    ``rungs``/``max_rung``/``input_names``/``pick_rung``/
+    ``estimate_wall``/``observe_wall``/``dispatch`` — the unit tests
+    drive the scheduler with a fake ladder, no accelerator needed).
+
+    ``window_ms``/``queue_depth``/``default_deadline_ms`` default to
+    the ``MXNET_TPU_SERVE_*`` knobs."""
+
+    def __init__(self, ladder, window_ms=None, queue_depth=None,
+                 default_deadline_ms=None, start=True):
+        from .. import telemetry
+        from ..telemetry.catalog import OCCUPANCY_BUCKETS
+        self._ladder = ladder
+        self._window = (window_ms if window_ms is not None else
+                        _env_float("MXNET_TPU_SERVE_WINDOW_MS", 5.0)) \
+            / 1e3
+        self._depth = int(queue_depth if queue_depth is not None else
+                          _env_float("MXNET_TPU_SERVE_QUEUE_DEPTH", 64))
+        self._deadline = (default_deadline_ms if default_deadline_ms
+                          is not None else
+                          _env_float("MXNET_TPU_SERVE_DEADLINE_MS",
+                                     1000.0)) / 1e3
+        self._cv = threading.Condition()
+        self._pending = []
+        self._stopped = False
+        self._ids = itertools.count(1)
+        # instruments (created once; .labels children cached per use
+        # site below)
+        self._m_requests = telemetry.counter("mxtpu_serve_requests_total")
+        self._m_shed = telemetry.counter("mxtpu_serve_shed_total")
+        self._m_rung = telemetry.counter(
+            "mxtpu_serve_rung_dispatch_total")
+        self._m_latency = telemetry.histogram(
+            "mxtpu_serve_request_seconds")
+        self._m_occupancy = telemetry.histogram(
+            "mxtpu_serve_rung_occupancy", buckets=OCCUPANCY_BUCKETS)
+        self._m_depth = telemetry.gauge("mxtpu_serve_queue_depth")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="mxtpu-serve-batcher")
+        if start:
+            self._thread.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self, timeout=5.0):
+        """Stop the scheduler; queued requests fail with a stopped
+        error."""
+        with self._cv:
+            self._stopped = True
+            pending, self._pending = self._pending, []
+            self._cv.notify_all()
+        for req in pending:
+            req.error = MXNetError("batcher stopped")
+            req.done.set()
+        self._thread.join(timeout)
+
+    @property
+    def alive(self):
+        return self._thread.is_alive() and not self._stopped
+
+    def queue_depth(self):
+        with self._cv:
+            return len(self._pending)
+
+    # -------------------------------------------------------------- submit
+    def submit(self, inputs, deadline_ms=None, timeout=None):
+        """Block until the request is served; returns the list of
+        output arrays (sliced to this request's rows).
+
+        ``inputs``: name -> array whose axis 0 is the request's batch
+        (1..max_rung rows; every input must agree).  ``deadline_ms``:
+        remaining deadline from NOW (default
+        ``MXNET_TPU_SERVE_DEADLINE_MS``).  Raises :class:`RequestShed`
+        when shed, or re-raises the dispatch error (fail fast — an
+        injected ``serve.dispatch`` fault surfaces here)."""
+        feed, rows = self._validate(inputs)
+        now = time.monotonic()
+        ddl = now + (deadline_ms / 1e3 if deadline_ms else self._deadline)
+        req = _Request(next(self._ids), feed, rows, ddl, now)
+        with self._cv:
+            if self._stopped:
+                raise MXNetError("batcher stopped")
+            if len(self._pending) >= self._depth:
+                self._count_shed("queue_full", req,
+                                 "queue depth %d" % self._depth)
+                raise RequestShed(
+                    "queue_full", "queue at bounded depth %d"
+                    % self._depth)
+            # shed EARLY: even alone in the smallest rung this request
+            # cannot finish inside its deadline
+            min_wall = self._ladder.estimate_wall(
+                self._ladder.pick_rung(rows) or self._ladder.max_rung)
+            if ddl - now < min_wall:
+                self._count_shed("deadline", req,
+                                 "deadline %.1fms < estimated wall "
+                                 "%.1fms" % ((ddl - now) * 1e3,
+                                             min_wall * 1e3))
+                raise RequestShed(
+                    "deadline", "remaining deadline %.1fms cannot cover "
+                    "the estimated rung wall %.1fms"
+                    % ((ddl - now) * 1e3, min_wall * 1e3))
+            self._pending.append(req)
+            self._m_depth.set(len(self._pending))
+            self._cv.notify_all()
+        wait = timeout if timeout is not None else \
+            max(0.05, ddl - now) + 4.0 * max(
+                0.025, self._ladder.estimate_wall(self._ladder.max_rung))
+        if not req.done.wait(wait):
+            raise MXNetError("request %d timed out after %.1fs in the "
+                             "batcher" % (req.rid, wait))
+        if req.error is not None:
+            raise req.error
+        return req.outputs
+
+    def _validate(self, inputs):
+        names = list(self._ladder.input_names)
+        feed, rows = {}, None
+        for n in names:
+            if n not in inputs:
+                raise MXNetError("missing input %r (serving inputs: %s)"
+                                 % (n, names))
+            arr = np.asarray(inputs[n],
+                             dtype=self._ladder.input_dtype(n))
+            tail = tuple(self._ladder.input_tail(n))
+            if arr.shape == tail:
+                arr = arr[None]          # one unbatched row
+            if arr.ndim != len(tail) + 1 or tuple(arr.shape[1:]) != tail:
+                raise MXNetError(
+                    "input %r: expected rows of shape %r, got %r"
+                    % (n, tail, tuple(arr.shape)))
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise MXNetError(
+                    "inputs disagree on batch rows (%d vs %d)"
+                    % (rows, arr.shape[0]))
+            feed[n] = arr
+        if rows < 1:
+            raise MXNetError("empty request (0 rows)")
+        if rows > self._ladder.max_rung:
+            raise MXNetError(
+                "request rows %d exceed the largest ladder rung %d — "
+                "split the request or extend MXNET_TPU_SERVE_LADDER"
+                % (rows, self._ladder.max_rung))
+        return feed, rows
+
+    # ------------------------------------------------------------- shedding
+    def _count_shed(self, reason, req, detail):
+        from ..telemetry import flight
+        self._m_shed.labels(reason=reason).inc()
+        self._m_requests.labels(outcome="shed").inc()
+        flight.record("request_shed", reason=reason, rows=req.rows,
+                      waited_ms=round(
+                          (time.monotonic() - req.enqueue_t) * 1e3, 3),
+                      detail=detail)
+
+    def _shed_queued(self, req, reason, detail):
+        self._count_shed(reason, req, detail)
+        req.error = RequestShed(reason, detail)
+        req.done.set()
+
+    # ------------------------------------------------------------ scheduler
+    def _run(self):
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            if batch:
+                self._dispatch(batch)
+
+    def _collect(self):
+        """Wait out the batching window and select the EDF batch.
+        Returns None on stop, possibly-empty list otherwise."""
+        with self._cv:
+            while not self._pending and not self._stopped:
+                self._cv.wait()
+            if self._stopped:
+                return None
+            # window anchored at the OLDEST queued request: it has
+            # already waited, so its window credit is spent first
+            window_end = min(r.enqueue_t for r in self._pending) \
+                + self._window
+            while (not self._stopped
+                   and sum(r.rows for r in self._pending)
+                   < self._ladder.max_rung):
+                left = window_end - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            if self._stopped:
+                return None
+            # EDF: most urgent first, into the largest rung that the
+            # queue reaches; overflow stays queued for the next round
+            self._pending.sort(key=lambda r: r.deadline)
+            batch, rows = [], 0
+            for req in list(self._pending):
+                if rows + req.rows > self._ladder.max_rung:
+                    break
+                batch.append(req)
+                rows += req.rows
+            for req in batch:
+                self._pending.remove(req)
+                req.dequeue_t = time.monotonic()
+            self._m_depth.set(len(self._pending))
+            return batch
+
+    def _dispatch(self, batch):
+        from ..telemetry import flight
+        from .. import resilience
+        # deadline feasibility at the LAST moment before TPU time is
+        # spent; shedding shrinks the batch, which can shrink the rung
+        # and the estimate, so iterate to a fixed point
+        while batch:
+            rows = sum(r.rows for r in batch)
+            rung = self._ladder.pick_rung(rows)
+            est = self._ladder.estimate_wall(rung)
+            now = time.monotonic()
+            hopeless = [r for r in batch if r.deadline - now < est]
+            if not hopeless:
+                break
+            for req in hopeless:
+                batch.remove(req)
+                self._shed_queued(
+                    req, "deadline",
+                    "%.1fms left < estimated rung-%d wall %.1fms"
+                    % ((req.deadline - now) * 1e3, rung, est * 1e3))
+        if not batch:
+            return
+        t_pad = time.monotonic()
+        feed = {}
+        for n in self._ladder.input_names:
+            stacked = np.concatenate([r.feed[n] for r in batch], axis=0) \
+                if len(batch) > 1 else batch[0].feed[n]
+            feed[n] = pad_batch(stacked, rung)
+        t_disp = time.monotonic()
+        try:
+            resilience.fault_point("serve.dispatch")
+            outs = self._ladder.dispatch(rung, feed)
+        except BaseException as e:  # mxlint: allow-broad-except(fail fast: every request of a poisoned batch gets THE error and the scheduler keeps draining — a wedged queue would turn one bad dispatch into an outage)
+            for req in batch:
+                req.error = e if isinstance(e, Exception) else \
+                    MXNetError("dispatch aborted: %r" % (e,))
+                req.done.set()
+            self._m_requests.labels(outcome="error").inc(len(batch))
+            flight.record("rung_dispatch", rung=rung, rows=rows,
+                          requests=len(batch), error=str(e)[:200])
+            if not isinstance(e, Exception):
+                raise
+            return
+        t_done = time.monotonic()
+        wall = t_done - t_disp
+        self._ladder.observe_wall(rung, wall)
+        self._m_rung.labels(rung=str(rung)).inc()
+        self._m_occupancy.labels(rung=str(rung)).observe(
+            rows / float(rung))
+        flight.record("rung_dispatch", rung=rung, rows=rows,
+                      requests=len(batch),
+                      wall_ms=round(wall * 1e3, 3))
+        lat = self._m_latency
+        off = 0
+        for req in batch:
+            req.outputs = [o[off:off + req.rows] if getattr(o, "ndim", 0)
+                           else o for o in outs]
+            off += req.rows
+            req.done.set()
+            lat.labels(segment="queue").observe(
+                req.dequeue_t - req.enqueue_t)
+            lat.labels(segment="pad").observe(t_disp - t_pad)
+            lat.labels(segment="dispatch").observe(wall)
+            lat.labels(segment="total").observe(t_done - req.enqueue_t)
+        self._m_requests.labels(outcome="ok").inc(len(batch))

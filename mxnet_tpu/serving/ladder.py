@@ -1,0 +1,304 @@
+"""Batch ladder: startup AOT compilation of the predict path.
+
+One :class:`~mxnet_tpu.predictor.Predictor` handle per batch size is
+the documented reference pattern (MXPredReshape hands out independent
+handles over shared weights); the ladder builds the whole set at
+startup and owns the "zero compiles in the request path" contract:
+
+* every rung dispatches through the executor's AOT cache
+  (``telemetry.memory.planned_executable`` — the same compile that
+  registers the rung's memory plan runs the requests), so after
+  :meth:`BatchLadder.warm_up` the ``mxtpu_compile_total`` counter
+  stays flat under traffic;
+* the LARGEST rung is budget-checked by the static liveness analyzer
+  (``analysis.memlive``, MXG017) BEFORE anything compiles — a ladder
+  that cannot fit fails at startup with the per-category breakdown,
+  not with a mid-traffic OOM;
+* rung walls are priced for the deadline scheduler: a fitted cost
+  model (``MXNET_TPU_SERVE_COST_MODEL`` → ``autotune.model``) seeds
+  the estimate from the compiled program's flops/bytes, warm-up
+  measurements replace it, and every live dispatch folds into an EWMA
+  (:meth:`BatchLadder.observe_wall`).
+
+The ladder itself is NOT thread-safe — one executor dispatches at a
+time.  The :class:`~mxnet_tpu.serving.batcher.Batcher` owns it from a
+single scheduler thread.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from ..predictor import Predictor, pad_batch
+
+__all__ = ["BatchLadder", "ladder_rungs", "DEFAULT_RUNGS"]
+
+log = logging.getLogger(__name__)
+
+#: default rung set: powers-of-4 ladder (MXNET_TPU_SERVE_LADDER overrides)
+DEFAULT_RUNGS = (1, 4, 16, 64)
+
+#: EWMA weight of a newly observed dispatch wall
+_EWMA_ALPHA = 0.2
+
+
+def ladder_rungs(spec=None):
+    """Parse a ladder spec (``"1,4,16,64"``) into a sorted tuple of
+    distinct positive batch sizes.  ``spec=None`` reads
+    ``MXNET_TPU_SERVE_LADDER``; empty/unset falls back to
+    :data:`DEFAULT_RUNGS`."""
+    if spec is None:
+        spec = os.environ.get("MXNET_TPU_SERVE_LADDER", "")
+    if isinstance(spec, (tuple, list)):
+        rungs = tuple(sorted({int(r) for r in spec}))
+    else:
+        toks = [t for t in str(spec).replace(";", ",").split(",")
+                if t.strip()]
+        if not toks:
+            return DEFAULT_RUNGS
+        try:
+            rungs = tuple(sorted({int(t) for t in toks}))
+        except ValueError:
+            raise MXNetError(
+                "bad ladder spec %r (MXNET_TPU_SERVE_LADDER): expected "
+                "comma-separated batch sizes like '1,4,16,64'" % (spec,))
+    if not rungs or rungs[0] < 1:
+        raise MXNetError("ladder rungs must be positive, got %r"
+                         % (rungs,))
+    return rungs
+
+
+class BatchLadder:
+    """AOT-compiled predictors at a ladder of batch sizes.
+
+    ``predictor``: a bound :class:`~mxnet_tpu.predictor.Predictor` (its
+    own batch size need not be a rung — each rung is an independent
+    ``reshaped()`` handle over the shared weights).  ``rungs``: ladder
+    spec (see :func:`ladder_rungs`).  ``budget_check``: run the memlive
+    MXG017 gate on the largest rung before any compile (on by
+    default; it is skipped silently when no device budget is armed —
+    see ``MXNET_TPU_MEMORY_BUDGET`` / ``MXNET_TPU_HBM_LIMIT_BYTES``).
+    ``warm``: compile + measure every rung now (pass ``False`` to defer
+    to an explicit :meth:`warm_up`)."""
+
+    def __init__(self, predictor, rungs=None, budget_check=True,
+                 warm=True):
+        if not isinstance(predictor, Predictor):
+            raise MXNetError("BatchLadder needs a Predictor, got %r"
+                             % type(predictor).__name__)
+        self._rungs = ladder_rungs(rungs)
+        self._input_names = list(predictor._input_names)
+        # per-input trailing (non-batch) dims + dtype from the bound
+        # executor: the rung handles only ever change axis 0
+        self._tails, self._dtypes = {}, {}
+        for n in self._input_names:
+            arr = predictor._executor.arg_dict[n]
+            self._tails[n] = tuple(arr.shape)[1:]
+            self._dtypes[n] = np.dtype(arr.dtype)
+        if budget_check:
+            self._budget_gate(predictor, self._rungs[-1])
+        self._preds = {}
+        for r in self._rungs:
+            shapes = {n: (r,) + self._tails[n] for n in self._input_names}
+            self._preds[r] = predictor.reshaped(shapes)
+        self._wall = {}          # rung -> EWMA wall estimate (seconds)
+        self._cost_est = {}      # rung -> cost-model estimate (seconds)
+        self._model = self._load_cost_model()
+        self._warmed = False
+        if warm:
+            self.warm_up()
+
+    # ------------------------------------------------------------ properties
+    @property
+    def rungs(self):
+        """The sorted rung tuple."""
+        return self._rungs
+
+    @property
+    def max_rung(self):
+        return self._rungs[-1]
+
+    @property
+    def input_names(self):
+        return list(self._input_names)
+
+    @property
+    def warmed(self):
+        """True once every rung has been AOT-compiled and measured."""
+        return self._warmed
+
+    def input_tail(self, name):
+        """Non-batch dims of one input (the shape a request row must
+        have)."""
+        return self._tails[name]
+
+    def input_dtype(self, name):
+        return self._dtypes[name]
+
+    # ------------------------------------------------------------ budget gate
+    @staticmethod
+    def _budget_gate(predictor, rung):
+        """Static-liveness (MXG017) check of the LARGEST rung before
+        any compile: the whole ladder shares weights, so the biggest
+        rung's predicted peak bounds the ladder's footprint.  Analysis
+        failures degrade to a debug log (the gate is advisory
+        infrastructure); an actual budget excess raises."""
+        findings = []
+        try:
+            from ..analysis import memlive
+            from ..analysis.verifier import Report
+            shapes = {}
+            for n in predictor._input_names:
+                bound = tuple(predictor._executor.arg_dict[n].shape)
+                shapes[n] = (rung,) + bound[1:]
+            rep = Report()
+            memlive.check_memory(predictor._symbol, shapes, report=rep,
+                                 is_train=False, record=True,
+                                 program="serve.rung%d" % rung)
+            findings = [str(d) for d in rep if d.rule == "MXG017"]
+        except MXNetError:
+            raise
+        except Exception as e:  # mxlint: allow-broad-except(the static analyzer may not cover every op; an unanalyzable graph skips the gate rather than blocking serving — the dispatch-time check_budget still guards the compile)
+            log.debug("serving ladder: memlive budget gate skipped "
+                      "(%s: %s)", type(e).__name__, e)
+        if findings:
+            raise MXNetError(
+                "serving ladder refused: largest rung %d exceeds the "
+                "armed HBM budget before compile (shrink "
+                "MXNET_TPU_SERVE_LADDER or raise "
+                "MXNET_TPU_MEMORY_BUDGET):\n  %s"
+                % (rung, "\n  ".join(findings)))
+
+    # ------------------------------------------------------------ cost model
+    @staticmethod
+    def _load_cost_model():
+        path = os.environ.get("MXNET_TPU_SERVE_COST_MODEL", "")
+        if not path:
+            return None
+        try:
+            from ..autotune.model import load_model
+            return load_model(path)
+        except Exception as e:  # mxlint: allow-broad-except(a stale/foreign model file must not stop serving; the ladder falls back to measured walls)
+            log.warning("serving ladder: cost model %r unusable (%s); "
+                        "pricing rungs from warm-up measurements", path, e)
+            return None
+
+    def _price_rung(self, rung):
+        """Cost-model estimate of one rung's wall from the compiled
+        executable's flops/bytes (None when no model is configured or
+        the analyses are unavailable)."""
+        if self._model is None:
+            return None
+        try:
+            from ..telemetry import memory as tmem
+            exes = getattr(self._preds[rung]._executor, "_aot_exes", {})
+            for (prog, _fid), exe in exes.items():
+                if prog == "executor.forward":
+                    ca = tmem.cost_analysis_of(exe)
+                    if ca and ca.get("flops"):
+                        est = float(self._model.predict(
+                            flops=ca["flops"],
+                            bytes_accessed=ca.get("bytes_accessed", 0)))
+                        if est > 0:
+                            return est
+        except Exception as e:  # mxlint: allow-broad-except(cost pricing is an estimate source, never a failure source)
+            log.debug("serving ladder: cost pricing of rung %d failed "
+                      "(%s)", rung, e)
+        return None
+
+    # -------------------------------------------------------------- warm-up
+    def warm_up(self):
+        """Compile and measure every rung (ascending).  The first
+        forward per rung triggers the one AOT compile
+        (``planned_executable`` registers + budget-checks its memory
+        plan); the second measures the steady-state wall that seeds the
+        scheduler's estimate.  Returns {rung: wall_seconds}."""
+        from ..telemetry import compile as _compile
+        _compile.install()
+        for r in self._rungs:
+            feed = {n: np.zeros((r,) + self._tails[n],
+                                dtype=self._dtypes[n])
+                    for n in self._input_names}
+            pred = self._preds[r]
+            pred.forward(**feed)
+            pred.get_output(0)              # close the compile dispatch
+            t0 = time.perf_counter()
+            pred.forward(**feed)
+            pred.get_output(0)
+            wall = time.perf_counter() - t0
+            self._wall[r] = wall
+            est = self._price_rung(r)
+            if est is not None:
+                self._cost_est[r] = est
+            log.info("serving ladder: rung %d warm (wall %.2f ms%s)",
+                     r, wall * 1e3,
+                     ", cost model %.2f ms" % (est * 1e3)
+                     if est is not None else "")
+        self._warmed = True
+        return dict(self._wall)
+
+    # ------------------------------------------------------------- dispatch
+    def pick_rung(self, rows):
+        """Smallest rung that fits ``rows`` (None when rows exceed the
+        largest rung — the caller splits or refuses)."""
+        for r in self._rungs:
+            if rows <= r:
+                return r
+        return None
+
+    def estimate_wall(self, rung):
+        """Scheduler-facing wall estimate for one rung: the measured
+        EWMA when available, else the cost-model price, else the
+        largest known wall (conservative — an unknown rung must not
+        look free to the deadline check)."""
+        if rung in self._wall:
+            return self._wall[rung]
+        if rung in self._cost_est:
+            return self._cost_est[rung]
+        known = list(self._wall.values()) or list(self._cost_est.values())
+        return max(known) if known else 0.0
+
+    def observe_wall(self, rung, wall):
+        """Fold a measured dispatch wall into the rung's EWMA."""
+        prev = self._wall.get(rung)
+        self._wall[rung] = wall if prev is None else \
+            (1.0 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * wall
+
+    def dispatch(self, rung, feed):
+        """Run one batch at ``rung``.  ``feed``: name -> array with
+        EXACTLY ``rung`` rows (the batcher pads with
+        :func:`~mxnet_tpu.predictor.pad_batch` before calling).
+        Returns the list of output arrays (all ``rung`` rows — the
+        caller slices per request).  Never compiles after warm-up: the
+        executor dispatches the cached AOT executable."""
+        if rung not in self._preds:
+            raise MXNetError("no rung %r in ladder %r"
+                             % (rung, self._rungs))
+        pred = self._preds[rung]
+        for n in self._input_names:
+            arr = feed[n]
+            if arr.shape[0] != rung:
+                arr = pad_batch(arr, rung)
+            pred.set_input(n, arr)
+        pred._partial_rows.clear()      # the batcher owns slicing
+        pred._executor.forward(is_train=False)
+        outs = pred._executor.outputs
+        return [outs[i].asnumpy() for i in range(len(outs))]
+
+    def describe(self):
+        """Structured ladder state for /healthz and serve_top."""
+        return {
+            "rungs": list(self._rungs),
+            "warmed": self._warmed,
+            "wall_ms": {str(r): round(self._wall[r] * 1e3, 3)
+                        for r in sorted(self._wall)},
+            "cost_model_ms": {str(r): round(self._cost_est[r] * 1e3, 3)
+                              for r in sorted(self._cost_est)},
+            "inputs": {n: {"tail": list(self._tails[n]),
+                           "dtype": str(self._dtypes[n])}
+                       for n in self._input_names},
+        }

@@ -1,0 +1,169 @@
+"""Stdlib HTTP front door for the serving tier.
+
+Endpoints:
+
+* ``POST /predict`` — body ``{"<input>": <nested list>, ...}`` plus an
+  optional ``"deadline_ms"``; every other key is a model input (rows
+  along axis 0; a single unbatched row is accepted).  Replies 200
+  ``{"outputs": [...], "rows": N, "wall_ms": W}``, 503
+  ``{"shed": reason}`` when the load shedder refused the request, 500
+  ``{"error": msg}`` when the dispatch failed (fail fast — the chaos
+  seam surfaces here);
+* ``GET /healthz`` — 200 with ladder/queue state while the batcher
+  thread is alive, 503 once it stopped (the fleet watchdog's liveness
+  contract);
+* ``GET /metrics`` — the shared Prometheus exposition
+  (``telemetry.exporters.render_prom``), the ``tools/serve_top.py``
+  input.
+
+One :class:`Server` per replica; ``tools/launch.py --fleet`` runs N of
+them with per-rank ports (``--port`` + ``MXNET_TPU_PROCESS_ID``, the
+same offset rule the telemetry exporter uses).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+from .batcher import Batcher, RequestShed
+
+__all__ = ["Server", "serve_port"]
+
+
+def serve_port(port=None):
+    """The replica's port: explicit ``port``, else
+    ``MXNET_TPU_SERVE_PORT``, offset by the launcher rank
+    (``MXNET_TPU_PROCESS_ID``) so co-located replicas never race for
+    one bind."""
+    if port is None:
+        try:
+            port = int(os.environ.get("MXNET_TPU_SERVE_PORT", "8080"))
+        except ValueError:
+            port = 8080
+    try:
+        rank = int(os.environ.get("MXNET_TPU_PROCESS_ID", "0"))
+    except ValueError:
+        rank = 0
+    return port + rank if port > 0 else port
+
+
+class Server:
+    """HTTP server over a :class:`~mxnet_tpu.serving.batcher.Batcher`.
+
+    ``batcher=None`` builds one from ``ladder`` with the env-default
+    knobs.  ``port=0`` binds an ephemeral port (tests); read it back
+    from :attr:`port`.  The HTTP threads only ever call
+    ``batcher.submit`` — all model work stays on the scheduler
+    thread."""
+
+    def __init__(self, ladder, batcher=None, port=None):
+        self._ladder = ladder
+        self._batcher = batcher or Batcher(ladder)
+        self._httpd = self._build(serve_port(port))
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def batcher(self):
+        return self._batcher
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        """Serve from a daemon thread (tests / in-process benches)."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="mxtpu-serve-http")
+            self._thread.start()
+        return self
+
+    def serve_forever(self):
+        """Serve on the calling thread (the replica main loop)."""
+        self._httpd.serve_forever()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._batcher.close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    # ------------------------------------------------------------- handler
+    def _build(self, port):
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _send(self, doc, status=200,
+                      ctype="application/json"):
+                body = doc if isinstance(doc, bytes) else \
+                    json.dumps(doc).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.rstrip("/") or "/"
+                if path in ("/", "/healthz"):
+                    ok = server._batcher.alive
+                    self._send({
+                        "status": "ok" if ok else "stopped",
+                        "pid": os.getpid(),
+                        "queue_depth": server._batcher.queue_depth(),
+                        "ladder": server._ladder.describe(),
+                    }, status=200 if ok else 503)
+                    return
+                if path == "/metrics":
+                    from ..telemetry import render_prom
+                    self._send(render_prom().encode("utf-8"),
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    return
+                self.send_error(404)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/predict":
+                    self.send_error(404)
+                    return
+                t0 = time.perf_counter()
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise MXNetError("predict body must be a JSON "
+                                         "object of model inputs")
+                    deadline_ms = doc.pop("deadline_ms", None)
+                    outs = server._batcher.submit(
+                        doc, deadline_ms=deadline_ms)
+                except RequestShed as e:
+                    self._send({"shed": e.reason, "error": str(e)},
+                               status=503)
+                    return
+                except Exception as e:  # mxlint: allow-broad-except(the front door maps EVERY failure — bad JSON, missing inputs, an injected chaos fault — to a fail-fast 4xx/5xx reply; an unhandled exception would silently drop the connection instead)
+                    status = 400 if isinstance(e, (ValueError, KeyError)) \
+                        else 500
+                    self._send({"error": str(e)[:500]}, status=status)
+                    return
+                rows = int(np.asarray(outs[0]).shape[0]) if outs else 0
+                self._send({
+                    "outputs": [np.asarray(o).tolist() for o in outs],
+                    "rows": rows,
+                    "wall_ms": round((time.perf_counter() - t0) * 1e3,
+                                     3),
+                })
+
+            def log_message(self, fmt, *args):
+                pass        # request logs ride the metrics, not stderr
+
+        return ThreadingHTTPServer(("0.0.0.0", int(port)), _Handler)

@@ -320,7 +320,36 @@ CATALOG = {
     "mxtpu_capture_total": (COUNTER, ("trigger",),
                             "on-demand live capture windows started "
                             "(trigger=signal|http|api)"),
+    # ------------------------------------- serving tier (mxnet_tpu.serving)
+    "mxtpu_serve_requests_total": (COUNTER, ("outcome",),
+                                   "predict requests finished "
+                                   "(outcome=ok|shed|error)"),
+    "mxtpu_serve_shed_total": (COUNTER, ("reason",),
+                               "requests refused by the load shedder "
+                               "(reason=queue_full — the bounded queue "
+                               "was at depth; deadline — the remaining "
+                               "deadline could not cover the estimated "
+                               "rung wall)"),
+    "mxtpu_serve_rung_dispatch_total": (COUNTER, ("rung",),
+                                        "coalesced batches dispatched "
+                                        "per ladder rung (rung=batch "
+                                        "size)"),
+    "mxtpu_serve_request_seconds": (HISTOGRAM, ("segment",),
+                                    "per-request serving latency split "
+                                    "(segment=queue|pad|dispatch|"
+                                    "total)"),
+    "mxtpu_serve_rung_occupancy": (HISTOGRAM, ("rung",),
+                                   "real-request rows divided by rung "
+                                   "batch size per dispatched batch "
+                                   "(1.0 = the rung left with no pad "
+                                   "rows)"),
+    "mxtpu_serve_queue_depth": (GAUGE, (),
+                                "predict requests currently queued in "
+                                "the batcher"),
 }
+
+# rung-occupancy fractions (histogram buckets): fill ratios up to full
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 
 
 def selfcheck():

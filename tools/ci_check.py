@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Seventeen stages, all of which must be clean:
+Eighteen stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-007; pragmas with reasons are the only
@@ -146,6 +146,19 @@ Seventeen stages, all of which must be clean:
     ``mxtpu_remat_candidate_bytes`` / ``mxtpu_memlive_drift_ratio``
     metrics automatically.)
 
+18. **serving gate** — the production predict path
+    (``mxnet_tpu/serving/``, docs/api/serving.md): a 1-replica
+    ``tools/launch.py --fleet`` job serving the tiny zoo MLP behind
+    the batch ladder must answer ``/healthz``; a concurrent burst must
+    COALESCE (``mxtpu_serve_rung_dispatch_total`` on a rung > 1) and a
+    deadline-starved overload must SHED
+    (``mxtpu_serve_shed_total`` > 0) while ok requests keep landing;
+    ``tools/serve_top.py --json`` must emit a strict-parseable
+    ``mxtpu-servetop/1`` document naming the hot rung; and SIGKILLing
+    the replica mid-fleet must end with the watchdog's
+    ``replica_restart`` in the supervisor timeline and ``/healthz``
+    green again under a NEW pid — the fleet availability contract.
+
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
 """
@@ -180,7 +193,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/17] mxlint: %d finding(s) over %s"
+        say("ci_check[1/18] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -189,7 +202,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/17] registry selfcheck: %d problem(s)"
+        say("ci_check[2/18] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -203,14 +216,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/17] verify model %-22s %s" % (name, status))
+            say("ci_check[3/18] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/17] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/18] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -218,7 +231,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/17] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/18] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -226,7 +239,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/17] distview smoke: %d problem(s)"
+        say("ci_check[6/18] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -234,14 +247,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/17] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/18] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/17] perf ground truth: %d problem(s)"
+        say("ci_check[8/18] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -249,7 +262,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/17] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/18] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -257,7 +270,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/17] reshard gate: %d problem(s)"
+        say("ci_check[10/18] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -266,7 +279,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/17] numerics gate: %d problem(s)"
+        say("ci_check[11/18] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -275,7 +288,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/17] plan search: %d problem(s)"
+        say("ci_check[12/18] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
@@ -284,7 +297,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 13: SPMD gate (seeded-defect discrimination per
         # MXG011-016 rule + clean sweep over zoo and composed configs)
         problems = spmd_check(repo_root)
-        say("ci_check[13/17] spmd gate: %d problem(s)" % len(problems))
+        say("ci_check[13/18] spmd gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("spmd: %s" % p)
             say("  " + p)
@@ -292,7 +305,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 14: io observability gate (seeded slow stage ->
         # io_top --json names it; flight + counter verdicts agree)
         problems = ioview_check(repo_root)
-        say("ci_check[14/17] io observability: %d problem(s)"
+        say("ci_check[14/18] io observability: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("ioview: %s" % p)
@@ -302,7 +315,7 @@ def run(repo_root=_ROOT, out=None):
         # collective wait strictly smaller at bit-identical params,
         # bucket flight events parseable)
         problems = overlap_check(repo_root)
-        say("ci_check[15/17] overlap gate: %d problem(s)"
+        say("ci_check[15/18] overlap gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("overlap: %s" % p)
@@ -312,7 +325,7 @@ def run(repo_root=_ROOT, out=None):
         # mid-epoch -> world-size-1 resume with no sample dropped or
         # doubled; seeded slow producer -> backpressure depth raise)
         problems = io_resume_check(repo_root)
-        say("ci_check[16/17] io resume gate: %d problem(s)"
+        say("ci_check[16/18] io resume gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("io_resume: %s" % p)
@@ -322,10 +335,19 @@ def run(repo_root=_ROOT, out=None):
         # vs aval-compiled XLA plans; seeded MXG017/019/020/021
         # fixtures; mem_top --json strict parse)
         problems = memlive_check(repo_root)
-        say("ci_check[17/17] memory gate: %d problem(s)"
+        say("ci_check[17/18] memory gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("memlive: %s" % p)
+            say("  " + p)
+
+        # stage 18: serving gate (fleet replica smoke: coalescing,
+        # shedding, serve_top contract, kill -> watchdog restart)
+        problems = serving_check(repo_root)
+        say("ci_check[18/18] serving gate: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("serving: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -582,7 +604,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/17] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/18] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -1962,6 +1984,215 @@ def memlive_check(repo_root=_ROOT):
             problems.append("mem_top advice: no ZeRO record")
         if not doc.get("over_budget"):
             problems.append("mem_top: over_budget flag not set")
+    return problems
+
+
+def serving_check(repo_root=_ROOT):
+    """Serving gate (stage 18, docs/api/serving.md).
+
+    One ``tools/launch.py --fleet -n 1`` replica serves the tiny zoo
+    MLP behind a 1,4 batch ladder on an ephemeral port.  The gate
+    drives it through the whole serving contract:
+
+    * a 6-wide concurrent burst must land entirely as 200s AND coalesce
+      into the rung-4 executable (``mxtpu_serve_rung_dispatch_total
+      {rung="4"}`` > 0 — the continuous batcher worked);
+    * a 24-wide burst under a 1 ms deadline must SHED early at submit
+      (503s with a ``shed`` reason / ``mxtpu_serve_shed_total`` > 0 —
+      the estimated rung wall cannot meet the deadline) while the ok
+      counter keeps growing — load is refused, not queued to death;
+    * ``tools/serve_top.py --json`` over the replica's ``/metrics``
+      must strict-parse as ``mxtpu-servetop/1`` and name a hot rung;
+    * SIGKILLing the replica's process group (exit rc -9, the rc-137
+      container-kill shape) must produce the fleet watchdog's
+      ``replica_restart`` supervisor event and a green ``/healthz``
+      under a NEW pid, peers-keep-serving semantics — in-flight
+      requests on the dead replica fail fast at the client.
+
+    Returns problem strings (empty = clean)."""
+    import json
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_serving_gate_")
+    jsonl = os.path.join(tmpdir, "sup.jsonl")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    launcher = os.path.join(repo_root, "tools", "launch.py")
+    env = _scrubbed_launch_env({"MXNET_TPU_TELEMETRY_JSONL": jsonl})
+    sup = None
+
+    def get(path, timeout=5):
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (port, path),
+                timeout=timeout) as r:
+            return r.status, r.read()
+
+    def post(rows, deadline_ms, out):
+        doc = {"data": [[0.5] * 16] * rows, "deadline_ms": deadline_ms}
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/predict" % port,
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out.append((r.status, json.loads(r.read())))
+        except urllib.error.HTTPError as e:
+            out.append((e.code, json.loads(e.read())))
+        except OSError as e:
+            out.append((-1, {"error": str(e)}))
+
+    def burst(n, deadline_ms):
+        out = []
+        threads = [threading.Thread(target=post,
+                                    args=(1, deadline_ms, out))
+                   for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    try:
+        sup = subprocess.Popen(
+            [sys.executable, launcher, "--fleet", "-n", "1",
+             "--restart-budget", "2",
+             "%s -m mxnet_tpu.serving --model mlp --data-shape 16 "
+             "--port %d --ladder 1,4 --window-ms 20 --queue-depth 8 "
+             "--deadline-ms 2000" % (sys.executable, port)],
+            env=env, cwd=repo_root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        deadline = time.time() + 180
+        up = False
+        while time.time() < deadline:
+            if sup.poll() is not None:
+                problems.append("fleet supervisor exited early "
+                                "(code %s)" % sup.returncode)
+                return problems
+            try:
+                if get("/healthz")[0] == 200:
+                    up = True
+                    break
+            except OSError:
+                time.sleep(0.5)
+        if not up:
+            problems.append("replica /healthz never answered 200")
+            return problems
+
+        # coalescing: 6 concurrent 1-row posts against a 20 ms window
+        res = burst(6, 2000.0)
+        bad = [r for r in res if r[0] != 200]
+        if bad:
+            problems.append("coalescing burst had non-200 replies: %r"
+                            % bad[:3])
+        text = get("/metrics")[1].decode()
+        if 'mxtpu_serve_rung_dispatch_total{rung="4"}' not in text:
+            problems.append("concurrent burst never coalesced into "
+                            "rung 4 (no rung-4 dispatch counter)")
+
+        # shedding: 24-wide burst, 1 ms deadline, depth-4 queue
+        res = burst(24, 1.0)
+        shed = [doc for st, doc in res if st == 503 and doc.get("shed")]
+        if not shed:
+            problems.append("deadline-starved overload shed nothing "
+                            "(no 503 with a shed reason)")
+        text = get("/metrics")[1].decode()
+        if "mxtpu_serve_shed_total" not in text:
+            problems.append("mxtpu_serve_shed_total not exported after "
+                            "the overload burst")
+        if 'mxtpu_serve_requests_total{outcome="ok"}' not in text:
+            problems.append("no ok-outcome requests recorded")
+
+        # serve_top contract
+        top = subprocess.run(
+            [sys.executable, os.path.join(repo_root, "tools",
+                                          "serve_top.py"),
+             "--url", "http://127.0.0.1:%d/metrics" % port, "--json"],
+            capture_output=True, text=True, env=env, timeout=60)
+        if top.returncode != 0:
+            problems.append("serve_top --json exited %d: %s"
+                            % (top.returncode, top.stderr[:200]))
+        else:
+            try:
+                doc = json.loads(top.stdout)
+            except ValueError as e:
+                problems.append("serve_top --json unparseable: %s" % e)
+                doc = {}
+            if doc.get("schema") != "mxtpu-servetop/1":
+                problems.append("serve_top schema %r != mxtpu-servetop/1"
+                                % doc.get("schema"))
+            if not doc.get("hot_rung"):
+                problems.append("serve_top named no hot rung")
+            if doc.get("sheds") == {}:
+                problems.append("serve_top saw no sheds after the "
+                                "overload burst")
+
+        # chaos: SIGKILL the replica's process group (rc -9 — the
+        # rc-137 shape); the fleet watchdog must restart IT alone and
+        # /healthz must come back green under a new pid
+        old_pid = None
+        with open(jsonl) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "worker_start":
+                    old_pid = rec["pid"]
+        if old_pid is None:
+            problems.append("no worker_start event in the supervisor "
+                            "timeline")
+            return problems
+        try:
+            os.killpg(os.getpgid(old_pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError) as e:
+            problems.append("cannot SIGKILL replica pid %d: %s"
+                            % (old_pid, e))
+            return problems
+        deadline = time.time() + 120
+        back = False
+        while time.time() < deadline:
+            try:
+                st, body = get("/healthz", timeout=3)
+                if st == 200 and json.loads(body)["pid"] != old_pid:
+                    back = True
+                    break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        if not back:
+            problems.append("killed replica never came back green "
+                            "under a new pid")
+        events = []
+        with open(jsonl) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "replica_restart":
+                    events.append(rec)
+        if not events:
+            problems.append("no replica_restart event in the "
+                            "supervisor timeline after the kill")
+        elif events[0].get("exit_code") != -signal.SIGKILL:
+            problems.append("replica_restart recorded exit_code %r, "
+                            "expected %d (SIGKILL)"
+                            % (events[0].get("exit_code"),
+                               -signal.SIGKILL))
+    finally:
+        if sup is not None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(20)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+        shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 
 

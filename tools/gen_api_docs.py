@@ -49,6 +49,7 @@ MODULES = [
     ("random", "mxnet_tpu.random"),
     ("context", "mxnet_tpu.context"),
     ("rtc", "mxnet_tpu.rtc"),
+    ("predictor (deployment inference)", "mxnet_tpu.predictor"),
 ]
 
 # hand-written pages kept alongside the generated ones (never
@@ -70,12 +71,27 @@ HAND_WRITTEN = [
      "elastic cursor remap, backpressure)", "io_resume.md"),
     ("memlive (static memory-liveness: bind-time peak-HBM prediction, "
      "remat ranking, donation/ZeRO audit)", "memlive.md"),
+    ("serving (production predict path: batch-ladder AOT, continuous "
+     "batching, deadline scheduling, load shedding)", "serving.md"),
 ]
 
 # cross-links appended to generated pages (page key = module filename
 # stem): the generator owns these files, so hand-edits would be lost —
 # declare the links here instead
 SEE_ALSO = {
+    "predictor": ["[serving](serving.md) — the production predict "
+                  "path over Predictor handles: the batch ladder AOT-"
+                  "compiles one `reshaped()` rung per batch size at "
+                  "startup, the continuous batcher pads coalesced "
+                  "requests with `pad_batch` (the same helper "
+                  "`set_input` uses for its pad-and-slice partial-"
+                  "batch contract), and nothing compiles on the "
+                  "request path",
+                  "[telemetry](telemetry.md) — the predictor's "
+                  "executor dispatches through the AOT memory-plan "
+                  "path (`telemetry.memory.planned_executable`); the "
+                  "serving tier's `mxtpu_serve_*` instruments ride "
+                  "the same registry"],
     "executor": ["[fusion](fusion.md) — block-granularity fusion + "
                  "layout planning: the `block_fusion` flag captured at "
                  "bind time lowers conv+BN+ReLU / FC+activation chains "

@@ -294,6 +294,141 @@ def _note_worker_death(attempt, rank, code, flight_dumps, agg=None):
                 "flight_dumps": flight_dumps}, agg)
 
 
+def _run_fleet(opts, command, agg=None):
+    """Serving-fleet supervision (``--fleet``): N INDEPENDENT replicas.
+
+    Training workers form one collective job, so ``_run_workers_once``
+    rightly tears the whole fleet down when one rank dies.  Serving
+    replicas share nothing — each binds its own port
+    (``MXNET_TPU_SERVE_PORT`` + rank) and answers its own requests —
+    so here a dead replica is restarted ALONE (up to
+    ``--restart-budget`` times per rank, ``replica_restart`` in the
+    supervisor timeline) while its peers keep serving.  In-flight
+    requests on the dead replica fail fast at the client (connection
+    reset); the fleet stays available the whole time.  A replica that
+    exits 0 is treated as done, not dead.  SIGTERM/SIGINT to the
+    supervisor forwards to every replica's process group (graceful
+    drain — ``python -m mxnet_tpu.serving`` closes its batcher), then
+    SIGKILLs stragglers after a grace period."""
+    hb = max(0.05, float(opts.heartbeat_interval))
+    base_env = dmlc_opts(opts)
+    base_jsonl = _supervisor_jsonl()
+    try:
+        base_port = int(base_env.get("MXNET_TPU_TELEMETRY_PORT", "0"))
+    except ValueError:
+        base_port = 0
+
+    def spawn(rank, restart_count):
+        env = dict(base_env)
+        env["MXNET_TPU_PROCESS_ID"] = str(rank)
+        env["MXNET_TPU_RESTART_COUNT"] = str(restart_count)
+        port = 0
+        if base_port > 0:
+            port = base_port + (rank if opts.num_workers > 1 else 0)
+            env["MXNET_TPU_TELEMETRY_PORT"] = str(port)
+        if base_jsonl:
+            env["MXNET_TPU_TELEMETRY_JSONL"] = \
+                "%s.rank%d" % (base_jsonl, rank)
+
+        def _child_setup():
+            os.setsid()
+            signal.signal(signal.SIGUSR1, signal.SIG_IGN)
+
+        p = subprocess.Popen(command, shell=True, env=env,
+                             preexec_fn=_child_setup)
+        _sup_event({"event": "worker_start", "attempt": restart_count,
+                    "rank": rank, "pid": p.pid,
+                    "telemetry_port": port or None,
+                    "jsonl": env.get("MXNET_TPU_TELEMETRY_JSONL")},
+                   agg)
+        return p
+
+    def signal_group(p, sig):
+        try:
+            os.killpg(os.getpgid(p.pid), sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    if agg is not None:
+        agg.begin_attempt(0)
+    live = {rank: spawn(rank, 0) for rank in range(opts.num_workers)}
+    restarts = {rank: 0 for rank in live}
+    stop = {"sig": None}
+    code = 0
+
+    def relay_usr1(signum, frame):
+        for p in live.values():
+            signal_group(p, signal.SIGUSR1)
+
+    def request_stop(signum, frame):
+        stop["sig"] = signum
+
+    prev = {}
+    for sig, handler in ((signal.SIGUSR1, relay_usr1),
+                         (signal.SIGTERM, request_stop),
+                         (signal.SIGINT, request_stop)):
+        try:
+            prev[sig] = signal.signal(sig, handler)
+        except (ValueError, OSError):       # non-main thread embedding
+            pass
+    try:
+        while live and stop["sig"] is None:
+            for rank in list(live):
+                rc = live[rank].poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    # clean exit: this replica is done, not dead
+                    del live[rank]
+                    continue
+                _note_worker_death(restarts[rank], rank, rc,
+                                   sorted(_flight_dump_names()), agg)
+                if restarts[rank] < opts.restart_budget:
+                    restarts[rank] += 1
+                    sys.stderr.write(
+                        "launch.py: fleet replica %d died (code %d, "
+                        "signal %s); restarting it alone "
+                        "(restart %d/%d) — peers keep serving\n"
+                        % (rank, rc, -rc if rc < 0 else "none",
+                           restarts[rank], opts.restart_budget))
+                    sys.stderr.flush()
+                    _sup_event({"event": "replica_restart", "rank": rank,
+                                "restart": restarts[rank],
+                                "exit_code": rc}, agg)
+                    live[rank] = spawn(rank, restarts[rank])
+                else:
+                    code = code or rc
+                    sys.stderr.write(
+                        "launch.py: fleet replica %d died (code %d) "
+                        "with its restart budget (%d) spent; fleet "
+                        "continues with %d survivor(s)\n"
+                        % (rank, rc, opts.restart_budget, len(live) - 1))
+                    sys.stderr.flush()
+                    del live[rank]
+            if agg is not None:
+                agg.poll()
+            if live and stop["sig"] is None:
+                time.sleep(hb)
+        if stop["sig"] is not None and live:
+            sys.stderr.write("launch.py: fleet teardown (signal %d): "
+                             "draining %d replica(s)\n"
+                             % (stop["sig"], len(live)))
+            for p in live.values():
+                signal_group(p, signal.SIGTERM)
+            grace = time.time() + 10
+            for p in live.values():
+                try:
+                    p.wait(max(0.1, grace - time.time()))
+                except subprocess.TimeoutExpired:
+                    signal_group(p, signal.SIGKILL)
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+    if agg is not None:
+        agg.poll()
+    return code
+
+
 def launch_local(opts, command):
     """Fork N workers on this host (reference dmlc_tracker local mode —
     multi-node semantics without a cluster, SURVEY §4.6), under a
@@ -333,6 +468,10 @@ def launch_local(opts, command):
     except (ValueError, OSError):       # non-main thread embedding
         prev_usr1 = None
     try:
+        if getattr(opts, "fleet", False):
+            # independent-replica serving supervision: per-replica
+            # restarts inside ONE attempt, no collective teardown
+            return _run_fleet(opts, command, agg)
         attempt = 0
         while True:
             code, failed = _run_workers_once(opts, command, attempt, agg)
@@ -567,6 +706,15 @@ def main():
                         default=int(os.environ.get(
                             "MXNET_TPU_MIN_WORKERS", "1")),
                         help="floor for elastic shrinking (default 1)")
+    parser.add_argument("--fleet", action="store_true",
+                        default=os.environ.get("MXNET_TPU_FLEET",
+                                               "0") == "1",
+                        help="serving-fleet mode: workers are "
+                             "INDEPENDENT replicas — a dead one is "
+                             "restarted alone (up to --restart-budget "
+                             "times each) while peers keep serving, "
+                             "instead of the collective all-ranks "
+                             "teardown (local launcher only)")
     parser.add_argument("command", nargs="+", help="command to launch")
     opts = parser.parse_args()
     command = " ".join(opts.command)

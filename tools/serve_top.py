@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""serve_top: the serving tier's top — who is shedding, which rung is hot.
+
+Reads one Prometheus exposition snapshot from a replica's ``/metrics``
+endpoint (``--url``, default the local replica at
+``MXNET_TPU_SERVE_PORT``) or a saved file (``--file``), and summarizes
+the ``mxtpu_serve_*`` family (docs/api/serving.md):
+
+* requests by outcome (ok / shed / error) and the shed rate;
+* sheds by reason, naming the DOMINANT one (queue_full vs deadline —
+  the two need opposite remedies: more capacity vs looser deadlines or
+  a faster rung);
+* dispatches per ladder rung, naming the HOT rung, with each rung's
+  mean occupancy (real rows / rung — low occupancy on a big rung means
+  the batching window closes too early);
+* request latency p50/p99 interpolated from the ``total`` segment
+  histogram, plus the queue/pad/dispatch split means;
+* current batcher queue depth.
+
+``--json`` emits one machine-readable document (schema
+``mxtpu-servetop/1``) for CI assertions.  Stdlib only — never imports
+the framework.  Exit codes: 0 ok, 2 unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import urllib.request
+
+SCHEMA = "mxtpu-servetop/1"
+
+_LINE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$')
+_LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom(text):
+    """Exposition text -> {name: [(labels_dict, value), ...]}."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        name, labels, raw = m.groups()
+        try:
+            val = float(raw.replace("+Inf", "inf"))
+        except ValueError:
+            continue
+        kv = dict(_LABEL.findall(labels or ""))
+        out.setdefault(name, []).append((kv, val))
+    return out
+
+
+def _sum_by(samples, label):
+    agg = {}
+    for kv, val in samples:
+        key = kv.get(label, "")
+        agg[key] = agg.get(key, 0.0) + val
+    return agg
+
+
+def _quantile(buckets, q):
+    """Linear-interpolated quantile from cumulative (le, count) pairs
+    (the standard histogram_quantile estimate); None when empty."""
+    pts = sorted(((le, n) for le, n in buckets), key=lambda p: p[0])
+    if not pts:
+        return None
+    total = pts[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_n = 0.0, 0.0
+    for le, n in pts:
+        if n >= rank:
+            if le == float("inf"):
+                return prev_le        # unbounded tail: report the edge
+            if n == prev_n:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_n) / (n - prev_n)
+        prev_le, prev_n = le, n
+    return pts[-1][0]
+
+
+def summarize(metrics):
+    """The serve_top document (schema mxtpu-servetop/1) from parsed
+    exposition samples."""
+    outcomes = _sum_by(metrics.get("mxtpu_serve_requests_total", []),
+                       "outcome")
+    finished = sum(outcomes.values())
+    sheds = _sum_by(metrics.get("mxtpu_serve_shed_total", []), "reason")
+    dispatches = _sum_by(
+        metrics.get("mxtpu_serve_rung_dispatch_total", []), "rung")
+
+    occupancy = {}
+    occ_sum = _sum_by(metrics.get("mxtpu_serve_rung_occupancy_sum", []),
+                      "rung")
+    occ_n = _sum_by(metrics.get("mxtpu_serve_rung_occupancy_count", []),
+                    "rung")
+    for rung, n in occ_n.items():
+        if n > 0:
+            occupancy[rung] = round(occ_sum.get(rung, 0.0) / n, 4)
+
+    segments = {}
+    seg_sum = _sum_by(metrics.get("mxtpu_serve_request_seconds_sum", []),
+                      "segment")
+    seg_n = _sum_by(metrics.get("mxtpu_serve_request_seconds_count", []),
+                    "segment")
+    for seg, n in seg_n.items():
+        if n > 0:
+            segments[seg] = round(seg_sum.get(seg, 0.0) / n * 1e3, 3)
+
+    total_buckets = []
+    for kv, val in metrics.get("mxtpu_serve_request_seconds_bucket", []):
+        if kv.get("segment") == "total" and "le" in kv:
+            total_buckets.append((float(kv["le"].replace("+Inf", "inf")),
+                                  val))
+    p50 = _quantile(total_buckets, 0.50)
+    p99 = _quantile(total_buckets, 0.99)
+
+    depth = metrics.get("mxtpu_serve_queue_depth", [])
+    doc = {
+        "schema": SCHEMA,
+        "requests": {k: int(v) for k, v in sorted(outcomes.items())},
+        "shed_rate": round(outcomes.get("shed", 0.0) / finished, 4)
+        if finished else 0.0,
+        "sheds": {k: int(v) for k, v in sorted(sheds.items())},
+        "dominant_shed_reason": max(sheds, key=sheds.get)
+        if sheds else None,
+        "rung_dispatches": {k: int(v)
+                            for k, v in sorted(dispatches.items(),
+                                               key=lambda p: int(p[0]))},
+        "hot_rung": max(dispatches, key=dispatches.get)
+        if dispatches else None,
+        "rung_occupancy": occupancy,
+        "latency_ms": {
+            "p50": round(p50 * 1e3, 3) if p50 is not None else None,
+            "p99": round(p99 * 1e3, 3) if p99 is not None else None,
+            "segment_mean": segments,
+        },
+        "queue_depth": int(depth[0][1]) if depth else None,
+    }
+    return doc
+
+
+def render(doc):
+    lines = []
+    req = doc["requests"]
+    lines.append("requests: %s  (shed rate %.1f%%)"
+                 % (" ".join("%s=%d" % kv for kv in sorted(req.items()))
+                    or "none", doc["shed_rate"] * 100))
+    if doc["sheds"]:
+        lines.append("sheds:    %s  -> dominant reason: %s"
+                     % (" ".join("%s=%d" % kv
+                                 for kv in sorted(doc["sheds"].items())),
+                        doc["dominant_shed_reason"]))
+    if doc["rung_dispatches"]:
+        lines.append("rungs:")
+        for rung, n in doc["rung_dispatches"].items():
+            occ = doc["rung_occupancy"].get(rung)
+            hot = "  <- hot" if rung == doc["hot_rung"] else ""
+            lines.append("  rung %-4s dispatches=%-6d occupancy=%s%s"
+                         % (rung, n,
+                            "%.0f%%" % (occ * 100) if occ is not None
+                            else "n/a", hot))
+    lat = doc["latency_ms"]
+    if lat["p50"] is not None:
+        lines.append("latency:  p50=%.2fms p99=%.2fms"
+                     % (lat["p50"], lat["p99"]))
+    if lat["segment_mean"]:
+        lines.append("segments: %s (mean ms)"
+                     % " ".join("%s=%.2f" % kv
+                                for kv in sorted(
+                                    lat["segment_mean"].items())))
+    if doc["queue_depth"] is not None:
+        lines.append("queue:    depth=%d" % doc["queue_depth"])
+    if not doc["requests"] and not doc["rung_dispatches"]:
+        lines.append("no mxtpu_serve_* samples yet — has the replica "
+                     "served a request?")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="serve_top",
+        description="summarize a serving replica's mxtpu_serve_* "
+                    "metrics (docs/api/serving.md)")
+    parser.add_argument("--url", default=None,
+                        help="metrics endpoint (default "
+                             "http://127.0.0.1:$MXNET_TPU_SERVE_PORT"
+                             "/metrics)")
+    parser.add_argument("--file", default=None,
+                        help="read a saved exposition snapshot instead "
+                             "of fetching --url")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one mxtpu-servetop/1 JSON document")
+    args = parser.parse_args(argv)
+
+    if args.file:
+        try:
+            with open(args.file) as f:
+                text = f.read()
+        except OSError as e:
+            sys.stderr.write("serve_top: cannot read %s: %s\n"
+                             % (args.file, e))
+            return 2
+    else:
+        url = args.url
+        if not url:
+            port = os.environ.get("MXNET_TPU_SERVE_PORT", "8080")
+            url = "http://127.0.0.1:%s/metrics" % port
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                text = r.read().decode("utf-8", "replace")
+        except Exception as e:  # mxlint: allow-broad-except(urllib raises a zoo of URLError/OSError/HTTPException subclasses; every fetch failure means the same thing here — no snapshot — and maps to the documented exit code 2)
+            sys.stderr.write("serve_top: cannot fetch %s: %s\n"
+                             % (url, e))
+            return 2
+
+    doc = summarize(parse_prom(text))
+    if args.json:
+        print(json.dumps(doc, sort_keys=True))
+    else:
+        print(render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
