@@ -388,6 +388,8 @@ def _serve_bench():
         "hot_rung": servetop.get("hot_rung"),
         "rung_occupancy": servetop.get("rung_occupancy"),
         "dominant_shed_reason": servetop.get("dominant_shed_reason"),
+        "health": servetop.get("health"),
+        "firing_rules": servetop.get("firing_rules"),
         "compiles_after_warmup": int(compiles_after - compiles_before)
         if telemetry.compile.installed() else None,
         "clients": n_clients,

@@ -351,6 +351,38 @@ _CATALOG = {
                                 "gradient's exact-zero fraction "
                                 "(1.0 = only an entirely zero grad; "
                                 "0 disables the rule)"),
+    # SLO engine / healthd (telemetry.slo, docs/api/telemetry.md)
+    "MXNET_TPU_SLO": ("1", "honored",
+                      "the in-process SLO engine: 0 disables rule "
+                      "evaluation entirely (health() reports "
+                      "status=healthy, disabled=true; no alert "
+                      "metrics, no ticker)"),
+    "MXNET_TPU_SLO_RULES": ("", "honored",
+                            "SLO rule-catalog override: @file.json or "
+                            "inline JSON list merged over the built-in "
+                            "catalog by rule name (disable:true drops "
+                            "a rule), or the compact form "
+                            "'rule.param=value;rule2.disable=1'; a "
+                            "malformed spec warns once and keeps the "
+                            "defaults"),
+    "MXNET_TPU_SLO_TICK_S": ("1.0", "honored",
+                             "background-ticker evaluation cadence in "
+                             "seconds (floor 0.05); also rate-limits "
+                             "the per-step evaluation hook"),
+    "MXNET_TPU_SLO_FAST_S": ("60", "honored",
+                             "default fast burn-rate window in "
+                             "seconds for rules that leave fast_s "
+                             "unset"),
+    "MXNET_TPU_SLO_SLOW_S": ("300", "honored",
+                             "default slow burn-rate window in "
+                             "seconds for rules that leave slow_s "
+                             "unset"),
+    "MXNET_TPU_SLO_LATENCY_MS": ("250", "honored",
+                                 "serving latency SLO threshold: a "
+                                 "request slower than this is 'bad' "
+                                 "for serve_p99_latency_burn (rounded "
+                                 "up to the nearest request-latency "
+                                 "histogram bucket bound)"),
 }
 
 

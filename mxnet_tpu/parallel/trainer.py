@@ -1981,6 +1981,17 @@ class ShardedTrainer:
             extra=self._segments_extra(total, count=num_steps))
         return losses
 
+    def health(self):
+        """This rank's SLO health verdict (``mxtpu-health/1`` dict —
+        see ``telemetry.slo``).  The training-run rules (step-time
+        regression vs the rolling baseline, collective-wait share,
+        starved-input share, the step heartbeat, numerics/io
+        passthrough) are evaluated on the ``step_end`` cadence every
+        :meth:`step`/:meth:`run_steps` already drives, so this is a
+        read, not an evaluation."""
+        from ..telemetry import slo
+        return slo.health()
+
     def _run_steps_impl(self, batch, num_steps):
         import jax
         import jax.numpy as jnp

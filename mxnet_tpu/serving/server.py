@@ -11,10 +11,22 @@ Endpoints:
   seam surfaces here);
 * ``GET /healthz`` — 200 with ladder/queue state while the batcher
   thread is alive, 503 once it stopped (the fleet watchdog's liveness
-  contract);
+  contract).  ``GET /healthz?deep=1`` additionally consults the SLO
+  engine (``telemetry.slo``): the reply embeds the ``mxtpu-health/1``
+  verdict under ``"health"`` and the status flips 503 when the verdict
+  is ``critical`` — a load balancer or fleet supervisor can drain a
+  replica whose error budget is burning, not just a dead one;
+* ``GET /alerts`` — the full alert surface: the health verdict plus
+  every rule's current state (``tools/health_top.py --url`` reads
+  this);
 * ``GET /metrics`` — the shared Prometheus exposition
   (``telemetry.exporters.render_prom``), the ``tools/serve_top.py``
   input.
+
+Constructing a :class:`Server` arms the SLO background ticker
+(``MXNET_TPU_SLO_TICK_S`` cadence; ``MXNET_TPU_SLO=0`` disables) and
+binds the ``serve_queue_depth`` rule to 0.9x the batcher's real queue
+depth.
 
 One :class:`Server` per replica; ``tools/launch.py --fleet`` runs N of
 them with per-rank ports (``--port`` + ``MXNET_TPU_PROCESS_ID``, the
@@ -66,6 +78,15 @@ class Server:
         self._batcher = batcher or Batcher(ladder)
         self._httpd = self._build(serve_port(port))
         self._thread = None
+        # arm the SLO judge: the replica evaluates its serving rules on
+        # a background ticker and the queue-depth rule learns the
+        # batcher's REAL capacity
+        from ..telemetry import slo
+        if slo.enabled():
+            slo.engine().configure(
+                "serve_queue_depth",
+                bound=0.9 * getattr(self._batcher, "_depth", 64))
+            slo.start_ticker()
 
     @property
     def port(self):
@@ -114,15 +135,38 @@ class Server:
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = self.path.rstrip("/") or "/"
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(self.path)
+                path = parsed.path.rstrip("/") or "/"
                 if path in ("/", "/healthz"):
                     ok = server._batcher.alive
-                    self._send({
+                    doc = {
                         "status": "ok" if ok else "stopped",
                         "pid": os.getpid(),
                         "queue_depth": server._batcher.queue_depth(),
                         "ladder": server._ladder.describe(),
-                    }, status=200 if ok else 503)
+                    }
+                    status = 200 if ok else 503
+                    q = parse_qs(parsed.query)
+                    if q.get("deep", ["0"])[-1] not in ("", "0",
+                                                        "false"):
+                        from ..telemetry import slo
+                        verdict = slo.health()
+                        doc["health"] = verdict
+                        doc["status"] = "stopped" if not ok else \
+                            verdict["status"]
+                        # critical = the error budget is burning: a
+                        # fleet supervisor / LB drains this replica
+                        if verdict["status"] == "critical":
+                            status = 503
+                    self._send(doc, status=status)
+                    return
+                if path == "/alerts":
+                    from ..telemetry import slo
+                    doc = slo.health()
+                    doc["alerts"] = slo.engine().alerts() \
+                        if slo.enabled() else []
+                    self._send(doc)
                     return
                 if path == "/metrics":
                     from ..telemetry import render_prom

@@ -49,7 +49,16 @@ Three engines:
   (param/grad/fused-block norms, non-finite counts, value digests,
   global grad norm), anomaly rules with NaN/Inf provenance and a
   strict-mode stop, and the per-step divergence ledger
-  ``tools/numdiff.py`` bisects.
+  ``tools/numdiff.py`` bisects;
+* **SLO engine / healthd** (:mod:`.slo`) — the judge over every sensor
+  above: a declared rule catalog (threshold / multi-window burn-rate /
+  absence / anomaly-passthrough, ``MXNET_TPU_SLO_RULES`` overrides)
+  evaluated by an in-process ticker, an alert state machine (pending →
+  firing → resolved with debounce) emitting ``mxtpu_alert_*`` metrics
+  and ``alert`` flight events, the per-rank ``health()`` verdict
+  behind the serving tier's deep ``/healthz``, and fleet-scope rules
+  evaluated over the run timeline by ``launch.py``;
+  ``tools/health_top.py`` renders live and postmortem views.
 
 Compile events come from ``jax.monitoring`` listeners where available
 (:mod:`.compile`), else a first-call-vs-steady-state heuristic.
@@ -71,6 +80,7 @@ from . import distview
 from . import ioview
 from . import costdb
 from . import numerics
+from . import slo
 from .exporters import (step_end, jsonl_event, render_prom, report,
                         start_http_server, jsonl_path, env_port, reset,
                         reset_steps)
@@ -86,6 +96,7 @@ __all__ = [
     "start_http_server", "jsonl_path", "env_port", "reset",
     "reset_steps", "compile_events",
     "flight", "memory", "distview", "ioview", "costdb", "numerics",
+    "slo",
 ]
 
 # best-effort process-wide init: compile listener (jax.monitoring) and

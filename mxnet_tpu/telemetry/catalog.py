@@ -346,6 +346,25 @@ CATALOG = {
     "mxtpu_serve_queue_depth": (GAUGE, (),
                                 "predict requests currently queued in "
                                 "the batcher"),
+    # ----------------------------------- SLO engine / alerting (slo)
+    "mxtpu_alert_transitions_total": (COUNTER, ("rule", "to"),
+                                      "alert state-machine transitions "
+                                      "per SLO rule (to=pending|firing|"
+                                      "cleared|resolved)"),
+    "mxtpu_alert_state": (GAUGE, ("rule",),
+                          "current alert state per SLO rule "
+                          "(0=inactive 1=pending 2=firing)"),
+    "mxtpu_alerts_firing": (GAUGE, ("severity",),
+                            "SLO rules currently firing, by severity "
+                            "(severity=warn|critical)"),
+    "mxtpu_slo_burn_rate": (GAUGE, ("rule", "window"),
+                            "latest error-budget burn rate per "
+                            "burn_rate rule and window (window=fast|"
+                            "slow; 1.0 = budget consumed exactly at "
+                            "the objective's allowance)"),
+    "mxtpu_health_status": (GAUGE, (),
+                            "this rank's health verdict (0=healthy "
+                            "1=degraded 2=critical)"),
 }
 
 # rung-occupancy fractions (histogram buckets): fill ratios up to full

@@ -411,6 +411,11 @@ class RunAggregator:
         self._seen_dumps = set()
         self._lock = threading.Lock()
         self._closed = False
+        #: optional fleet-scope SLO evaluator (telemetry.slo.
+        #: FleetHealth): launch.py attaches one; every emitted step is
+        #: judged and alert transitions land in the timeline as
+        #: ``event: alert`` records
+        self.health = None
         try:
             # fresh timeline per job: a reused base must not leave the
             # old run's records above this run's run_begin header
@@ -568,6 +573,16 @@ class RunAggregator:
             if len(digests) > 1:
                 rec["digest_mismatch"] = True
             self._write(rec)
+            if self.health is not None:
+                # self._lock is already held: write alert transitions
+                # directly (note_event would deadlock re-taking it)
+                try:
+                    for ev in self.health.observe_step(rec):
+                        self._write(ev)
+                except Exception:  # mxlint: allow-broad-except(a fleet-rule bug must not stop the timeline merge it annotates)
+                    logging.getLogger(__name__).warning(
+                        "distview: fleet SLO evaluation failed on "
+                        "step %s", step, exc_info=True)
 
     # -------------------------------------------------------------- poll
     def poll(self):
@@ -622,6 +637,14 @@ class RunAggregator:
         self.poll()
         with self._lock:
             self._emit_ready(final=True)
+            if self.health is not None:
+                try:
+                    self._write({"kind": "event",
+                                 "event": "fleet_health",
+                                 "ts": round(time.time(), 6),
+                                 **self.health.verdict()})
+                except Exception:  # mxlint: allow-broad-except(the closing verdict is best-effort; run_end must still be written)
+                    pass
             self._write({"kind": "run_end", "ts": round(time.time(), 6),
                          "steps": self._steps_written})
 
@@ -700,6 +723,28 @@ def summarize_run(records):
     steps = [r for r in records if r.get("kind") == "step"]
     events = [r for r in records if r.get("kind") == "event"]
     head = records[0]
+    # fleet SLO alerts (telemetry.slo.FleetHealth transitions written
+    # into the timeline) + the closing fleet_health verdict
+    alerts = [e for e in events if e.get("event") == "alert"]
+    firing_now = {}
+    for a in alerts:
+        if a.get("to") == "firing":
+            firing_now[a.get("rule")] = a
+        elif a.get("to") == "resolved":
+            firing_now.pop(a.get("rule"), None)
+    fleet_health = None
+    for e in events:
+        if e.get("event") == "fleet_health":
+            fleet_health = {k: e.get(k)
+                            for k in ("status", "firing", "rules")}
+    if fleet_health is None and alerts:
+        fleet_health = {
+            "status": "critical" if any(
+                a.get("severity") == "critical"
+                for a in firing_now.values())
+            else ("degraded" if firing_now else "healthy"),
+            "firing": sorted(firing_now),
+        }
     worst = {}
     seg_totals = {}
     rank_times = {}
@@ -798,10 +843,15 @@ def summarize_run(records):
         "grad_skew_max": grad_skew_max,
         "digest_mismatch_steps": digest_mismatch_steps,
         "io_bottleneck": io_bottleneck,
+        "health": fleet_health,
+        "alerts": [{k: a.get(k) for k in ("ts", "step", "rule", "to",
+                                          "severity", "value", "bound")
+                    if a.get(k) is not None} for a in alerts],
         "per_rank": per_rank,
         "events": [{k: e.get(k) for k in ("ts", "event", "rank", "pid",
                                           "attempt", "exit_code", "path",
-                                          "telemetry_port")
+                                          "telemetry_port", "rule",
+                                          "to", "severity", "status")
                     if e.get(k) is not None} for e in events],
         "ended": any(r.get("kind") == "run_end" for r in records),
     }

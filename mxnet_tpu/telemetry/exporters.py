@@ -30,6 +30,7 @@ from . import distview as distview_mod
 from . import flight
 from . import ioview as ioview_mod
 from . import memory as memory_mod
+from . import slo as slo_mod
 from .spans import drain_step_spans
 
 __all__ = ["step_end", "jsonl_event", "render_prom", "report",
@@ -151,6 +152,9 @@ def step_end(samples=None, step_time=None, extra=None, count=1):
         # a postmortem black box carries the last steps' segment shape
         ev["segments"] = extra["segments"]
     flight.record("step_end", **ev)
+    # the SLO judge rides the step cadence: one clock read per step, a
+    # full rule evaluation at most every MXNET_TPU_SLO_TICK_S
+    slo_mod.on_step()
     with _lock:
         fh = _jsonl_handle()
         if fh is None:
@@ -456,6 +460,7 @@ def reset():
     costdb_mod.reset()
     from . import numerics as numerics_mod
     numerics_mod.reset()
+    slo_mod.reset()
     with _lock:
         _step_durs.clear()
         _last_counters.clear()

@@ -1,0 +1,395 @@
+"""The SLO engine / healthd layer (``mxnet_tpu/telemetry/slo.py``,
+docs/api/telemetry.md): hand-computed burn-rate window math, the alert
+state machine (debounce up, anti-flap down, freeze on no-evidence),
+absence arming, fleet quorum evaluation, rule-loading overrides, and
+the rule-catalog drift guards.
+
+Every evaluation here drives ``tick(now=...)`` / ``observe_step`` with
+an EXPLICIT clock — the engine must be deterministic under a synthetic
+timeline, which is also what makes ``health_top.py`` postmortems
+trustworthy.
+"""
+import copy
+import json
+import os
+import re
+
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import slo
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_SLO_RULES", raising=False)
+    monkeypatch.delenv("MXNET_TPU_SLO", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _rules(*names, **overrides):
+    """Default rules filtered to ``names``, with per-rule overrides
+    (``{"rule": {"param": value}}``)."""
+    table = [r for r in slo.load_rules(spec="") if r["name"] in names]
+    assert len(table) == len(names), (names, [r["name"] for r in table])
+    for r in table:
+        r.update(overrides.get(r["name"], {}))
+    return table
+
+
+# ------------------------------------------------------- rule catalog
+
+def test_builtin_rule_catalog_selfchecks_clean():
+    assert slo.selfcheck_rules() == []
+    names = [r["name"] for r in slo.RULES]
+    assert len(names) == len(set(names))
+
+
+def test_selfcheck_catches_bad_rules():
+    bad = copy.deepcopy(slo.RULES)
+    bad[0]["severity"] = "apocalyptic"
+    assert any("severity" in p for p in slo.selfcheck_rules(bad))
+    bad = copy.deepcopy(slo.RULES)
+    bad[1]["objective"] = 1.0          # budget would be zero
+    assert any("objective" in p for p in slo.selfcheck_rules(bad))
+    bad = copy.deepcopy(slo.RULES)
+    bad.append(dict(bad[0]))           # duplicate name
+    assert any("name" in p for p in slo.selfcheck_rules(bad))
+
+
+def test_rule_table_in_docs_matches_code():
+    # the same both-directions drift guard ci_check stage 4 runs —
+    # here so plain tier-1 catches a rule added without its docs row
+    with open(os.path.join(ROOT, "docs", "api", "telemetry.md")) as f:
+        text = f.read()
+    m = re.search(r"<!-- slo-rules:begin -->(.*?)<!-- slo-rules:end -->",
+                  text, re.S)
+    assert m, "docs/api/telemetry.md lost the slo-rules marker block"
+    doc = {n for n in re.findall(r"`([a-z0-9_]+)`", m.group(1))
+           if not n.startswith(("mxtpu_", "mxnet_tpu"))}
+    code = {r["name"] for r in slo.RULES}
+    assert doc == code, (sorted(code - doc), sorted(doc - code))
+
+
+def test_alert_metrics_are_declared_in_catalog():
+    for name in ("mxtpu_alert_transitions_total", "mxtpu_alert_state",
+                 "mxtpu_alerts_firing", "mxtpu_slo_burn_rate",
+                 "mxtpu_health_status"):
+        assert name in telemetry.CATALOG, name
+
+
+# ------------------------------------------------- burn-rate windows
+
+def _shed_engine(fast=10.0, slow=60.0):
+    return slo.SloEngine(rules=_rules(
+        "serve_shed_burn",
+        serve_shed_burn={"fast_s": fast, "slow_s": slow,
+                         "resolve_for_s": 0.0}))
+
+
+def test_burn_rate_hand_computed():
+    # objective 0.99 -> budget 0.01.  90 shed of 100 requests inside
+    # both windows: burn = (90/100)/0.01 = 90.0 on each window.
+    eng = _shed_engine()
+    req = telemetry.counter("mxtpu_serve_requests_total")
+    eng.tick(now=0.0)
+    req.labels(outcome="shed").inc(90)
+    req.labels(outcome="ok").inc(10)
+    eng.tick(now=5.0)
+    al = eng._alerts["serve_shed_burn"]
+    assert al.state == "firing"
+    assert al.info["burn_fast"] == pytest.approx(90.0)
+    assert al.info["burn_slow"] == pytest.approx(90.0)
+    doc = eng.health(now=5.0)
+    assert doc["status"] == "critical"
+    assert doc["firing"][0]["rule"] == "serve_shed_burn"
+
+
+def test_burn_rate_below_factor_does_not_fire():
+    # 1 shed of 100 -> burn (1/100)/0.01 = 1.0, under factor 2
+    eng = _shed_engine()
+    req = telemetry.counter("mxtpu_serve_requests_total")
+    eng.tick(now=0.0)
+    req.labels(outcome="shed").inc(1)
+    req.labels(outcome="ok").inc(99)
+    eng.tick(now=5.0)
+    assert eng._alerts["serve_shed_burn"].state == "inactive"
+
+
+def test_burn_rate_needs_both_windows():
+    # a long clean history keeps the SLOW window under the factor even
+    # when the fast window burns hot — one blip cannot page
+    eng = _shed_engine(fast=10.0, slow=100.0)
+    req = telemetry.counter("mxtpu_serve_requests_total")
+    eng.tick(now=0.0)
+    req.labels(outcome="ok").inc(10000)
+    for t in range(10, 101, 10):
+        eng.tick(now=float(t))
+    req.labels(outcome="shed").inc(90)
+    req.labels(outcome="ok").inc(10)
+    eng.tick(now=105.0)
+    al = eng._alerts["serve_shed_burn"]
+    # fast window: (90/100)/0.01 = 90; slow window: 90/10100/0.01 < 1
+    assert al.info["burn_fast"] == pytest.approx(90.0)
+    assert al.info["burn_slow"] < 2.0
+    assert al.state == "inactive"
+    # sustain the badness until the slow window burns too -> fires
+    req.labels(outcome="shed").inc(400)
+    eng.tick(now=110.0)
+    assert al.info["burn_slow"] > 2.0
+    assert al.state == "firing"
+
+
+def test_burn_rate_no_traffic_stays_quiet():
+    eng = _shed_engine()
+    for t in range(0, 30, 5):
+        eng.tick(now=float(t))
+    assert eng._alerts["serve_shed_burn"].state == "inactive"
+    assert eng.health(now=30.0)["status"] == "healthy"
+
+
+def test_latency_burn_from_histogram_buckets():
+    # requests over the threshold are "bad": 100 fast (1 ms) requests
+    # keep the budget intact; 300 slow (10 s) ones burn it at
+    # (300/400)/0.01 = 75x
+    eng = slo.SloEngine(rules=_rules(
+        "serve_p99_latency_burn",
+        serve_p99_latency_burn={"fast_s": 10.0, "slow_s": 60.0}))
+    h = telemetry.histogram("mxtpu_serve_request_seconds")
+    eng.tick(now=0.0)
+    for _ in range(100):
+        h.labels(segment="total").observe(0.001)
+    eng.tick(now=2.0)
+    al = eng._alerts["serve_p99_latency_burn"]
+    assert al.state == "inactive"
+    for _ in range(300):
+        h.labels(segment="total").observe(10.0)
+    eng.tick(now=4.0)
+    assert al.state == "firing"
+    assert al.info["burn_fast"] == pytest.approx(75.0)
+
+
+# ------------------------------------------------- alert state machine
+
+def test_state_machine_debounce_up():
+    al = slo.Alert("r", "warn")
+    assert al.advance(True, 0.0, 5.0, 0.0) == ["pending"]
+    assert al.advance(True, 4.0, 5.0, 0.0) == []
+    assert al.state == "pending"
+    assert al.advance(True, 5.0, 5.0, 0.0) == ["firing"]
+    assert al.fired_ts == 5.0
+
+
+def test_state_machine_pending_clears_without_firing():
+    al = slo.Alert("r", "warn")
+    al.advance(True, 0.0, 10.0, 0.0)
+    assert al.advance(False, 3.0, 10.0, 0.0) == ["cleared"]
+    assert al.state == "inactive"
+    assert al.fired_ts is None
+
+
+def test_state_machine_antiflap_down():
+    al = slo.Alert("r", "warn")
+    al.advance(True, 0.0, 0.0, 4.0)
+    assert al.state == "firing"
+    # a false reading does not resolve until held resolve_for_s
+    assert al.advance(False, 1.0, 0.0, 4.0) == []
+    assert al.state == "firing"
+    # flap: condition returns true, resetting the resolve clock
+    assert al.advance(True, 2.0, 0.0, 4.0) == []
+    assert al.advance(False, 5.0, 0.0, 4.0) == []
+    assert al.state == "firing"
+    assert al.advance(False, 6.0, 0.0, 4.0) == ["resolved"]
+    assert al.state == "inactive"
+    assert al.resolved_ts == 6.0
+
+
+def test_state_machine_none_freezes():
+    al = slo.Alert("r", "warn")
+    al.advance(True, 0.0, 0.0, 30.0)
+    assert al.state == "firing"
+    # unknown evidence (no traffic) must freeze, not resolve
+    for t in range(1, 200, 50):
+        assert al.advance(None, float(t), 0.0, 30.0) == []
+    assert al.state == "firing"
+
+
+def test_zero_for_s_fires_in_one_tick():
+    al = slo.Alert("r", "critical")
+    assert al.advance(True, 0.0, 0.0, 0.0) == ["pending", "firing"]
+
+
+# --------------------------------------------------------- absence
+
+def test_absence_arms_only_after_first_advance():
+    eng = slo.SloEngine(rules=_rules(
+        "train_heartbeat", train_heartbeat={"hold_s": 60.0}))
+    step = telemetry.counter("mxtpu_step_total")
+    # an idle process that never stepped must not false-fire
+    for t in range(0, 500, 100):
+        eng.tick(now=float(t))
+    al = eng._alerts["train_heartbeat"]
+    assert al.state == "inactive"
+    # first step arms the rule ...
+    step.inc()
+    eng.tick(now=500.0)
+    assert al.state == "inactive"
+    # ... and a stalled ticker clock past hold_s fires it
+    eng.tick(now=559.0)
+    assert al.state == "inactive"
+    eng.tick(now=561.0)
+    assert al.state == "firing"
+    # progress resumes -> resolves (resolve_for_s = 0 for heartbeats)
+    step.inc()
+    eng.tick(now=562.0)
+    assert al.state == "inactive"
+    assert al.resolved_ts == 562.0
+
+
+# ----------------------------------------------------- fleet quorum
+
+def _fleet_rule(quorum, field="ranks.lag", bound=0.5):
+    return [dict(name="q", type="threshold", severity="warn",
+                 scope="fleet", field=field, op=">", bound=bound,
+                 quorum=quorum, summary="t", for_s=0.0,
+                 resolve_for_s=0.0)]
+
+
+def _rec(ts, **ranks):
+    return {"kind": "step", "step": 1, "ts": ts,
+            "ranks": {str(k): v for k, v in ranks.items()}}
+
+
+def test_fleet_quorum_any_vs_all():
+    rec = _rec(1.0, r0={"lag": 1.0}, r1={"lag": 0.0})
+    fh = slo.FleetHealth(rules=_fleet_rule("any"))
+    events = fh.observe_step(rec)
+    assert [e["to"] for e in events] == ["firing"]
+    assert events[0]["rule"] == "q" and events[0]["scope"] == "fleet"
+    fh = slo.FleetHealth(rules=_fleet_rule("all"))
+    assert fh.observe_step(rec) == []
+    assert fh.verdict(now=1.0)["status"] == "healthy"
+
+
+def test_fleet_quorum_fraction():
+    rec = _rec(1.0, r0={"lag": 1.0}, r1={"lag": 1.0}, r2={"lag": 0.0})
+    fh = slo.FleetHealth(rules=_fleet_rule(0.5))
+    assert [e["to"] for e in fh.observe_step(rec)] == ["firing"]
+    fh = slo.FleetHealth(rules=_fleet_rule(0.9))
+    assert fh.observe_step(rec) == []
+
+
+def test_fleet_skew_rule_over_timeline():
+    fh = slo.FleetHealth(rules=_rules(
+        "fleet_skew", fleet_skew={"bound": 0.05,
+                                  "resolve_for_s": 0.0}))
+    assert fh.observe_step(
+        {"kind": "step", "step": 1, "ts": 1.0, "skew_s": 0.01}) == []
+    events = fh.observe_step(
+        {"kind": "step", "step": 2, "ts": 2.0, "skew_s": 0.2})
+    assert [e["to"] for e in events] == ["firing"]
+    assert events[0]["value"] == pytest.approx(0.2)
+    v = fh.verdict(now=2.0)
+    assert v["status"] == "degraded"
+    assert v["firing"][0]["rule"] == "fleet_skew"
+    # an unsampled step (skew measured every Nth) freezes the alert
+    assert fh.observe_step(
+        {"kind": "step", "step": 3, "ts": 3.0}) == []
+    assert fh.verdict(now=3.0)["status"] == "degraded"
+    events = fh.observe_step(
+        {"kind": "step", "step": 4, "ts": 4.0, "skew_s": 0.01})
+    assert [e["to"] for e in events] == ["resolved"]
+
+
+def test_fleet_rank_missing_armed_to_fleet_size():
+    fh = slo.FleetHealth(rules=_rules("fleet_rank_missing"),
+                         num_ranks=4)
+    assert fh.observe_step({"kind": "step", "step": 1, "ts": 1.0,
+                            "n_ranks": 4}) == []
+    events = fh.observe_step({"kind": "step", "step": 2, "ts": 2.0,
+                              "n_ranks": 3})
+    assert [e["to"] for e in events] == ["firing"]
+    assert fh.verdict(now=2.0)["status"] == "critical"
+
+
+# ------------------------------------------------- loading / overrides
+
+def test_load_rules_compact_override():
+    rules = slo.load_rules(
+        spec="fleet_skew.bound=0.25;serve_heartbeat.disable=1")
+    by = {r["name"]: r for r in rules}
+    assert by["fleet_skew"]["bound"] == 0.25
+    assert "serve_heartbeat" not in by
+
+
+def test_load_rules_json_merge_and_new_rule():
+    spec = json.dumps([
+        {"name": "serve_error_rate", "bound": 0.5},
+        {"name": "numerics_anomaly", "disable": True},
+        {"name": "my_rule", "type": "threshold", "severity": "warn",
+         "scope": "rank", "mode": "value",
+         "metric": "mxtpu_serve_queue_depth", "labels": None,
+         "op": ">", "bound": 3.0, "window_s": None, "summary": "mine",
+         "for_s": 0.0, "resolve_for_s": 0.0},
+    ])
+    by = {r["name"]: r for r in slo.load_rules(spec=spec)}
+    assert by["serve_error_rate"]["bound"] == 0.5
+    assert "numerics_anomaly" not in by
+    assert by["my_rule"]["bound"] == 3.0
+
+
+def test_load_rules_malformed_spec_keeps_defaults():
+    rules = slo.load_rules(spec="{not json")
+    assert {r["name"] for r in rules} == {r["name"] for r in slo.RULES}
+    rules = slo.load_rules(spec="nosuchrule.bound=1")
+    assert {r["name"] for r in rules} == {r["name"] for r in slo.RULES}
+
+
+def test_load_rules_invalid_override_dropped():
+    # an override that breaks a rule drops THAT rule, not the process
+    rules = slo.load_rules(spec="serve_shed_burn.objective=2.0")
+    names = {r["name"] for r in rules}
+    assert "serve_shed_burn" not in names
+    assert "serve_error_rate" in names
+
+
+# --------------------------------------------------- engine emission
+
+def test_engine_emits_alert_surface_metrics():
+    eng = _shed_engine()
+    req = telemetry.counter("mxtpu_serve_requests_total")
+    eng.tick(now=0.0)
+    req.labels(outcome="shed").inc(90)
+    req.labels(outcome="ok").inc(10)
+    eng.tick(now=5.0)
+    flat = telemetry.REGISTRY.flat()
+    assert flat["mxtpu_health_status"] == 2.0
+    assert flat['mxtpu_alert_state{rule="serve_shed_burn"}'] == 2.0
+    assert flat['mxtpu_alerts_firing{severity="critical"}'] == 1.0
+    assert flat['mxtpu_slo_burn_rate{rule="serve_shed_burn",'
+                'window="fast"}'] == pytest.approx(90.0)
+    assert flat['mxtpu_alert_transitions_total'
+                '{rule="serve_shed_burn",to="firing"}'] == 1.0
+    kinds = [e["kind"] for e in telemetry.flight.events()]
+    assert "alert" in kinds
+
+
+def test_health_doc_disabled_stub(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SLO", "0")
+    doc = slo.health()
+    assert doc["status"] == "healthy" and doc["disabled"] is True
+    assert doc["schema"] == slo.HEALTH_SCHEMA
+
+
+def test_health_doc_shape():
+    eng = _shed_engine()
+    doc = eng.health(now=0.0)
+    assert doc["schema"] == "mxtpu-health/1"
+    for key in ("ts", "rank", "status", "firing", "pending",
+                "resolved", "rules"):
+        assert key in doc, key
+    assert doc["rules"] == 1

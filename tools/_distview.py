@@ -31,3 +31,10 @@ def load_ioview():
     """Aggregation half of ``telemetry/ioview.py`` for ``io_top.py`` —
     same stdlib-only-by-path contract as distview."""
     return _load("mxtpu_ioview", "ioview.py")
+
+
+def load_slo():
+    """SLO rule catalog + fleet evaluator of ``telemetry/slo.py`` for
+    ``health_top.py`` and ``launch.py`` — same stdlib-only-by-path
+    contract as distview."""
+    return _load("mxtpu_slo", "slo.py")

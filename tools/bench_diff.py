@@ -19,6 +19,17 @@ it is errored or tunnel-down: nonzero wrapper ``rc``, an ``error``
 field, ``"valid": false`` (bench.py marks its watchdog artifact so),
 a missing/non-numeric value, or a value <= 0.
 
+Runs carrying the serving block (``{"serving": {...}}``, bench.py's
+``--serve`` leg) are additionally guarded on its two SLO-facing
+numbers, both lower-is-better:
+
+* ``p99_ms`` — the newest value must not rise more than the relative
+  noise band above the best (lowest) earlier value;
+* ``shed_rate`` — an ABSOLUTE slack (``--shed-slack``, default +0.05)
+  over the best earlier rate: a healthy baseline sheds 0.0, where any
+  relative band would make every nonzero shed either a regression or
+  a free pass.
+
 Stdlib-only.  Usage::
 
     python tools/bench_diff.py FILE [FILE...] [--threshold 0.1]
@@ -43,6 +54,9 @@ import os
 import sys
 
 DEFAULT_THRESHOLD = 0.10
+#: absolute shed-rate slack — relative bands degenerate at a 0.0
+#: baseline (see the module docstring)
+DEFAULT_SHED_SLACK = 0.05
 
 
 def load_run(path):
@@ -51,7 +65,7 @@ def load_run(path):
     Never raises: unreadable/unparseable files become invalid runs
     with the reason recorded."""
     run = {"path": path, "metric": None, "value": None,
-           "valid": False, "reason": None}
+           "valid": False, "reason": None, "serving": None}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -79,27 +93,77 @@ def load_run(path):
     else:
         run["valid"] = True
         run["value"] = float(value)
+    if run["valid"] and isinstance(payload.get("serving"), dict):
+        run["serving"] = payload["serving"]
     return run
 
 
-def diff(runs, threshold=DEFAULT_THRESHOLD, metric=None):
+def _serving_diff(valid, threshold, shed_slack):
+    """The serving-block guard: lower-is-better p99 under the relative
+    band, shed rate under the absolute slack.  Returns the report
+    sub-dict (``comparable`` false below two serving runs)."""
+    runs = [r for r in valid
+            if isinstance((r["serving"] or {}).get("p99_ms"),
+                          (int, float))]
+    out = {"comparable": False, "regression": False,
+           "runs": len(runs)}
+    if len(runs) < 2:
+        return out
+    last, earlier = runs[-1], runs[:-1]
+    best_p99 = min(float(r["serving"]["p99_ms"]) for r in earlier)
+    p99 = float(last["serving"]["p99_ms"])
+    ceiling = best_p99 * (1.0 + threshold)
+    out.update({
+        "comparable": True,
+        "p99_ms": {"latest": p99, "best_earlier": best_p99,
+                   "ceiling": round(ceiling, 6),
+                   "regression": p99 > ceiling},
+    })
+    sheds = [float(r["serving"]["shed_rate"]) for r in earlier
+             if isinstance(r["serving"].get("shed_rate"),
+                           (int, float))]
+    if sheds and isinstance(last["serving"].get("shed_rate"),
+                            (int, float)):
+        best_shed = min(sheds)
+        shed = float(last["serving"]["shed_rate"])
+        shed_ceiling = best_shed + shed_slack
+        out["shed_rate"] = {
+            "latest": shed, "best_earlier": best_shed,
+            "ceiling": round(shed_ceiling, 6),
+            "regression": shed > shed_ceiling}
+    out["regression"] = any(
+        out.get(k, {}).get("regression")
+        for k in ("p99_ms", "shed_rate"))
+    return out
+
+
+def diff(runs, threshold=DEFAULT_THRESHOLD, metric=None,
+         shed_slack=DEFAULT_SHED_SLACK):
     """Compare the series; returns the report dict.
 
     ``regression`` is true when the LAST valid run's value falls more
     than ``threshold`` below the best earlier valid value of the same
-    metric.  Fewer than two comparable runs -> ``comparable`` false
-    (no regression claim either way)."""
+    metric, OR when the serving-block guard trips (p99 above its
+    relative ceiling / shed rate above its absolute slack).  Fewer
+    than two comparable runs -> ``comparable`` false (no regression
+    claim either way)."""
     valid = [r for r in runs if r["valid"]
              and (metric is None or r["metric"] == metric)]
+    # the serving guard runs over every valid run carrying the block,
+    # BEFORE the dominant-metric filter: in a mixed directory the
+    # throughput metric may dominate, but a serving series must still
+    # be guarded
+    serving = _serving_diff(valid, threshold, shed_slack)
     report = {
-        "schema": "mxtpu-benchdiff/1",
+        "schema": "mxtpu-benchdiff/2",
         "threshold": threshold,
         "runs": len(runs),
         "valid_runs": len(valid),
         "skipped": [{"path": r["path"], "reason": r["reason"]}
                     for r in runs if not r["valid"]],
         "comparable": False,
-        "regression": False,
+        "regression": serving["regression"],
+        "serving": serving,
     }
     if metric is None and valid:
         # single-metric series expected; mixed series compare the
@@ -132,7 +196,7 @@ def diff(runs, threshold=DEFAULT_THRESHOLD, metric=None):
         "best_earlier": {"path": best["path"], "value": best["value"]},
         "floor": round(floor, 6),
         "change_frac": round(change, 6),
-        "regression": last["value"] < floor,
+        "regression": last["value"] < floor or serving["regression"],
     })
     return report
 
@@ -158,6 +222,10 @@ def main(argv=None):
                     help="relative noise band (default 0.10)")
     ap.add_argument("--metric", default=None,
                     help="compare only this metric name")
+    ap.add_argument("--shed-slack", type=float,
+                    default=DEFAULT_SHED_SLACK,
+                    help="absolute shed-rate slack for the serving "
+                         "guard (default 0.05)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
@@ -169,8 +237,12 @@ def main(argv=None):
     if not files:
         print("bench_diff: no files match", file=sys.stderr)
         return 2
+    if args.shed_slack < 0:
+        print("bench_diff: --shed-slack must be >= 0", file=sys.stderr)
+        return 2
     runs = [load_run(p) for p in files]
-    report = diff(runs, threshold=args.threshold, metric=args.metric)
+    report = diff(runs, threshold=args.threshold, metric=args.metric,
+                  shed_slack=args.shed_slack)
 
     if args.as_json:
         print(json.dumps(report, sort_keys=True))
@@ -178,6 +250,21 @@ def main(argv=None):
         for s in report["skipped"]:
             print("skip %s: %s" % (os.path.basename(s["path"]),
                                    s["reason"]))
+        srv = report["serving"]
+        if srv["comparable"]:
+            p99 = srv["p99_ms"]
+            print("serving p99 %.2fms vs best earlier %.2fms "
+                  "(ceiling %.2fms): %s"
+                  % (p99["latest"], p99["best_earlier"],
+                     p99["ceiling"],
+                     "REGRESSION" if p99["regression"] else "ok"))
+            if "shed_rate" in srv:
+                sr = srv["shed_rate"]
+                print("serving shed rate %.3f vs best earlier %.3f "
+                      "(+%.2f slack -> ceiling %.3f): %s"
+                      % (sr["latest"], sr["best_earlier"],
+                         args.shed_slack, sr["ceiling"],
+                         "REGRESSION" if sr["regression"] else "ok"))
         if not report["comparable"]:
             print("bench_diff: %d valid run(s) of metric %r — nothing "
                   "to compare" % (report["valid_runs"],

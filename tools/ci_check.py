@@ -3,7 +3,7 @@
 from pytest (tests/test_analysis.py::test_repo_lint_clean wires it into
 tier-1).
 
-Eighteen stages, all of which must be clean:
+Nineteen stages, all of which must be clean:
 
 1. **mxlint** (tools/mxlint.py) over ``mxnet_tpu/ tools/ examples/`` —
    the TPU-hazard rules MXL001-007; pragmas with reasons are the only
@@ -154,10 +154,26 @@ Eighteen stages, all of which must be clean:
     deadline-starved overload must SHED
     (``mxtpu_serve_shed_total`` > 0) while ok requests keep landing;
     ``tools/serve_top.py --json`` must emit a strict-parseable
-    ``mxtpu-servetop/1`` document naming the hot rung; and SIGKILLing
+    ``mxtpu-servetop/2`` document naming the hot rung; and SIGKILLing
     the replica mid-fleet must end with the watchdog's
     ``replica_restart`` in the supervisor timeline and ``/healthz``
     green again under a NEW pid — the fleet availability contract.
+
+19. **SLO gate** — the healthd engine (``mxnet_tpu/telemetry/slo.py``,
+    docs/api/telemetry.md): a serving replica with seconds-scale burn
+    windows under a deadline-starved shed storm must take
+    ``serve_shed_burn`` through the FULL alert lifecycle — firing
+    (both burn windows over the factor), ``/healthz?deep=1`` 503 with
+    a critical ``mxtpu-health/1`` verdict, ``tools/health_top.py
+    --json`` exit 1 naming the rule, ``tools/serve_top.py`` health
+    fields — and then RESOLVE back to 200 once only good traffic
+    flows; and a 2-process dry-run with seeded cross-rank skew must
+    fire ``fleet_skew`` at the supervisor's aggregator, leaving an
+    ``alert`` event in the run timeline that ``health_top.py --run``
+    replays (first-fired named) and ``run_top.py --summarize`` rolls
+    up.  (The stage-4 drift guard covers the ``mxtpu_alert_*`` /
+    ``mxtpu_slo_burn_rate`` / ``mxtpu_health_status`` metrics AND the
+    rule catalog vs its docs table automatically.)
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -193,7 +209,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/18] mxlint: %d finding(s) over %s"
+        say("ci_check[1/19] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -202,7 +218,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/18] registry selfcheck: %d problem(s)"
+        say("ci_check[2/19] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -216,14 +232,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/18] verify model %-22s %s" % (name, status))
+            say("ci_check[3/19] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/18] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/19] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -231,7 +247,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/18] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/19] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -239,7 +255,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/18] distview smoke: %d problem(s)"
+        say("ci_check[6/19] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -247,14 +263,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/18] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/19] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/18] perf ground truth: %d problem(s)"
+        say("ci_check[8/19] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -262,7 +278,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/18] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/19] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -270,7 +286,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/18] reshard gate: %d problem(s)"
+        say("ci_check[10/19] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -279,7 +295,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/18] numerics gate: %d problem(s)"
+        say("ci_check[11/19] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -288,7 +304,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/18] plan search: %d problem(s)"
+        say("ci_check[12/19] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
@@ -297,7 +313,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 13: SPMD gate (seeded-defect discrimination per
         # MXG011-016 rule + clean sweep over zoo and composed configs)
         problems = spmd_check(repo_root)
-        say("ci_check[13/18] spmd gate: %d problem(s)" % len(problems))
+        say("ci_check[13/19] spmd gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("spmd: %s" % p)
             say("  " + p)
@@ -305,7 +321,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 14: io observability gate (seeded slow stage ->
         # io_top --json names it; flight + counter verdicts agree)
         problems = ioview_check(repo_root)
-        say("ci_check[14/18] io observability: %d problem(s)"
+        say("ci_check[14/19] io observability: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("ioview: %s" % p)
@@ -315,7 +331,7 @@ def run(repo_root=_ROOT, out=None):
         # collective wait strictly smaller at bit-identical params,
         # bucket flight events parseable)
         problems = overlap_check(repo_root)
-        say("ci_check[15/18] overlap gate: %d problem(s)"
+        say("ci_check[15/19] overlap gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("overlap: %s" % p)
@@ -325,7 +341,7 @@ def run(repo_root=_ROOT, out=None):
         # mid-epoch -> world-size-1 resume with no sample dropped or
         # doubled; seeded slow producer -> backpressure depth raise)
         problems = io_resume_check(repo_root)
-        say("ci_check[16/18] io resume gate: %d problem(s)"
+        say("ci_check[16/19] io resume gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("io_resume: %s" % p)
@@ -335,7 +351,7 @@ def run(repo_root=_ROOT, out=None):
         # vs aval-compiled XLA plans; seeded MXG017/019/020/021
         # fixtures; mem_top --json strict parse)
         problems = memlive_check(repo_root)
-        say("ci_check[17/18] memory gate: %d problem(s)"
+        say("ci_check[17/19] memory gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("memlive: %s" % p)
@@ -344,10 +360,19 @@ def run(repo_root=_ROOT, out=None):
         # stage 18: serving gate (fleet replica smoke: coalescing,
         # shedding, serve_top contract, kill -> watchdog restart)
         problems = serving_check(repo_root)
-        say("ci_check[18/18] serving gate: %d problem(s)"
+        say("ci_check[18/19] serving gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("serving: %s" % p)
+            say("  " + p)
+
+        # stage 19: SLO gate (shed storm -> serve_shed_burn firing ->
+        # deep-healthz 503 -> resolve; seeded skew -> fleet_skew alert
+        # in the run timeline)
+        problems = slo_check(repo_root)
+        say("ci_check[19/19] slo gate: %d problem(s)" % len(problems))
+        for p in problems:
+            failures.append("slo: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -389,6 +414,33 @@ def telemetry_drift(repo_root=_ROOT):
         if not _derived(name):
             problems.append("metric %r appears in docs/api/telemetry.md "
                             "but is not in telemetry.CATALOG" % name)
+
+    # SLO rule-catalog drift (telemetry.slo): the built-in rules must
+    # selfcheck clean, and the hand-written rule table in the doc's
+    # marked block must list exactly the built-in rule names — the
+    # same both-directions guard the metric catalog gets
+    from mxnet_tpu.telemetry import slo
+    problems.extend("slo rule catalog: %s" % p
+                    for p in slo.selfcheck_rules())
+    m = re.search(r"<!-- slo-rules:begin -->(.*?)<!-- slo-rules:end -->",
+                  text, re.S)
+    if not m:
+        problems.append("docs/api/telemetry.md lacks the "
+                        "slo-rules:begin/end marker block (the "
+                        "hand-written SLO rule table)")
+    else:
+        doc_rules = {n for n in re.findall(r"`([a-z0-9_]+)`",
+                                           m.group(1))
+                     if not n.startswith(("mxtpu_", "mxnet_tpu"))}
+        code_rules = {r["name"] for r in slo.RULES}
+        for name in sorted(code_rules - doc_rules):
+            problems.append("SLO rule %r is in slo.RULES but missing "
+                            "from the docs/api/telemetry.md rule "
+                            "table" % name)
+        for name in sorted(doc_rules - code_rules):
+            problems.append("SLO rule %r appears in the docs/api/"
+                            "telemetry.md rule table but is not in "
+                            "slo.RULES" % name)
     return problems
 
 
@@ -604,7 +656,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/18] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/19] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -2002,7 +2054,7 @@ def serving_check(repo_root=_ROOT):
       the estimated rung wall cannot meet the deadline) while the ok
       counter keeps growing — load is refused, not queued to death;
     * ``tools/serve_top.py --json`` over the replica's ``/metrics``
-      must strict-parse as ``mxtpu-servetop/1`` and name a hot rung;
+      must strict-parse as ``mxtpu-servetop/2`` and name a hot rung;
     * SIGKILLing the replica's process group (exit rc -9, the rc-137
       container-kill shape) must produce the fleet watchdog's
       ``replica_restart`` supervisor event and a green ``/healthz``
@@ -2129,8 +2181,8 @@ def serving_check(repo_root=_ROOT):
             except ValueError as e:
                 problems.append("serve_top --json unparseable: %s" % e)
                 doc = {}
-            if doc.get("schema") != "mxtpu-servetop/1":
-                problems.append("serve_top schema %r != mxtpu-servetop/1"
+            if doc.get("schema") != "mxtpu-servetop/2":
+                problems.append("serve_top schema %r != mxtpu-servetop/2"
                                 % doc.get("schema"))
             if not doc.get("hot_rung"):
                 problems.append("serve_top named no hot rung")
@@ -2192,6 +2244,289 @@ def serving_check(repo_root=_ROOT):
                 sup.wait(20)
             except subprocess.TimeoutExpired:
                 sup.kill()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def slo_check(repo_root=_ROOT):
+    """SLO gate (stage 19, docs/api/telemetry.md).
+
+    Two legs over the healthd engine (``telemetry.slo``):
+
+    * **replica leg** — one serving replica with the shed burn-rate
+      windows shrunk to seconds (``MXNET_TPU_SLO_RULES`` compact
+      grammar, ``MXNET_TPU_SLO_TICK_S=0.2``).  A deadline-starved shed
+      storm must take ``serve_shed_burn`` to **firing** (both burn
+      windows over the factor), flip ``/healthz?deep=1`` to
+      503/critical, and surface through ``/alerts``,
+      ``tools/health_top.py --json`` (exit 1, naming
+      ``serve_shed_burn``) and ``tools/serve_top.py --json``
+      (``health``/``firing_rules``).  With the storm stopped and good
+      traffic flowing the alert must **resolve** and deep healthz
+      return 200 — the full lifecycle, not a latched flag;
+    * **fleet leg** — a 2-process dry-run with seeded cross-rank skew
+      and ``fleet_skew.bound`` lowered under it must write a
+      fleet-scope ``alert`` event into the run timeline, which
+      ``tools/health_top.py --run --json`` replays naming
+      ``fleet_skew`` as first-fired and ``tools/run_top.py
+      --summarize --json`` rolls up under ``health``.
+
+    Returns problem strings (empty = clean)."""
+    import json
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    problems = []
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_slo_gate_")
+    jsonl = os.path.join(tmpdir, "sup.jsonl")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    launcher = os.path.join(repo_root, "tools", "launch.py")
+    env = _scrubbed_launch_env({
+        "MXNET_TPU_TELEMETRY_JSONL": jsonl,
+        "MXNET_TPU_SLO_TICK_S": "0.2",
+        # seconds-scale burn windows so the gate sees fire AND resolve
+        "MXNET_TPU_SLO_RULES":
+            "serve_shed_burn.fast_s=2;serve_shed_burn.slow_s=5;"
+            "serve_shed_burn.resolve_for_s=2",
+    })
+    sup = None
+
+    def get(path, timeout=5):
+        try:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d%s" % (port, path),
+                    timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def post(rows, deadline_ms, out):
+        doc = {"data": [[0.5] * 16] * rows, "deadline_ms": deadline_ms}
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/predict" % port,
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out.append((r.status, json.loads(r.read())))
+        except urllib.error.HTTPError as e:
+            out.append((e.code, json.loads(e.read())))
+        except OSError as e:
+            out.append((-1, {"error": str(e)}))
+
+    def burst(n, deadline_ms):
+        out = []
+        threads = [threading.Thread(target=post,
+                                    args=(1, deadline_ms, out))
+                   for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    def tool(name, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(repo_root, "tools", name)]
+            + list(args), capture_output=True, text=True, env=env,
+            timeout=60, cwd=repo_root)
+
+    try:
+        sup = subprocess.Popen(
+            [sys.executable, launcher, "--fleet", "-n", "1",
+             "--restart-budget", "1",
+             "%s -m mxnet_tpu.serving --model mlp --data-shape 16 "
+             "--port %d --ladder 1,4 --window-ms 20 --queue-depth 8 "
+             "--deadline-ms 2000" % (sys.executable, port)],
+            env=env, cwd=repo_root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.time() + 180
+        up = False
+        while time.time() < deadline:
+            if sup.poll() is not None:
+                problems.append("fleet supervisor exited early "
+                                "(code %s)" % sup.returncode)
+                return problems
+            try:
+                if get("/healthz")[0] == 200:
+                    up = True
+                    break
+            except OSError:
+                time.sleep(0.5)
+        if not up:
+            problems.append("replica /healthz never answered 200")
+            return problems
+
+        # shed storm: every request deadline-starved -> the burn on
+        # BOTH shrunken windows blows past the factor within ~a tick
+        fired = False
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            burst(12, 1.0)
+            st, body = get("/healthz?deep=1")
+            doc = json.loads(body)
+            if st == 503 and doc.get("status") == "critical" and any(
+                    f.get("rule") == "serve_shed_burn"
+                    for f in (doc.get("health") or {})
+                    .get("firing", [])):
+                fired = True
+                break
+            time.sleep(0.3)
+        if not fired:
+            problems.append("shed storm never took serve_shed_burn to "
+                            "firing / deep healthz to 503-critical "
+                            "(last: %d %s)" % (st, body[:300]))
+            return problems
+
+        st, body = get("/alerts")
+        alerts_doc = json.loads(body)
+        if alerts_doc.get("schema") != "mxtpu-health/1":
+            problems.append("/alerts schema %r != mxtpu-health/1"
+                            % alerts_doc.get("schema"))
+        if not any(a.get("rule") == "serve_shed_burn"
+                   and a.get("state") == "firing"
+                   for a in alerts_doc.get("alerts", [])):
+            problems.append("/alerts does not show serve_shed_burn "
+                            "firing")
+
+        top = tool("health_top.py", "--url",
+                   "http://127.0.0.1:%d" % port, "--json")
+        if top.returncode != 1:
+            problems.append("health_top --json on a critical replica "
+                            "exited %d (want 1): %s"
+                            % (top.returncode, top.stderr[:200]))
+        else:
+            doc = json.loads(top.stdout)
+            if doc.get("status") != "critical" or not any(
+                    f.get("rule") == "serve_shed_burn"
+                    for f in doc.get("firing", [])):
+                problems.append("health_top --json did not name "
+                                "serve_shed_burn critical: %s"
+                                % top.stdout[:300])
+
+        top = tool("serve_top.py", "--url",
+                   "http://127.0.0.1:%d/metrics" % port, "--json")
+        if top.returncode != 0:
+            problems.append("serve_top --json exited %d: %s"
+                            % (top.returncode, top.stderr[:200]))
+        else:
+            doc = json.loads(top.stdout)
+            if doc.get("health") != "critical":
+                problems.append("serve_top health %r != 'critical' "
+                                "while the shed alert fires"
+                                % doc.get("health"))
+            if "serve_shed_burn" not in (doc.get("firing_rules")
+                                         or []):
+                problems.append("serve_top firing_rules %r misses "
+                                "serve_shed_burn"
+                                % doc.get("firing_rules"))
+
+        # recovery: good traffic only — the burn windows drain and the
+        # alert must RESOLVE (firing -> inactive after resolve_for_s)
+        resolved = False
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            burst(2, 2000.0)
+            st, body = get("/healthz?deep=1")
+            if st == 200 and \
+                    json.loads(body).get("status") == "healthy":
+                resolved = True
+                break
+            time.sleep(0.5)
+        if not resolved:
+            problems.append("serve_shed_burn never resolved after the "
+                            "storm stopped (last: %d %s)"
+                            % (st, body[:300]))
+    finally:
+        if sup is not None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(20)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if problems:
+        return problems
+
+    # ---- fleet leg: seeded skew must fire fleet_skew at the
+    # aggregator and land in the timeline as an alert event
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_slo_fleet_")
+    base = os.path.join(tmpdir, "run.jsonl")
+    env = _scrubbed_launch_env({
+        "MXNET_TPU_TELEMETRY_JSONL": base,
+        "DISTVIEW_STEPS": "3",
+        "DISTVIEW_SLOW_RANK": "1",
+        "DISTVIEW_SLOW_S": "0.05",
+        "DISTVIEW_BASE_S": "0.01",
+        "DISTVIEW_SKEW_S": "0.05",
+        "MXNET_TPU_SLO_RULES": "fleet_skew.bound=0.01",
+    })
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        res = subprocess.run(
+            [sys.executable, launcher, "-n", "2",
+             "--launcher", "local", "--heartbeat-interval", "0.1",
+             sys.executable,
+             os.path.join(repo_root, "tests",
+                          "dist_distview_worker.py")],
+            capture_output=True, text=True, timeout=240,
+            cwd=repo_root, env=env)
+        if res.returncode != 0:
+            problems.append("fleet-leg dry-run failed (%d): %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-800:]))
+            return problems
+        run_path = base + ".run"
+        fired = []
+        with open(run_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "alert" and \
+                        rec.get("scope") == "fleet":
+                    fired.append(rec)
+        if not any(r.get("rule") == "fleet_skew"
+                   and r.get("to") == "firing" for r in fired):
+            problems.append("seeded 50 ms skew under a 10 ms bound "
+                            "fired no fleet_skew alert event in the "
+                            "timeline (alert events: %r)" % fired[:3])
+            return problems
+        top = tool("health_top.py", "--run", run_path, "--json")
+        if top.returncode not in (0, 1):
+            problems.append("health_top --run exited %d: %s"
+                            % (top.returncode, top.stderr[:200]))
+        else:
+            doc = json.loads(top.stdout)
+            if (doc.get("first_fired") or {}).get("rule") != \
+                    "fleet_skew":
+                problems.append("health_top --run first_fired %r != "
+                                "fleet_skew"
+                                % doc.get("first_fired"))
+        top = tool("run_top.py", run_path, "--summarize", "--json")
+        if top.returncode != 0:
+            problems.append("run_top --summarize exited %d: %s"
+                            % (top.returncode, top.stderr[:200]))
+        else:
+            summary = json.loads(top.stdout)
+            health = summary.get("health") or {}
+            if health.get("status") not in ("degraded", "critical"):
+                problems.append("run summary health %r does not "
+                                "reflect the firing fleet_skew"
+                                % health)
+            if not summary.get("alerts"):
+                problems.append("run summary carries no alerts list")
+    except subprocess.TimeoutExpired:
+        problems.append("fleet-leg dry-run timed out")
+    finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 

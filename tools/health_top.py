@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""health_top — the health verdict: which SLO rules are burning, and
+which fired first.
+
+The reader half of the in-process SLO engine
+(``mxnet_tpu/telemetry/slo.py``, docs/api/telemetry.md).  Three
+sources, one document (schema ``mxtpu-health/1``):
+
+* **live** (``--url``, the default mode): GET a serving replica's
+  ``/alerts`` endpoint and render its verdict — status, every firing /
+  pending rule with its evidence (burn rates, values, bounds), and the
+  recently-resolved list.  Among the firing rules the one with the
+  LARGEST ``since_s`` fired first — usually the cause; the rest are
+  symptoms;
+* **postmortem over a flight dump** (``--flight dump.json``): replay
+  the ``alert`` events a crashed rank's black box recorded
+  (``mxtpu-flight/1``) and reconstruct the verdict at the moment of
+  death, naming which rule fired first;
+* **postmortem over a run timeline** (``--run base.run``): scan the
+  fleet aggregator's merged timeline (``mxtpu-run/1``) for
+  fleet-scope ``alert`` events and the ``fleet_health`` trailer —
+  the supervisor-side view (skew, digest mismatch, missing ranks).
+
+``--json`` emits the ``mxtpu-health/1`` document (live: the replica's
+own verdict verbatim; postmortem: the replayed reconstruction plus a
+``"first_fired"`` key).  Stdlib only — slo.py is loaded by file path
+for its schema constant, never through the framework.
+
+Exit codes: 0 healthy/degraded, 1 critical, 2 unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+from _distview import load_slo as _load_slo  # noqa: E402
+
+
+def _fetch_alerts(url):
+    """GET the ``/alerts`` document from a replica base URL (or a full
+    ``/alerts`` URL)."""
+    if not url.rstrip("/").endswith("/alerts"):
+        url = url.rstrip("/") + "/alerts"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode("utf-8", "replace"))
+
+
+def _normalize_flight(doc):
+    """Flight-dump ``alert`` events -> ordered transition tuples."""
+    out = []
+    for ev in doc.get("events", []):
+        if ev.get("kind") != "alert":
+            continue
+        out.append({"ts": ev.get("ts"), "rule": ev.get("rule"),
+                    "to": ev.get("to"), "severity": ev.get("severity"),
+                    "value": ev.get("value"),
+                    "summary": ev.get("summary")})
+    return out, doc.get("ts"), doc.get("rank")
+
+
+def _normalize_run(records):
+    """Run-timeline fleet ``alert`` events -> ordered transition
+    tuples, plus the ``fleet_health`` trailer when present."""
+    out, trailer, last_ts = [], None, None
+    for rec in records:
+        if rec.get("kind") != "event":
+            continue
+        if rec.get("ts") is not None:
+            last_ts = rec["ts"]
+        if rec.get("event") == "alert":
+            out.append({"ts": rec.get("ts"), "rule": rec.get("rule"),
+                        "to": rec.get("to"),
+                        "severity": rec.get("severity"),
+                        "value": rec.get("value"),
+                        "summary": None, "step": rec.get("step"),
+                        "bound": rec.get("bound")})
+        elif rec.get("event") == "fleet_health":
+            trailer = rec
+    return out, trailer, last_ts
+
+
+def replay(transitions, schema, now=None, rank=None):
+    """Reconstruct an ``mxtpu-health/1`` verdict from ordered
+    firing/resolved transition events (the postmortem path — the live
+    path gets the engine's own document).  The extra ``first_fired``
+    key names the rule whose firing transition came first."""
+    state = {}          # rule -> dict(severity, state, since, value, ..)
+    first = None
+    for t in transitions:
+        r = state.setdefault(t["rule"], {"rule": t["rule"]})
+        r["severity"] = t.get("severity") or r.get("severity", "warn")
+        for k in ("value", "summary", "step", "bound"):
+            if t.get(k) is not None:
+                r[k] = t[k]
+        if t["to"] == "firing":
+            r["state"] = "firing"
+            r["since"] = t.get("ts")
+            if first is None:
+                first = {"rule": t["rule"], "ts": t.get("ts"),
+                         "severity": r["severity"]}
+        elif t["to"] == "resolved":
+            r["state"] = "inactive"
+            r["resolved_ts"] = t.get("ts")
+    if now is None:
+        now = max((t.get("ts") or 0.0 for t in transitions),
+                  default=0.0)
+    firing = [r for r in state.values() if r.get("state") == "firing"]
+    status = "healthy"
+    for r in firing:
+        if r["severity"] == "critical":
+            status = "critical"
+            break
+        status = "degraded"
+
+    def desc(r):
+        d = {"rule": r["rule"], "severity": r["severity"],
+             "state": "firing"}
+        if r.get("since") is not None:
+            d["since_s"] = round(max(0.0, now - r["since"]), 3)
+        for k in ("value", "summary", "step", "bound"):
+            if r.get(k) is not None:
+                d[k] = r[k]
+        return d
+
+    return {
+        "schema": schema,
+        "ts": round(now, 6) if now else now,
+        "rank": rank,
+        "status": status,
+        "firing": [desc(r) for r in firing],
+        "pending": [],          # transitions only log fire/resolve
+        "resolved": [
+            {"rule": r["rule"], "severity": r["severity"],
+             "ago_s": round(max(0.0, now - r["resolved_ts"]), 3)}
+            for r in state.values()
+            if r.get("state") == "inactive"
+            and r.get("resolved_ts") is not None],
+        "rules": len(state),
+        "first_fired": first,
+    }
+
+
+def first_fired_live(doc):
+    """Among currently-firing rules the largest ``since_s`` fired
+    first (live mode has no transition log — the durations are the
+    evidence)."""
+    firing = doc.get("firing") or []
+    if not firing:
+        return None
+    best = max(firing, key=lambda f: f.get("since_s") or 0.0)
+    return {"rule": best["rule"], "severity": best.get("severity"),
+            "since_s": best.get("since_s")}
+
+
+def _evidence(entry):
+    """One-line evidence string for a firing/pending rule entry."""
+    bits = []
+    if entry.get("value") is not None:
+        try:
+            bits.append("value=%.4g" % float(entry["value"]))
+        except (TypeError, ValueError):
+            bits.append("value=%s" % entry["value"])
+    if entry.get("burn_fast") is not None:
+        bits.append("burn fast=%.2f slow=%.2f"
+                    % (entry["burn_fast"], entry.get("burn_slow", 0.0)))
+    if entry.get("bound") is not None:
+        bits.append("bound=%s" % entry["bound"])
+    if entry.get("step") is not None:
+        bits.append("step=%s" % entry["step"])
+    if entry.get("summary"):
+        bits.append("- %s" % entry["summary"])
+    return "  ".join(bits)
+
+
+def render(doc):
+    lines = []
+    status = doc.get("status", "?")
+    lines.append("health: %s  (rank %s, %s rules%s)"
+                 % (status.upper(), doc.get("rank", "?"),
+                    doc.get("rules", "?"),
+                    ", SLO engine disabled"
+                    if doc.get("disabled") else ""))
+    firing = doc.get("firing") or []
+    if firing:
+        lines.append("firing:")
+        for f in sorted(firing, key=lambda x: -(x.get("since_s") or 0)):
+            lines.append("  %-28s %-8s since %6.1fs  %s"
+                         % (f["rule"], f.get("severity", "?"),
+                            f.get("since_s") or 0.0, _evidence(f)))
+    ff = doc.get("first_fired") or first_fired_live(doc)
+    if ff:
+        lines.append("first fired: %s%s"
+                     % (ff["rule"],
+                        "  (%.1fs ago)" % ff["since_s"]
+                        if ff.get("since_s") is not None else
+                        "  (ts %s)" % ff.get("ts")
+                        if ff.get("ts") is not None else ""))
+    pending = doc.get("pending") or []
+    if pending:
+        lines.append("pending:")
+        for p in pending:
+            lines.append("  %-28s %-8s for %6.1fs  %s"
+                         % (p["rule"], p.get("severity", "?"),
+                            p.get("since_s") or 0.0, _evidence(p)))
+    resolved = doc.get("resolved") or []
+    if resolved:
+        lines.append("resolved recently:")
+        for r in resolved:
+            lines.append("  %-28s %-8s %6.1fs ago"
+                         % (r["rule"], r.get("severity", "?"),
+                            r.get("ago_s") or 0.0))
+    if not firing and not pending:
+        lines.append("no alerts firing — every rule inside its "
+                     "objective")
+    alerts = doc.get("alerts")
+    if alerts:
+        lines.append("rules:")
+        for a in alerts:
+            lines.append("  %-28s %-8s %-9s %s"
+                         % (a["rule"], a.get("severity", "?"),
+                            a.get("state", "?"), _evidence(a)))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="health_top",
+        description="render the SLO engine's health verdict "
+                    "(docs/api/telemetry.md)")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--url", default=None,
+                     help="replica base URL (default http://127.0.0.1:"
+                          "$MXNET_TPU_SERVE_PORT); /alerts is fetched")
+    src.add_argument("--flight", default=None, metavar="DUMP",
+                     help="postmortem: replay alert events from an "
+                          "mxtpu-flight/1 black-box dump")
+    src.add_argument("--run", default=None, metavar="TIMELINE",
+                     help="postmortem: fleet alert events from an "
+                          "mxtpu-run/1 merged timeline")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the mxtpu-health/1 document")
+    args = ap.parse_args(argv)
+    slo = _load_slo()
+
+    try:
+        if args.flight:
+            with open(args.flight) as f:
+                dump = json.load(f)
+            if dump.get("schema") != "mxtpu-flight/1":
+                raise ValueError("%r is not an mxtpu-flight/1 dump "
+                                 "(schema %r)"
+                                 % (args.flight, dump.get("schema")))
+            transitions, ts, rank = _normalize_flight(dump)
+            doc = replay(transitions, slo.HEALTH_SCHEMA,
+                         now=ts, rank=rank)
+        elif args.run:
+            from _distview import load_distview
+            dv = load_distview()
+            records = dv.read_run_timeline(args.run)
+            transitions, trailer, last_ts = _normalize_run(records)
+            doc = replay(transitions, slo.HEALTH_SCHEMA,
+                         now=last_ts, rank="fleet")
+            if trailer is not None:
+                # the aggregator's own close-time verdict wins over
+                # the replay for status (it saw every record)
+                doc["status"] = trailer.get("status", doc["status"])
+                doc["rules"] = trailer.get("rules", doc["rules"])
+        else:
+            url = args.url
+            if not url:
+                port = os.environ.get("MXNET_TPU_SERVE_PORT", "8080")
+                url = "http://127.0.0.1:%s" % port
+            doc = _fetch_alerts(url)
+    except Exception as e:  # mxlint: allow-broad-except(every source failure — connection refused, bad JSON, wrong schema, missing file — means the same thing here: no verdict; all map to the documented exit code 2)
+        sys.stderr.write("health_top: cannot read verdict: %s\n" % e)
+        return 2
+
+    if args.json:
+        print(json.dumps(doc, sort_keys=True))
+    else:
+        print(render(doc))
+    return 1 if doc.get("status") == "critical" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,11 +120,32 @@ def _make_aggregator(opts):
     if dv is None:
         return None
     try:
-        return dv.RunAggregator(base, opts.num_workers)
+        agg = dv.RunAggregator(base, opts.num_workers)
     except Exception as e:  # mxlint: allow-broad-except(optional observability — see _load_distview)
         sys.stderr.write("launch.py: cannot start run aggregator: "
                          "%s\n" % e)
         return None
+    # fleet-scope SLO rules (telemetry/slo.py, loaded by path — same
+    # stdlib-only contract): every merged step is judged and alert
+    # transitions land in the timeline; a broken module degrades to an
+    # unjudged timeline, exactly like a missing aggregator
+    try:
+        import importlib.util
+        spath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             os.pardir, "mxnet_tpu", "telemetry",
+                             "slo.py")
+        spec = importlib.util.spec_from_file_location("mxtpu_slo",
+                                                      spath)
+        slo = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(slo)
+        if slo.enabled():
+            fh = slo.FleetHealth(num_ranks=opts.num_workers)
+            if fh.specs:
+                agg.health = fh
+    except Exception as e:  # mxlint: allow-broad-except(optional observability — see _load_distview)
+        sys.stderr.write("launch.py: fleet SLO evaluation unavailable "
+                         "(%s)\n" % e)
+    return agg
 
 
 def _run_workers_once(opts, command, attempt, agg=None):

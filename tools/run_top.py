@@ -9,6 +9,8 @@ supervisor stream (``<base>.run``); this tool renders it —
 * **dashboard** (default): the run header, the last N step rows
   (p50/max across ranks, the worst rank, measured skew), each rank's
   cumulative segment split (compute / input-wait / collective-wait),
+  the fleet health verdict with any firing SLO rules
+  (telemetry.slo.FleetHealth — skew, digest mismatch, missing ranks),
   and recent supervisor events;
 * **live** (``--follow``): redraw the dashboard every ``--interval``
   seconds while the job runs, top(1)-style, until the ``run_end``
@@ -46,6 +48,13 @@ from _distview import load_distview as _load_distview  # noqa: E402
 #: summaries shown live cover that window (postmortem --summarize is
 #: exact over the whole file)
 _FOLLOW_WINDOW = 5000
+
+
+def _firing_names(health):
+    """Rule names from a fleet-health dict — the trailer carries full
+    describe() dicts, the derived fallback carries bare names."""
+    return [f if isinstance(f, str) else f.get("rule", "?")
+            for f in (health.get("firing") or [])]
 
 
 def _bar(parts, width=30):
@@ -97,6 +106,14 @@ def format_dashboard(records, summary, steps_shown=12):
                "  [DIGEST MISMATCH in %d step(s)]"
                % summary["digest_mismatch_steps"]
                if summary.get("digest_mismatch_steps") else ""))
+    health = summary.get("health")
+    if health:
+        firing = _firing_names(health)
+        lines.append(
+            "fleet health: %s%s — tools/health_top.py --run for the "
+            "alert replay" % (str(health.get("status", "?")).upper(),
+                              "  firing: " + " ".join(firing)
+                              if firing else ""))
     lines.append("")
     lines.append("  step  p50 ms   max ms  worst  skew ms  ranks")
     for s in steps[-steps_shown:]:
@@ -141,7 +158,9 @@ def format_dashboard(records, summary, steps_shown=12):
             fields = " ".join(
                 "%s=%s" % (k, e[k]) for k in ("rank", "pid", "attempt",
                                               "exit_code",
-                                              "telemetry_port", "path")
+                                              "telemetry_port", "path",
+                                              "rule", "to", "severity",
+                                              "value", "status")
                 if e.get(k) is not None)
             lines.append("  %-18s %s" % (e.get("event", "?"), fields))
     return "\n".join(lines)
@@ -181,6 +200,20 @@ def format_summary(summary):
                         % summary["digest_mismatch_steps"]
                         if summary.get("digest_mismatch_steps") else
                         ""))
+    health = summary.get("health")
+    if health:
+        firing = _firing_names(health)
+        lines.append("  fleet health:   %s%s"
+                     % (str(health.get("status", "?")).upper(),
+                        "  firing: " + " ".join(firing)
+                        if firing else ""))
+    for a in summary.get("alerts") or []:
+        lines.append("    alert: %-22s -> %-9s %s"
+                     % (a.get("rule", "?"), a.get("to", "?"),
+                        " ".join("%s=%s" % (k, a[k])
+                                 for k in ("severity", "value", "bound",
+                                           "step") if a.get(k)
+                                 is not None)))
     for r in sorted(summary.get("per_rank") or {}, key=int):
         pr = summary["per_rank"][r]
         seg = pr.get("segments_s") or {}

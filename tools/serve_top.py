@@ -15,10 +15,14 @@ the ``mxtpu_serve_*`` family (docs/api/serving.md):
   the batching window closes too early);
 * request latency p50/p99 interpolated from the ``total`` segment
   histogram, plus the queue/pad/dispatch split means;
-* current batcher queue depth.
+* current batcher queue depth;
+* the SLO engine's health verdict (``mxtpu_health_status``) with the
+  firing rules by name (``mxtpu_alert_state`` == 2) and the firing
+  count per severity (``mxtpu_alerts_firing``) — the drill-down is
+  ``tools/health_top.py``.
 
 ``--json`` emits one machine-readable document (schema
-``mxtpu-servetop/1``) for CI assertions.  Stdlib only — never imports
+``mxtpu-servetop/2``) for CI assertions.  Stdlib only — never imports
 the framework.  Exit codes: 0 ok, 2 unreadable input.
 """
 from __future__ import annotations
@@ -30,7 +34,10 @@ import re
 import sys
 import urllib.request
 
-SCHEMA = "mxtpu-servetop/1"
+SCHEMA = "mxtpu-servetop/2"
+
+#: mxtpu_health_status gauge value -> verdict string (telemetry.slo)
+_HEALTH = {0: "healthy", 1: "degraded", 2: "critical"}
 
 _LINE = re.compile(r'^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$')
 _LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
@@ -123,6 +130,16 @@ def summarize(metrics):
     p99 = _quantile(total_buckets, 0.99)
 
     depth = metrics.get("mxtpu_serve_queue_depth", [])
+
+    # the SLO verdict: absent gauges (engine disabled / never ticked)
+    # leave health None — "no verdict" is not "healthy"
+    status = metrics.get("mxtpu_health_status", [])
+    firing_rules = sorted(
+        kv.get("rule", "") for kv, val in
+        metrics.get("mxtpu_alert_state", []) if val >= 2)
+    firing_sev = {k: int(v) for k, v in _sum_by(
+        metrics.get("mxtpu_alerts_firing", []), "severity").items()
+        if v > 0}
     doc = {
         "schema": SCHEMA,
         "requests": {k: int(v) for k, v in sorted(outcomes.items())},
@@ -143,6 +160,9 @@ def summarize(metrics):
             "segment_mean": segments,
         },
         "queue_depth": int(depth[0][1]) if depth else None,
+        "health": _HEALTH.get(int(status[0][1])) if status else None,
+        "firing_rules": firing_rules,
+        "alerts_firing": firing_sev,
     }
     return doc
 
@@ -178,6 +198,12 @@ def render(doc):
                                     lat["segment_mean"].items())))
     if doc["queue_depth"] is not None:
         lines.append("queue:    depth=%d" % doc["queue_depth"])
+    if doc["health"] is not None:
+        lines.append("health:   %s%s"
+                     % (doc["health"].upper(),
+                        "  firing: %s"
+                        % " ".join(doc["firing_rules"])
+                        if doc["firing_rules"] else ""))
     if not doc["requests"] and not doc["rung_dispatches"]:
         lines.append("no mxtpu_serve_* samples yet — has the replica "
                      "served a request?")
@@ -197,7 +223,7 @@ def main(argv=None):
                         help="read a saved exposition snapshot instead "
                              "of fetching --url")
     parser.add_argument("--json", action="store_true",
-                        help="emit one mxtpu-servetop/1 JSON document")
+                        help="emit one mxtpu-servetop/2 JSON document")
     args = parser.parse_args(argv)
 
     if args.file:
