@@ -137,6 +137,29 @@ _CATALOG = {
     "MXNET_TPU_FLIGHT_EVENTS": ("512", "honored",
                                 "flight-recorder ring capacity "
                                 "(oldest events fall off)"),
+    "MXNET_TPU_TRACE_SAMPLE": ("1", "honored",
+                               "distributed-tracing sample rate for "
+                               "ordinary traces, clamped to [0,1] "
+                               "(error/shed and the slow tail are "
+                               "ALWAYS kept; 0 disables tracing "
+                               "entirely — start_trace returns the "
+                               "shared NULL_TRACE and the request "
+                               "path allocates nothing)"),
+    "MXNET_TPU_TRACE_DIR": ("", "honored",
+                            "append kept traces as mxtpu-trace/1 "
+                            "JSONL to trace.rank<N>.jsonl here; "
+                            "tools/launch.py merges the per-rank "
+                            "files into trace.merged.jsonl at job "
+                            "end and tools/trace_top.py renders"),
+    "MXNET_TPU_TRACE_RING": ("256", "honored",
+                             "in-process kept-trace ring capacity "
+                             "(floor 8; oldest traces fall off)"),
+    "MXNET_TPU_TRACE_SLOW_PCT": ("0.95", "honored",
+                                 "slow-tail retention percentile: "
+                                 "root durations at or above this "
+                                 "percentile of the recent window "
+                                 "are always kept regardless of the "
+                                 "sample rate"),
     "MXNET_TPU_IOVIEW_EVERY": ("1", "honored",
                                "attach the input-pipeline io block "
                                "(per-stage seconds/items/bytes, "

@@ -1654,19 +1654,54 @@ class ShardedTrainer:
         import time as _time
         from .. import telemetry
         from ..telemetry import flight as _flight, memory as _tmem
-        _flight.record("step_begin", program="trainer.step",
-                       step=self._step_count + 1)
-        self._seg = {"input_s": 0.0, "collective_s": 0.0, "skew": None}
-        t0 = _time.perf_counter()
-        with telemetry.span("trainer.step", category="trainer"), \
-                _flight.crash_guard("trainer.step"), \
-                _tmem.annotate_oom("trainer.step"):
-            loss = self._step_impl(batch)
-        total = _time.perf_counter() - t0
+        from ..telemetry import tracing as _tracing
+        # one distributed trace per step: the existing distview
+        # segments become its child spans, and flight events recorded
+        # inside (step_begin, any error) carry the trace id
+        tr = _tracing.start_trace("trainer.step",
+                                  attrs={"step": self._step_count + 1})
+        with tr:
+            _flight.record("step_begin", program="trainer.step",
+                           step=self._step_count + 1)
+            self._seg = {"input_s": 0.0, "collective_s": 0.0,
+                         "skew": None}
+            t0 = _time.perf_counter()
+            ts0 = _time.time()
+            step_ctx = None
+            with telemetry.span("trainer.step", category="trainer"), \
+                    _flight.crash_guard("trainer.step"), \
+                    _tmem.annotate_oom("trainer.step"):
+                step_ctx = _tracing.current()
+                loss = self._step_impl(batch)
+            total = _time.perf_counter() - t0
+            if step_ctx is not None:
+                self._record_segment_spans(step_ctx, ts0, total)
         telemetry.step_end(samples=self._batch_samples(batch),
                            step_time=total,
                            extra=self._segments_extra(total))
         return loss
+
+    def _record_segment_spans(self, ctx, ts0, total_s):
+        """The step's segment split as trace spans under the
+        ``trainer.step`` span (``ctx`` is that span's own context, so
+        these land as its children): input_wait, compute (the
+        remainder, distview's definition), collective_wait — laid out
+        sequentially from ``ts0`` so the waterfall reads like the
+        step."""
+        from ..telemetry import tracing as _tracing
+        seg = self._seg
+        inp = max(0.0, float(seg["input_s"]))
+        coll = max(0.0, float(seg["collective_s"]))
+        comp = max(0.0, float(total_s) - inp - coll)
+        _tracing.record_span(ctx, "step.input_wait", ts0, inp)
+        _tracing.record_span(ctx, "step.compute", ts0 + inp, comp)
+        attrs = None
+        sk = seg.get("skew")
+        if sk is not None:
+            attrs = {"skew_s": round(sk["skew_s"], 6),
+                     "slowest_rank": sk["slowest_rank"]}
+        _tracing.record_span(ctx, "step.collective_wait",
+                             ts0 + inp + comp, coll, attrs=attrs)
 
     def _segments_extra(self, total_s, count=1):
         """The straggler-attribution fields for this step's JSONL

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..predictor import pad_batch
+from ..telemetry import tracing
 
 __all__ = ["Batcher", "RequestShed"]
 
@@ -47,11 +48,16 @@ class RequestShed(MXNetError):
 
     ``reason``: ``"queue_full"`` (bounded queue at depth) or
     ``"deadline"`` (remaining deadline below the estimated rung wall).
-    The serving front door maps this to HTTP 503."""
+    ``rid`` is the batcher's request id — grep-able in the shed flight
+    events and joinable to the request's trace.  The serving front
+    door maps this to HTTP 503."""
 
-    def __init__(self, reason, detail):
-        super().__init__("request shed (%s): %s" % (reason, detail))
+    def __init__(self, reason, detail, rid=None):
+        super().__init__("request%s shed (%s): %s"
+                         % ("" if rid is None else " %d" % rid,
+                            reason, detail))
         self.reason = reason
+        self.rid = rid
 
 
 def _env_float(name, default):
@@ -64,9 +70,9 @@ def _env_float(name, default):
 
 class _Request:
     __slots__ = ("rid", "feed", "rows", "deadline", "enqueue_t",
-                 "dequeue_t", "done", "outputs", "error")
+                 "dequeue_t", "done", "outputs", "error", "trace")
 
-    def __init__(self, rid, feed, rows, deadline, now):
+    def __init__(self, rid, feed, rows, deadline, now, trace=None):
         self.rid = rid
         self.feed = feed
         self.rows = rows
@@ -76,6 +82,7 @@ class _Request:
         self.done = threading.Event()
         self.outputs = None
         self.error = None
+        self.trace = trace      # the submitter's TraceContext (or None)
 
 
 class Batcher:
@@ -156,17 +163,30 @@ class Batcher:
         injected ``serve.dispatch`` fault surfaces here)."""
         feed, rows = self._validate(inputs)
         now = time.monotonic()
-        ddl = now + (deadline_ms / 1e3 if deadline_ms else self._deadline)
-        req = _Request(next(self._ids), feed, rows, ddl, now)
+        # deadline_ms is EXPLICIT whenever it is not None: an explicit
+        # 0 or negative deadline is already expired, never the default
+        if deadline_ms is not None:
+            ddl = now + deadline_ms / 1e3
+        else:
+            ddl = now + self._deadline
+        req = _Request(next(self._ids), feed, rows, ddl, now,
+                       trace=tracing.current())
         with self._cv:
             if self._stopped:
                 raise MXNetError("batcher stopped")
+            if deadline_ms is not None and deadline_ms <= 0:
+                self._count_shed("deadline", req,
+                                 "explicit deadline_ms=%r expired on "
+                                 "arrival" % (deadline_ms,))
+                raise RequestShed(
+                    "deadline", "explicit deadline_ms=%r is already "
+                    "expired on arrival" % (deadline_ms,), rid=req.rid)
             if len(self._pending) >= self._depth:
                 self._count_shed("queue_full", req,
                                  "queue depth %d" % self._depth)
                 raise RequestShed(
                     "queue_full", "queue at bounded depth %d"
-                    % self._depth)
+                    % self._depth, rid=req.rid)
             # shed EARLY: even alone in the smallest rung this request
             # cannot finish inside its deadline
             min_wall = self._ladder.estimate_wall(
@@ -179,7 +199,7 @@ class Batcher:
                 raise RequestShed(
                     "deadline", "remaining deadline %.1fms cannot cover "
                     "the estimated rung wall %.1fms"
-                    % ((ddl - now) * 1e3, min_wall * 1e3))
+                    % ((ddl - now) * 1e3, min_wall * 1e3), rid=req.rid)
             self._pending.append(req)
             self._m_depth.set(len(self._pending))
             self._cv.notify_all()
@@ -230,14 +250,22 @@ class Batcher:
         from ..telemetry import flight
         self._m_shed.labels(reason=reason).inc()
         self._m_requests.labels(outcome="shed").inc()
-        flight.record("request_shed", reason=reason, rows=req.rows,
+        if req.trace is not None:
+            # the trace outlives the refusal: mark it shed so tail-
+            # sampling ALWAYS keeps it and trace_top can explain it
+            tracing.set_trace_status(req.trace, "shed",
+                                     shed_reason=reason, rid=req.rid)
+        extra = {} if req.trace is None else \
+            {"trace_id": req.trace.trace_id}
+        flight.record("request_shed", reason=reason, rid=req.rid,
+                      rows=req.rows,
                       waited_ms=round(
                           (time.monotonic() - req.enqueue_t) * 1e3, 3),
-                      detail=detail)
+                      detail="rid %d: %s" % (req.rid, detail), **extra)
 
     def _shed_queued(self, req, reason, detail):
         self._count_shed(reason, req, detail)
-        req.error = RequestShed(reason, detail)
+        req.error = RequestShed(reason, detail, rid=req.rid)
         req.done.set()
 
     # ------------------------------------------------------------ scheduler
@@ -307,6 +335,14 @@ class Batcher:
                     % ((req.deadline - now) * 1e3, rung, est * 1e3))
         if not batch:
             return
+        # batch fan-in: ONE dispatch span id is recorded into EVERY
+        # member trace (each parented under that trace's root) with
+        # span links naming all member roots, so trace_top can walk
+        # from any member to its batchmates
+        traced = [r for r in batch if r.trace is not None]
+        links = [{"trace_id": r.trace.trace_id,
+                  "span_id": r.trace.span_id} for r in traced]
+        disp_sid = tracing.new_span_id() if traced else None
         t_pad = time.monotonic()
         feed = {}
         for n in self._ladder.input_names:
@@ -314,11 +350,34 @@ class Batcher:
                 if len(batch) > 1 else batch[0].feed[n]
             feed[n] = pad_batch(stacked, rung)
         t_disp = time.monotonic()
+        # attach the first member's context (scheduler thread has none
+        # of its own) so the ladder's tracing.annotate() calls and any
+        # fault delay land on THIS dispatch
+        dctx = prev_ctx = None
+        if traced:
+            home = traced[0].trace
+            dctx = tracing.TraceContext(home.trace_id, disp_sid,
+                                        home.span_id)
+            prev_ctx = tracing.attach(dctx)
         try:
             resilience.fault_point("serve.dispatch")
             outs = self._ladder.dispatch(rung, feed)
         except BaseException as e:  # mxlint: allow-broad-except(fail fast: every request of a poisoned batch gets THE error and the scheduler keeps draining — a wedged queue would turn one bad dispatch into an outage)
+            t_err = time.monotonic()
+            notes = tracing.take_annotations()
+            if dctx is not None:
+                tracing.detach(prev_ctx)
+            epoch_off = time.time() - time.monotonic()
             for req in batch:
+                if req.trace is not None:
+                    attrs = dict(notes, rung=rung, rows=rows,
+                                 requests=len(batch),
+                                 error=str(e)[:200])
+                    tracing.record_span(
+                        req.trace, "serve.dispatch",
+                        t_disp + epoch_off, t_err - t_disp,
+                        attrs=attrs, links=links, status="error",
+                        span_id=disp_sid)
                 req.error = e if isinstance(e, Exception) else \
                     MXNetError("dispatch aborted: %r" % (e,))
                 req.done.set()
@@ -329,6 +388,9 @@ class Batcher:
                 raise
             return
         t_done = time.monotonic()
+        notes = tracing.take_annotations()
+        if dctx is not None:
+            tracing.detach(prev_ctx)
         wall = t_done - t_disp
         self._ladder.observe_wall(rung, wall)
         self._m_rung.labels(rung=str(rung)).inc()
@@ -336,17 +398,47 @@ class Batcher:
             rows / float(rung))
         flight.record("rung_dispatch", rung=rung, rows=rows,
                       requests=len(batch),
-                      wall_ms=round(wall * 1e3, 3))
+                      wall_ms=round(wall * 1e3, 3),
+                      **({"trace_id": traced[0].trace.trace_id}
+                         if traced else {}))
+        epoch_off = time.time() - time.monotonic()
+        disp_attrs = dict(notes, rung=rung, rows=rows,
+                          requests=len(batch), pad_rows=rung - rows)
         lat = self._m_latency
         off = 0
         for req in batch:
             req.outputs = [o[off:off + req.rows] if getattr(o, "ndim", 0)
                            else o for o in outs]
             off += req.rows
+            # spans are recorded BEFORE done.set(): once the submitter
+            # wakes, its root trace may exit and stop accepting spans
+            if req.trace is not None:
+                ctx = req.trace
+                tracing.record_span(
+                    ctx, "serve.queue", req.enqueue_t + epoch_off,
+                    req.dequeue_t - req.enqueue_t,
+                    attrs={"rid": req.rid})
+                tracing.record_span(
+                    ctx, "serve.coalesce", req.dequeue_t + epoch_off,
+                    t_pad - req.dequeue_t,
+                    attrs={"requests": len(batch)})
+                tracing.record_span(
+                    ctx, "serve.pad", t_pad + epoch_off, t_disp - t_pad,
+                    attrs={"rows": rows, "pad_rows": rung - rows})
+                tracing.record_span(
+                    ctx, "serve.dispatch", t_disp + epoch_off, wall,
+                    attrs=disp_attrs, links=links, span_id=disp_sid)
+                t_slice = time.monotonic()
+                tracing.record_span(
+                    ctx, "serve.slice", t_done + epoch_off,
+                    t_slice - t_done, attrs={"rows": req.rows})
             req.done.set()
             lat.labels(segment="queue").observe(
                 req.dequeue_t - req.enqueue_t)
             lat.labels(segment="pad").observe(t_disp - t_pad)
             lat.labels(segment="dispatch").observe(wall)
-            lat.labels(segment="total").observe(t_done - req.enqueue_t)
+            lat.labels(segment="total").observe(
+                t_done - req.enqueue_t,
+                exemplar=None if req.trace is None
+                else req.trace.trace_id)
         self._m_requests.labels(outcome="ok").inc(len(batch))

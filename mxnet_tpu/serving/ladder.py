@@ -34,6 +34,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..predictor import Predictor, pad_batch
+from ..telemetry import tracing
 
 __all__ = ["BatchLadder", "ladder_rungs", "DEFAULT_RUNGS"]
 
@@ -279,14 +280,22 @@ class BatchLadder:
             raise MXNetError("no rung %r in ladder %r"
                              % (rung, self._rungs))
         pred = self._preds[rung]
+        pad_rows = 0
         for n in self._input_names:
             arr = feed[n]
             if arr.shape[0] != rung:
+                pad_rows = max(pad_rows, rung - arr.shape[0])
                 arr = pad_batch(arr, rung)
             pred.set_input(n, arr)
         pred._partial_rows.clear()      # the batcher owns slicing
         pred._executor.forward(is_train=False)
         outs = pred._executor.outputs
+        # detail for the batcher's serve.dispatch trace span (no-op
+        # without an attached context): the rung actually run, rows the
+        # ladder itself had to pad, and the slice handed back for the
+        # batcher to split per request
+        tracing.annotate(ladder_rung=rung, ladder_pad_rows=pad_rows,
+                         ladder_slice_outputs=len(outs))
         return [outs[i].asnumpy() for i in range(len(outs))]
 
     def describe(self):

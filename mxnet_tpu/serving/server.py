@@ -6,9 +6,13 @@ Endpoints:
   optional ``"deadline_ms"``; every other key is a model input (rows
   along axis 0; a single unbatched row is accepted).  Replies 200
   ``{"outputs": [...], "rows": N, "wall_ms": W}``, 503
-  ``{"shed": reason}`` when the load shedder refused the request, 500
-  ``{"error": msg}`` when the dispatch failed (fail fast — the chaos
-  seam surfaces here);
+  ``{"shed": reason, "rid": n, "trace_id": ...}`` when the load
+  shedder refused the request, 500 ``{"error": msg}`` when the
+  dispatch failed (fail fast — the chaos seam surfaces here).  Every
+  request runs under a distributed trace (:mod:`..telemetry.tracing`):
+  an inbound W3C ``traceparent`` header continues the caller's trace,
+  and every reply carries ``X-Trace-Id`` + ``traceparent`` response
+  headers naming the trace the exported record joins on;
 * ``GET /healthz`` — 200 with ladder/queue state while the batcher
   thread is alive, 503 once it stopped (the fleet watchdog's liveness
   contract).  ``GET /healthz?deep=1`` additionally consults the SLO
@@ -42,6 +46,7 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import tracing
 from .batcher import Batcher, RequestShed
 
 __all__ = ["Server", "serve_port"]
@@ -125,12 +130,19 @@ class Server:
 
         class _Handler(BaseHTTPRequestHandler):
             def _send(self, doc, status=200,
-                      ctype="application/json"):
+                      ctype="application/json", trace=None):
                 body = doc if isinstance(doc, bytes) else \
                     json.dumps(doc).encode("utf-8")
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if trace is not None and trace.ctx is not None:
+                    # propagation contract: every /predict reply names
+                    # its trace, so a slow or shed reply is joinable to
+                    # the exported trace record
+                    self.send_header("X-Trace-Id", trace.trace_id)
+                    self.send_header("traceparent",
+                                     trace.ctx.to_traceparent())
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -181,31 +193,48 @@ class Server:
                     self.send_error(404)
                     return
                 t0 = time.perf_counter()
-                try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    doc = json.loads(self.rfile.read(n) or b"{}")
-                    if not isinstance(doc, dict):
-                        raise MXNetError("predict body must be a JSON "
-                                         "object of model inputs")
-                    deadline_ms = doc.pop("deadline_ms", None)
-                    outs = server._batcher.submit(
-                        doc, deadline_ms=deadline_ms)
-                except RequestShed as e:
-                    self._send({"shed": e.reason, "error": str(e)},
-                               status=503)
-                    return
-                except Exception as e:  # mxlint: allow-broad-except(the front door maps EVERY failure — bad JSON, missing inputs, an injected chaos fault — to a fail-fast 4xx/5xx reply; an unhandled exception would silently drop the connection instead)
-                    status = 400 if isinstance(e, (ValueError, KeyError)) \
-                        else 500
-                    self._send({"error": str(e)[:500]}, status=status)
-                    return
-                rows = int(np.asarray(outs[0]).shape[0]) if outs else 0
-                self._send({
-                    "outputs": [np.asarray(o).tolist() for o in outs],
-                    "rows": rows,
-                    "wall_ms": round((time.perf_counter() - t0) * 1e3,
-                                     3),
-                })
+                # one trace per request; an inbound traceparent header
+                # continues the caller's trace (NULL_TRACE when off)
+                tr = tracing.start_trace(
+                    "serve.request",
+                    traceparent=self.headers.get("traceparent"))
+                with tr:
+                    try:
+                        n = int(self.headers.get("Content-Length", "0"))
+                        doc = json.loads(self.rfile.read(n) or b"{}")
+                        if not isinstance(doc, dict):
+                            raise MXNetError(
+                                "predict body must be a JSON object of "
+                                "model inputs")
+                        deadline_ms = doc.pop("deadline_ms", None)
+                        outs = server._batcher.submit(
+                            doc, deadline_ms=deadline_ms)
+                    except RequestShed as e:
+                        tr.set_status("shed", shed_reason=e.reason)
+                        body = {"shed": e.reason, "error": str(e)}
+                        if e.rid is not None:
+                            body["rid"] = e.rid
+                        if tr.trace_id is not None:
+                            body["trace_id"] = tr.trace_id
+                        self._send(body, status=503, trace=tr)
+                        return
+                    except Exception as e:  # mxlint: allow-broad-except(the front door maps EVERY failure — bad JSON, missing inputs, an injected chaos fault — to a fail-fast 4xx/5xx reply; an unhandled exception would silently drop the connection instead)
+                        tr.set_status("error", error=str(e)[:200])
+                        status = 400 if isinstance(
+                            e, (ValueError, KeyError)) else 500
+                        self._send({"error": str(e)[:500]},
+                                   status=status, trace=tr)
+                        return
+                    rows = int(np.asarray(outs[0]).shape[0]) \
+                        if outs else 0
+                    tr.annotate(rows=rows)
+                    self._send({
+                        "outputs": [np.asarray(o).tolist()
+                                    for o in outs],
+                        "rows": rows,
+                        "wall_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 3),
+                    }, trace=tr)
 
             def log_message(self, fmt, *args):
                 pass        # request logs ride the metrics, not stderr

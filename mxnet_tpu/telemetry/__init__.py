@@ -19,6 +19,16 @@ Three engines:
   manager/decorator recording wall time per phase, wired through the
   executor, Module, both trainers, and the IO stack, and mirrored into
   the Chrome trace when the profiler is running;
+* **distributed tracing** (:mod:`.tracing`) — W3C-traceparent trace
+  context (thread-local + explicitly attachable) giving every serving
+  request and training step ONE causal trace: spans entered under an
+  active trace record into it, batch fan-in is expressed with span
+  links (one dispatch, many parents), retention is tail-sampled
+  (errors/sheds + the slow tail always kept, the rest at
+  ``MXNET_TPU_TRACE_SAMPLE``), kept traces export as ``mxtpu-trace/1``
+  JSONL per rank (``MXNET_TPU_TRACE_DIR``), and latency histograms
+  carry per-bucket trace-id exemplars; ``tools/trace_top.py`` ranks,
+  reconstructs waterfalls, and attributes the critical path;
 * **exporters** (:mod:`.exporters`) — a JSONL step-log
   (``MXNET_TPU_TELEMETRY_JSONL``), Prometheus text format
   (:func:`render_prom`, served on ``MXNET_TPU_TELEMETRY_PORT``), and
@@ -73,6 +83,7 @@ import os as _os
 from .catalog import CATALOG, selfcheck
 from .registry import (REGISTRY, Registry, Counter, Gauge, Histogram,
                        counter, gauge, histogram)
+from . import tracing
 from .spans import span, drain_step_spans, step_span_totals
 from . import flight
 from . import memory
@@ -96,7 +107,7 @@ __all__ = [
     "start_http_server", "jsonl_path", "env_port", "reset",
     "reset_steps", "compile_events",
     "flight", "memory", "distview", "ioview", "costdb", "numerics",
-    "slo",
+    "slo", "tracing",
 ]
 
 # best-effort process-wide init: compile listener (jax.monitoring) and

@@ -365,6 +365,13 @@ CATALOG = {
     "mxtpu_health_status": (GAUGE, (),
                             "this rank's health verdict (0=healthy "
                             "1=degraded 2=critical)"),
+    # ------------------------------ distributed tracing (telemetry.tracing)
+    "mxtpu_traces_total": (COUNTER, ("status",),
+                           "finished traces by final status "
+                           "(status=ok|error|shed)"),
+    "mxtpu_traces_kept_total": (COUNTER, ("reason",),
+                                "traces retained by tail-sampling "
+                                "(reason=error|shed|slow|sampled)"),
 }
 
 # rung-occupancy fractions (histogram buckets): fill ratios up to full

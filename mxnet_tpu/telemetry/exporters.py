@@ -31,6 +31,7 @@ from . import flight
 from . import ioview as ioview_mod
 from . import memory as memory_mod
 from . import slo as slo_mod
+from . import tracing as tracing_mod
 from .spans import drain_step_spans
 
 __all__ = ["step_end", "jsonl_event", "render_prom", "report",
@@ -241,11 +242,21 @@ def render_prom():
             if m.kind == HISTOGRAM:
                 cum = 0
                 bounds = list(m.buckets) + [float("inf")]
-                for ub, n in zip(bounds, val["buckets"]):
+                exemplars = val.get("exemplars") or {}
+                for i, (ub, n) in enumerate(zip(bounds,
+                                                val["buckets"])):
                     cum += n
-                    lines.append("%s_bucket%s %s" % (
+                    line = "%s_bucket%s %s" % (
                         name, _fmt_labels(key, {"le": _fmt_num(ub)}),
-                        cum))
+                        cum)
+                    ex = exemplars.get(i)
+                    if ex is not None:
+                        # OpenMetrics exemplar suffix: the bucket names
+                        # a REAL trace a reader can pull up with
+                        # tools/trace_top.py --trace <id>
+                        line += ' # {trace_id="%s"} %s %s' \
+                            % (ex[0], _fmt_num(ex[1]), ex[2])
+                    lines.append(line)
                 lines.append("%s_sum%s %s"
                              % (name, _fmt_labels(key),
                                 _fmt_num(val["sum"])))
@@ -461,6 +472,7 @@ def reset():
     from . import numerics as numerics_mod
     numerics_mod.reset()
     slo_mod.reset()
+    tracing_mod.reset()
     with _lock:
         _step_durs.clear()
         _last_counters.clear()

@@ -44,6 +44,7 @@ import time
 from collections import deque
 
 from ..base import MXNetError
+from . import tracing
 from .catalog import COUNTER, GAUGE
 from .registry import REGISTRY, counter
 
@@ -92,6 +93,12 @@ class FlightRecorder:
         for anything that is not)."""
         ev = {"kind": str(kind), "ts": round(time.time(), 6)}
         ev.update(fields)
+        if "trace_id" not in ev:
+            # cross-reference: events recorded under an active trace
+            # carry its id, so the flight ring and trace files join
+            ctx = tracing.current()
+            if ctx is not None:
+                ev["trace_id"] = ctx.trace_id
         with self._lock:
             self._seq += 1
             ev["seq"] = self._seq

@@ -17,6 +17,7 @@ generalized to labeled Prometheus-style instruments:
 from __future__ import annotations
 
 import threading
+import time
 
 from ..base import MXNetError
 from .catalog import CATALOG, COUNTER, GAUGE, HISTOGRAM, TIME_BUCKETS
@@ -43,8 +44,8 @@ class _Child:
     def set(self, value):
         self._metric._set(self._key, value)
 
-    def observe(self, value, weight=1):
-        self._metric._observe(self._key, value, weight)
+    def observe(self, value, weight=1, exemplar=None):
+        self._metric._observe(self._key, value, weight, exemplar)
 
     def get(self):
         return self._metric._get(self._key)
@@ -91,8 +92,8 @@ class Metric:
     def set(self, value):
         self._require_default().set(value)
 
-    def observe(self, value, weight=1):
-        self._require_default().observe(value, weight)
+    def observe(self, value, weight=1, exemplar=None):
+        self._require_default().observe(value, weight, exemplar)
 
     def get(self):
         return self._require_default().get()
@@ -109,7 +110,7 @@ class Metric:
         raise MXNetError("metric %r (%s) does not support set"
                          % (self.name, self.kind))
 
-    def _observe(self, key, value, weight=1):
+    def _observe(self, key, value, weight=1, exemplar=None):
         raise MXNetError("metric %r (%s) does not support observe"
                          % (self.name, self.kind))
 
@@ -118,10 +119,19 @@ class Metric:
             return self._samples.get(key, 0.0)
 
     def samples(self):
-        """{label key tuple: value} snapshot (histograms: dict values)."""
+        """{label key tuple: value} snapshot (histograms: dict values;
+        nested exemplar maps are copied too, so readers never race a
+        concurrent observe)."""
         with self._lock():
-            return {k: (dict(v) if isinstance(v, dict) else v)
-                    for k, v in self._samples.items()}
+            out = {}
+            for k, v in self._samples.items():
+                if isinstance(v, dict):
+                    v = dict(v)
+                    ex = v.get("exemplars")
+                    if ex is not None:
+                        v["exemplars"] = dict(ex)
+                out[k] = v
+            return out
 
     def _clear(self):
         with self._lock():
@@ -177,7 +187,7 @@ class Histogram(Metric):
                              "increasing, got %s" % (name, list(b)))
         self.buckets = b
 
-    def _observe(self, key, value, weight=1):
+    def _observe(self, key, value, weight=1, exemplar=None):
         value = float(value)
         weight = float(weight)
         if weight < 0:
@@ -198,6 +208,13 @@ class Histogram(Metric):
             s["buckets"][i] += weight
             s["sum"] += value * weight
             s["count"] += weight
+            if exemplar is not None:
+                # each bucket remembers ONE recent observation's trace
+                # id — the histogram-exemplar hook (telemetry.tracing)
+                ex = s.get("exemplars")
+                if ex is None:
+                    ex = s["exemplars"] = {}
+                ex[i] = (str(exemplar), value, round(time.time(), 3))
 
     def _get(self, key):
         with self._lock():

@@ -722,6 +722,15 @@ class SloEngine:
             info = {"burn_fast": round(burns["fast"], 3),
                     "burn_slow": round(burns["slow"], 3),
                     "factor": r["factor"]}
+            if r.get("kind") == "latency":
+                # name an ACTUAL slow trace next to the burning
+                # quantile: the histogram's slowest-bucket exemplar
+                # (rides describe() -> /alerts -> health_top, and the
+                # alert flight event)
+                from . import tracing
+                ex = tracing.exemplar_for(r["metric"], r.get("labels"))
+                if ex:
+                    info["exemplar_trace"] = ex
             return cond, round(max(burns.values()), 3), info
         if t == "threshold":
             mode = r["mode"]

@@ -21,6 +21,7 @@ import threading
 import time
 
 from .. import profiler
+from . import tracing
 from .registry import histogram
 
 __all__ = ["span", "drain_step_spans", "step_span_totals"]
@@ -62,12 +63,29 @@ class span:
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
-        stack.append((time.perf_counter(), profiler.now_us()))
+        # active trace? this span becomes a child span of it; the cost
+        # without a trace is ONE thread-local read (tracing.current)
+        ctx = tracing.current()
+        tr = None
+        if ctx is not None:
+            tracing.attach(ctx.child())
+            tr = (ctx, time.time())
+        stack.append((time.perf_counter(), profiler.now_us(), tr))
         return self
 
     def __exit__(self, *exc):
-        t0, start_us = self._tls.stack.pop()
+        t0, start_us, tr = self._tls.stack.pop()
         dur = time.perf_counter() - t0
+        if tr is not None:
+            parent, ts0 = tr
+            child = tracing.current()
+            tracing.detach(parent)
+            if child is not None:
+                tracing.record_span(
+                    parent, self.name, ts0, dur,
+                    span_id=child.span_id,
+                    status="error" if exc and exc[0] is not None
+                    else None)
         _hist().labels(span=self.name).observe(dur)
         with _step_lock:
             acc = _step_spans.get(self.name)

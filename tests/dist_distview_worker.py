@@ -44,7 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from mxnet_tpu import telemetry  # noqa: E402
-from mxnet_tpu.telemetry import distview, ioview  # noqa: E402
+from mxnet_tpu.telemetry import distview, ioview, tracing  # noqa: E402
 
 
 def _make_rec(path, n=16, size=8):
@@ -109,30 +109,46 @@ def main():
     data_iter = _io_pipeline(rank, world, slow_rank, slow_s) \
         if io_mode else None
 
-    for _ in range(steps):
-        t0 = time.perf_counter()
-        if io_mode:
-            # real pipeline fetch: the seeded slow decode makes this
-            # the step's dominant input_wait on the slow rank
-            try:
-                next(data_iter)
-            except StopIteration:
-                data_iter.reset()
-                next(data_iter)
-            input_s = time.perf_counter() - t0
-            time.sleep(base_s)                   # "compute"
-        else:
-            time.sleep(base_s / 2)               # "input wait"
-            input_s = time.perf_counter() - t0
-            time.sleep(base_s / 2 +
-                       (slow_s if rank == slow_rank else 0.0))  # compute
-        collective_s = 0.0
-        if skew_s and rank != slow_rank:
-            # simulated barrier: the fast ranks pay the straggler's
-            # lead as collective wait (see module docstring)
-            time.sleep(skew_s)
-            collective_s = skew_s
-        total = time.perf_counter() - t0
+    for i in range(steps):
+        # one trace per synthetic step, mirroring ShardedTrainer.step:
+        # the distview segments become its child spans, so the merged
+        # fleet trace file names the slow rank's dominant segment
+        with tracing.start_trace("trainer.step",
+                                 attrs={"step": i + 1}) as tr:
+            t0 = time.perf_counter()
+            ts0 = time.time()
+            if io_mode:
+                # real pipeline fetch: the seeded slow decode makes
+                # this the step's dominant input_wait on the slow rank
+                try:
+                    next(data_iter)
+                except StopIteration:
+                    data_iter.reset()
+                    next(data_iter)
+                input_s = time.perf_counter() - t0
+                time.sleep(base_s)               # "compute"
+            else:
+                time.sleep(base_s / 2)           # "input wait"
+                input_s = time.perf_counter() - t0
+                time.sleep(base_s / 2 +
+                           (slow_s if rank == slow_rank
+                            else 0.0))           # compute
+            collective_s = 0.0
+            if skew_s and rank != slow_rank:
+                # simulated barrier: the fast ranks pay the straggler's
+                # lead as collective wait (see module docstring)
+                time.sleep(skew_s)
+                collective_s = skew_s
+            total = time.perf_counter() - t0
+            ctx = tr.ctx
+            if ctx is not None:
+                comp = max(0.0, total - input_s - collective_s)
+                tracing.record_span(ctx, "step.input_wait", ts0,
+                                    input_s)
+                tracing.record_span(ctx, "step.compute",
+                                    ts0 + input_s, comp)
+                tracing.record_span(ctx, "step.collective_wait",
+                                    ts0 + input_s + comp, collective_s)
         segments = distview.record_step_segments(
             total, input_s=input_s, collective_s=collective_s)
         extra = {"segments": segments}

@@ -210,15 +210,22 @@ def test_out_of_range_label_finite_loss():
 
 
 def test_bench_script_cpu_smoke(monkeypatch, capsys):
-    """bench.py end-to-end on the CPU mesh (tiny config)."""
+    """bench.py end-to-end on the CPU mesh (tiny config).
+
+    Dry-run is the smoke contract: without it bench.py runs the full
+    ResNet-50 config, which on the 8-device virtual CPU mesh never
+    finishes inside the tier-1 window (and starves every test after
+    this file of its budget)."""
     import importlib
     import json as _json
+    monkeypatch.setenv("BENCH_DRYRUN", "1")
     import bench as bench_mod
     importlib.reload(bench_mod)
     bench_mod.main()
     line = capsys.readouterr().out.strip().splitlines()[-1]
     rec = _json.loads(line)
-    assert rec["unit"] == "img/s/chip" and rec["value"] > 0
+    assert rec["unit"] in ("img/s/chip", "samples/s/chip")
+    assert rec["value"] > 0
 
 
 def test_auto_layouts_matches_default():

@@ -1,0 +1,308 @@
+"""Serving-tier tracing: fan-in links, headers, shed joinability.
+
+The ISSUE 20 serving contract on top of ``tests/test_serving.py``'s
+fake-ladder harness: every batched request's trace carries the
+queue -> coalesce -> pad -> dispatch -> slice chain with the batch
+fan-in links (ONE dispatch span id shared across member traces), the
+HTTP front door accepts ``traceparent`` and names its trace on every
+reply (``X-Trace-Id``), sheds mark and keep the trace and the 503
+body carries ``rid`` + ``trace_id``, and the deadline_ms=0/negative
+falsy-bug regression stays fixed.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import Batcher, RequestShed, Server
+from mxnet_tpu.telemetry import tracing
+
+from test_serving import FakeLadder, _rows
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for k in ("MXNET_TPU_TRACE_SAMPLE", "MXNET_TPU_TRACE_DIR",
+              "MXNET_TPU_TRACE_RING", "MXNET_TPU_TRACE_SLOW_PCT",
+              "MXNET_TPU_TELEMETRY_JSONL", "MXNET_TPU_FLIGHT_DIR",
+              "MXNET_TPU_SLO"):
+        monkeypatch.delenv(k, raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _get_doc(trace_id, tries=100):
+    """Poll the ring: the submitter can observe its reply a beat
+    before the root Trace finishes finalizing."""
+    for _ in range(tries):
+        doc = tracing.get_trace(trace_id)
+        if doc is not None:
+            return doc
+        time.sleep(0.01)
+    raise AssertionError("trace %s never landed in the ring" % trace_id)
+
+
+# -------------------------------------------------------------- batcher
+
+def test_batched_traces_share_one_linked_dispatch_span():
+    lad = FakeLadder(rungs=(1, 4), wall=0.0005)
+    bat = Batcher(lad, window_ms=50, queue_depth=16,
+                  default_deadline_ms=5000)
+    tids = [None] * 3
+    errors = []
+    try:
+        def go(i):
+            try:
+                with tracing.start_trace("client.%d" % i) as tr:
+                    tids[i] = tr.trace_id
+                    bat.submit(_rows(1, fill=float(i)))
+            except Exception as e:  # mxlint: allow-broad-except(collected and re-asserted below)
+                errors.append(e)
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert lad.dispatches == [(4, 4)]    # one coalesced dispatch
+    finally:
+        bat.close()
+
+    docs = [_get_doc(t) for t in tids]
+    disp_ids = set()
+    for doc in docs:
+        by_name = {s["name"]: s for s in doc["spans"]}
+        assert set(by_name) >= {"serve.queue", "serve.coalesce",
+                                "serve.pad", "serve.dispatch",
+                                "serve.slice"}
+        root = doc["spans"][0]
+        disp = by_name["serve.dispatch"]
+        # each member's segments hang off ITS OWN root
+        for name in ("serve.queue", "serve.coalesce", "serve.pad",
+                     "serve.dispatch", "serve.slice"):
+            assert by_name[name]["parent_id"] == root["span_id"]
+        disp_ids.add(disp["span_id"])
+        assert disp["attrs"]["requests"] == 3
+        assert disp["attrs"]["rung"] == 4
+        assert disp["attrs"]["pad_rows"] == 1
+        # fan-in links name every member root (this one included)
+        linked = {(l["trace_id"], l["span_id"]) for l in disp["links"]}
+        assert linked == {(d["trace_id"], d["spans"][0]["span_id"])
+                          for d in docs}
+    # ONE dispatch span id across all member traces
+    assert len(disp_ids) == 1
+
+
+def test_segment_walls_cover_submit_latency():
+    """Acceptance: the recorded segment walls account for (almost) the
+    whole submit-observed latency — the 5%% coverage contract
+    trace_top reports."""
+    lad = FakeLadder(rungs=(1, 4), wall=0.0005)
+
+    real_dispatch = lad.dispatch
+
+    def slow_dispatch(rung, feed):
+        time.sleep(0.05)
+        return real_dispatch(rung, feed)
+
+    lad.dispatch = slow_dispatch
+    bat = Batcher(lad, window_ms=1, queue_depth=16,
+                  default_deadline_ms=5000)
+    try:
+        t0 = time.monotonic()
+        with tracing.start_trace("client.cov") as tr:
+            bat.submit(_rows(1))
+        wall = time.monotonic() - t0
+    finally:
+        bat.close()
+    doc = _get_doc(tr.trace_id)
+    segs = sum(s["dur_s"] for s in doc["spans"]
+               if s["parent_id"] is not None)
+    assert segs >= 0.05
+    assert segs <= wall * 1.05
+    assert segs >= wall * 0.5       # the chain is not a sliver
+    name, _excl = tracing.dominant_segment(doc)
+    assert name == "serve.dispatch"
+
+
+def test_dispatch_error_records_error_span_before_failing():
+    lad = FakeLadder(rungs=(1, 4))
+
+    def boom(rung, feed):
+        raise RuntimeError("kaboom")
+
+    lad.dispatch = boom
+    bat = Batcher(lad, window_ms=1, queue_depth=16,
+                  default_deadline_ms=5000)
+    try:
+        with pytest.raises(RuntimeError):
+            with tracing.start_trace("client.err") as tr:
+                bat.submit(_rows(1))
+    finally:
+        bat.close()
+    doc = _get_doc(tr.trace_id)
+    assert doc["status"] == "error"            # always kept
+    disp = [s for s in doc["spans"]
+            if s["name"] == "serve.dispatch"][0]
+    assert disp["status"] == "error"
+    assert "kaboom" in disp["attrs"]["error"]
+    assert disp["links"][0]["trace_id"] == tr.trace_id
+
+
+def test_untraced_submit_records_nothing():
+    lad = FakeLadder(rungs=(1, 4))
+    bat = Batcher(lad, window_ms=1, queue_depth=16,
+                  default_deadline_ms=5000)
+    try:
+        assert tracing.current() is None
+        out = bat.submit(_rows(1))
+        assert out[0].shape == (1, 3)
+    finally:
+        bat.close()
+    assert tracing.traces() == []
+
+
+# ----------------------------------------- deadline_ms falsy regression
+
+def test_explicit_zero_deadline_sheds_not_defaults():
+    """Regression (ISSUE 20 satellite): ``deadline_ms=0`` used to fall
+    through a falsy check onto the DEFAULT deadline; an explicit 0 or
+    negative deadline is already expired and must shed on arrival."""
+    lad = FakeLadder(rungs=(1, 4))
+    bat = Batcher(lad, window_ms=1, queue_depth=16,
+                  default_deadline_ms=5000)
+    try:
+        for ddl in (0, 0.0, -5):
+            with pytest.raises(RequestShed) as ei:
+                bat.submit(_rows(1), deadline_ms=ddl)
+            assert ei.value.reason == "deadline"
+            assert ei.value.rid is not None
+            assert "expired on arrival" in str(ei.value)
+        assert lad.dispatches == []            # nothing ever dispatched
+        # the default path still works
+        out = bat.submit(_rows(1), deadline_ms=None)
+        assert out[0].shape == (1, 3)
+    finally:
+        bat.close()
+
+
+def test_shed_exception_carries_rid_and_marks_trace():
+    lad = FakeLadder(rungs=(1, 4))
+    bat = Batcher(lad, window_ms=1, queue_depth=16,
+                  default_deadline_ms=5000)
+    try:
+        with tracing.start_trace("client.shed") as tr:
+            with pytest.raises(RequestShed) as ei:
+                bat.submit(_rows(1), deadline_ms=0)
+        assert ei.value.rid is not None
+        rid = ei.value.rid
+    finally:
+        bat.close()
+    doc = _get_doc(tr.trace_id)
+    assert doc["status"] == "shed"
+    assert doc["keep"] == "shed"
+    assert doc["attrs"]["shed_reason"] == "deadline"
+    assert doc["attrs"]["rid"] == rid
+    # the shed flight event joins on rid AND trace_id
+    from mxnet_tpu.telemetry import flight
+    evs = [e for e in flight.events() if e["kind"] == "request_shed"]
+    assert evs and evs[-1]["rid"] == rid
+    assert evs[-1]["trace_id"] == tr.trace_id
+    assert ("rid %d:" % rid) in evs[-1]["detail"]
+
+
+# ----------------------------------------------------------- front door
+
+@pytest.fixture()
+def _server():
+    lad = FakeLadder(rungs=(1, 4), wall=0.0005)
+    srv = Server(lad, batcher=Batcher(lad, window_ms=1, queue_depth=16,
+                                      default_deadline_ms=5000),
+                 port=0).start()
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+def _post(port, doc, headers=None):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/predict" % port,
+        data=json.dumps(doc).encode(), method="POST",
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_predict_reply_names_its_trace(_server):
+    with _post(_server.port, {"data": [[1.0, 2.0, 3.0]]}) as resp:
+        body = json.loads(resp.read())
+        tid = resp.headers["X-Trace-Id"]
+        tp = resp.headers["traceparent"]
+    assert body["rows"] == 1
+    assert tid and len(tid) == 32
+    assert tracing.parse_traceparent(tp)[0] == tid
+    doc = _get_doc(tid)
+    assert doc["root"] == "serve.request"
+    assert doc["attrs"]["rows"] == 1
+    names = {s["name"] for s in doc["spans"]}
+    assert "serve.dispatch" in names and "serve.queue" in names
+
+
+def test_predict_continues_inbound_traceparent(_server):
+    tid = "ab" * 16
+    parent_sid = "cd" * 8
+    header = "00-%s-%s-01" % (tid, parent_sid)
+    with _post(_server.port, {"data": [[0.0, 0.0, 0.0]]},
+               headers={"traceparent": header}) as resp:
+        assert resp.headers["X-Trace-Id"] == tid
+    doc = _get_doc(tid)
+    # the server's root span chains under the CALLER's span
+    assert doc["spans"][0]["parent_id"] == parent_sid
+
+
+def test_predict_shed_503_carries_rid_and_trace_id(_server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(_server.port, {"data": [[1.0, 2.0, 3.0]],
+                             "deadline_ms": 0})
+    assert ei.value.code == 503
+    body = json.loads(ei.value.read())
+    assert body["shed"] == "deadline"
+    assert isinstance(body["rid"], int)
+    assert len(body["trace_id"]) == 32
+    assert ei.value.headers["X-Trace-Id"] == body["trace_id"]
+    doc = _get_doc(body["trace_id"])
+    assert doc["status"] == "shed"
+
+
+def test_predict_traced_exemplar_resolves(_server):
+    for _ in range(3):
+        with _post(_server.port, {"data": [[1.0, 1.0, 1.0]]}) as resp:
+            tid = resp.headers["X-Trace-Id"]
+    ex = tracing.exemplar_for("mxtpu_serve_request_seconds",
+                              {"segment": "total"})
+    assert ex is not None and len(ex) == 32
+    assert _get_doc(ex)["root"] == "serve.request"
+    assert tid      # at least the last request produced a trace
+    # and the exposition carries the exemplar suffix
+    text = telemetry.render_prom()
+    assert ' # {trace_id="' in text
+
+
+def test_predict_disabled_tracing_no_headers(monkeypatch, _server):
+    monkeypatch.setenv("MXNET_TPU_TRACE_SAMPLE", "0")
+    with _post(_server.port, {"data": [[1.0, 2.0, 3.0]]}) as resp:
+        body = json.loads(resp.read())
+        assert body["rows"] == 1
+        assert resp.headers.get("X-Trace-Id") is None
+        assert resp.headers.get("traceparent") is None
+    assert tracing.traces() == []

@@ -1,0 +1,436 @@
+"""Distributed tracing: context, retention, merge, exemplars.
+
+Exercises ``mxnet_tpu/telemetry/tracing.py`` (ISSUE 20,
+docs/api/telemetry.md tracing section): W3C traceparent parsing and
+propagation, the thread-local context stack under nested
+``telemetry.span`` scopes, tail-sampled retention (error/shed always
+kept, the slow tail always kept, ``MXNET_TPU_TRACE_SAMPLE`` for the
+rest), the per-rank JSONL export + merge readers ``trace_top`` runs
+on, critical-path attribution, histogram exemplars, and the disabled
+path's no-allocation contract.  Also the ``spans.py`` concurrent
+re-entry contract: one shared span instance entered from two threads
+keeps independent per-thread stacks.
+"""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry import tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for k in ("MXNET_TPU_TRACE_SAMPLE", "MXNET_TPU_TRACE_DIR",
+              "MXNET_TPU_TRACE_RING", "MXNET_TPU_TRACE_SLOW_PCT",
+              "MXNET_TPU_TELEMETRY_JSONL", "MXNET_TPU_FLIGHT_DIR"):
+        monkeypatch.delenv(k, raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ------------------------------------------------------- identity / ctx
+
+def test_parse_traceparent_round_trip():
+    ctx = tracing.TraceContext(tracing.new_trace_id(),
+                               tracing.new_span_id())
+    parsed = tracing.parse_traceparent(ctx.to_traceparent())
+    assert parsed == (ctx.trace_id, ctx.span_id)
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "not-a-traceparent", "00-abc-def-01",
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",      # non-hex
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",      # all-zero trace
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",      # all-zero span
+    "00-" + "1" * 31 + "-" + "1" * 16 + "-01",      # short trace id
+])
+def test_parse_traceparent_rejects_malformed(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+def test_child_context_keeps_trace_id_and_chains_parent():
+    ctx = tracing.TraceContext("a" * 32, "b" * 16)
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.parent_id == ctx.span_id
+    assert kid.span_id != ctx.span_id
+
+
+def test_attach_detach_restores_previous_context():
+    a = tracing.TraceContext("a" * 32, "1" * 16)
+    b = tracing.TraceContext("b" * 32, "2" * 16)
+    assert tracing.current() is None
+    prev = tracing.attach(a)
+    assert tracing.current() is a and prev is None
+    prev2 = tracing.attach(b)
+    assert tracing.current() is b and prev2 is a
+    tracing.detach(prev2)
+    assert tracing.current() is a
+    tracing.detach(prev)
+    assert tracing.current() is None
+
+
+# ------------------------------------------------------ trace lifecycle
+
+def test_trace_records_root_span_and_lands_in_ring():
+    with tracing.start_trace("unit.op", attrs={"k": "v"}) as tr:
+        assert tracing.current() is tr.ctx
+        time.sleep(0.002)
+    assert tracing.current() is None
+    doc = tracing.get_trace(tr.trace_id)
+    assert doc is not None
+    assert doc["root"] == "unit.op"
+    assert doc["status"] == "ok"
+    assert doc["attrs"]["k"] == "v"
+    root = doc["spans"][0]
+    assert root["name"] == "unit.op" and root["parent_id"] is None
+    assert doc["dur_s"] >= 0.002
+
+
+def test_trace_continues_inbound_traceparent():
+    header = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with tracing.start_trace("unit.op", traceparent=header) as tr:
+        assert tr.trace_id == "ab" * 16
+    doc = tracing.get_trace("ab" * 16)
+    # the root span is a child of the REMOTE parent: same trace id,
+    # parent chained to the inbound span
+    assert doc["spans"][0]["parent_id"] == "cd" * 8
+
+
+def test_exception_marks_trace_error_and_is_always_kept(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACE_SAMPLE", "0.0000001")
+    with pytest.raises(RuntimeError):
+        with tracing.start_trace("unit.fail") as tr:
+            raise RuntimeError("boom")
+    doc = tracing.get_trace(tr.trace_id)
+    assert doc["status"] == "error"
+    assert doc["keep"] == "error"
+    assert "boom" in doc["attrs"]["error"]
+
+
+def test_shed_status_set_by_context_survives_and_is_kept(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACE_SAMPLE", "0.0000001")
+    with tracing.start_trace("unit.shed") as tr:
+        tracing.set_trace_status(tr.ctx, "shed", shed_reason="deadline")
+    doc = tracing.get_trace(tr.trace_id)
+    assert doc["status"] == "shed"
+    assert doc["keep"] == "shed"
+    assert doc["attrs"]["shed_reason"] == "deadline"
+
+
+def test_spans_nest_into_active_trace():
+    with tracing.start_trace("unit.op") as tr:
+        with telemetry.span("outer"):
+            with telemetry.span("inner"):
+                pass
+    doc = tracing.get_trace(tr.trace_id)
+    by_name = {s["name"]: s for s in doc["spans"]}
+    assert set(by_name) == {"unit.op", "outer", "inner"}
+    root, outer, inner = (by_name["unit.op"], by_name["outer"],
+                          by_name["inner"])
+    assert outer["parent_id"] == root["span_id"]
+    assert inner["parent_id"] == outer["span_id"]
+
+
+def test_record_span_from_foreign_thread_with_links():
+    with tracing.start_trace("unit.op") as tr:
+        sid = [None]
+
+        def scheduler():
+            # explicit-attach path: no ambient context on this thread
+            assert tracing.current() is None
+            sid[0] = tracing.record_span(
+                tr.ctx, "dispatch", time.time(), 0.01,
+                attrs={"rung": 4},
+                links=[{"trace_id": tr.trace_id,
+                        "span_id": tr.ctx.span_id}],
+                span_id="f" * 16)
+
+        t = threading.Thread(target=scheduler)
+        t.start()
+        t.join()
+    assert sid[0] == "f" * 16
+    doc = tracing.get_trace(tr.trace_id)
+    disp = [s for s in doc["spans"] if s["name"] == "dispatch"][0]
+    assert disp["links"][0]["span_id"] == tr.ctx.span_id
+    assert disp["attrs"]["rung"] == 4
+
+
+def test_record_span_after_finish_is_dropped():
+    with tracing.start_trace("unit.op") as tr:
+        pass
+    assert tracing.record_span(tr.ctx, "late", time.time(), 0.1) is None
+    doc = tracing.get_trace(tr.trace_id)
+    assert [s["name"] for s in doc["spans"]] == ["unit.op"]
+
+
+# ------------------------------------------------------- tail sampling
+
+def test_sample_zero_returns_shared_null_trace(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACE_SAMPLE", "0")
+    t1 = tracing.start_trace("a")
+    t2 = tracing.start_trace("b")
+    assert t1 is tracing.NULL_TRACE and t2 is tracing.NULL_TRACE
+    with t1:
+        assert tracing.current() is None
+        t1.annotate(x=1)
+        t1.set_status("error")
+    assert tracing.traces() == []
+
+
+def test_disabled_tracing_allocates_nothing_per_request(monkeypatch):
+    """The MXNET_TPU_TRACE_SAMPLE=0 contract: beyond the env/context
+    checks, a request allocates NOTHING — the same NULL_TRACE object
+    comes back every time and no trace state accumulates."""
+    monkeypatch.setenv("MXNET_TPU_TRACE_SAMPLE", "0")
+    import gc
+    handles = {id(tracing.start_trace("warm")) for _ in range(3)}
+    assert handles == {id(tracing.NULL_TRACE)}
+    gc.collect()
+    before = len(gc.get_objects())
+    for _ in range(200):
+        with tracing.start_trace("req"):
+            pass
+    gc.collect()
+    after = len(gc.get_objects())
+    assert tracing.traces() == []
+    assert tracing._active == {}
+    # no per-request garbage survives; tolerate unrelated interpreter
+    # noise but catch any O(requests) growth
+    assert after - before < 100
+
+
+def test_slow_tail_always_kept_ordinary_sampled_out(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACE_SAMPLE", "0.0000001")
+    # seed the duration window with fast roots (threshold needs 20)
+    for i in range(30):
+        doc = {"trace_id": tracing.new_trace_id(), "root": "w",
+               "rank": 0, "ts": time.time(), "status": "ok",
+               "attrs": {}, "spans": [], "dur_s": 0.001}
+        tracing._finish(doc)
+    kept_before = len(tracing.traces())
+    slow = {"trace_id": tracing.new_trace_id(), "root": "w", "rank": 0,
+            "ts": time.time(), "status": "ok", "attrs": {},
+            "spans": [], "dur_s": 5.0}
+    tracing._finish(slow)
+    kept = tracing.traces()
+    assert len(kept) == kept_before + 1
+    assert kept[-1]["trace_id"] == slow["trace_id"]
+    assert kept[-1]["keep"] == "slow"
+
+
+def test_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACE_RING", "8")
+    for i in range(20):
+        with tracing.start_trace("op%d" % i):
+            pass
+    assert len(tracing.traces()) == 8
+
+
+def test_deterministic_sampling_same_decision_everywhere():
+    tid = tracing.new_trace_id()
+    assert tracing._hash_unit(tid) == tracing._hash_unit(tid)
+    assert 0.0 <= tracing._hash_unit(tid) < 1.0
+
+
+# ---------------------------------------------------- export / readers
+
+def test_export_merge_and_critical_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_TRACE_DIR", str(tmp_path))
+    with tracing.start_trace("serve.request") as tr:
+        with telemetry.span("serve.dispatch"):
+            time.sleep(0.02)
+    path = tmp_path / "trace.rank0.jsonl"
+    assert path.exists()
+    docs = tracing.read_trace_lines(str(path))
+    assert docs[0]["schema"] == tracing.TRACE_SCHEMA
+    assert docs[0]["trace_id"] == tr.trace_id
+
+    # a second "rank" contributes more spans to the SAME trace
+    other = dict(docs[0])
+    other["rank"] = 1
+    other["spans"] = [{"span_id": "e" * 16,
+                       "parent_id": docs[0]["spans"][0]["span_id"],
+                       "name": "remote.work", "ts": docs[0]["ts"],
+                       "dur_s": 0.001}]
+    with open(tmp_path / "trace.rank1.jsonl", "w") as f:
+        f.write(json.dumps(dict(other, schema=tracing.TRACE_SCHEMA))
+                + "\n")
+    merged = tracing.read_traces(str(tmp_path))
+    assert len(merged) == 1
+    m = merged[0]
+    assert sorted(m["ranks"]) == [0, 1]
+    assert {s["name"] for s in m["spans"]} == {"serve.request",
+                                               "serve.dispatch",
+                                               "remote.work"}
+    out = tracing.merge_trace_dir(str(tmp_path))
+    assert out.endswith("trace.merged.jsonl")
+    # dominant segment: the dispatch sleep holds the exclusive time
+    name, excl = tracing.dominant_segment(m)
+    assert name == "serve.dispatch"
+    assert excl >= 0.015
+
+
+def test_read_trace_lines_rejects_wrong_schema(tmp_path):
+    p = tmp_path / "trace.rank0.jsonl"
+    p.write_text(json.dumps({"schema": "bogus/9", "trace_id": "x"})
+                 + "\n")
+    with pytest.raises(ValueError):
+        tracing.read_trace_lines(str(p))
+
+
+def test_merge_status_escalates_and_root_doc_wins():
+    base = {"root": "?", "rank": 3, "ts": 2.0, "status": "ok",
+            "attrs": {}, "dur_s": 0.5,
+            "spans": [{"span_id": "b" * 16, "parent_id": "a" * 16,
+                       "name": "child", "ts": 2.0, "dur_s": 0.5}]}
+    rootdoc = {"root": "serve.request", "rank": 0, "ts": 1.0,
+               "status": "error", "attrs": {}, "dur_s": 1.0,
+               "spans": [{"span_id": "a" * 16, "parent_id": None,
+                          "name": "serve.request", "ts": 1.0,
+                          "dur_s": 1.0}]}
+    tid = "9" * 32
+    docs = [dict(base, trace_id=tid), dict(rootdoc, trace_id=tid)]
+    (m,) = tracing.merge_traces(docs)
+    assert m["root"] == "serve.request"      # the parentless span's doc
+    assert m["rank"] == 0
+    assert m["status"] == "error"            # escalated over "ok"
+    assert m["dur_s"] == 1.0
+
+
+# ----------------------------------------------------------- exemplars
+
+def test_histogram_exemplar_remembered_and_resolved():
+    h = telemetry.histogram("mxtpu_serve_request_seconds")
+    h.labels(segment="total").observe(0.001, exemplar="a" * 32)
+    h.labels(segment="total").observe(7.5, exemplar="b" * 32)
+    ex = tracing.exemplar_for("mxtpu_serve_request_seconds",
+                              {"segment": "total"})
+    assert ex == "b" * 32        # the slowest bucket's exemplar wins
+    assert tracing.exemplar_for("mxtpu_serve_request_seconds",
+                                {"segment": "nope"}) is None
+    assert tracing.exemplar_for("no_such_metric") is None
+
+
+def test_render_prom_carries_exemplar_suffix():
+    h = telemetry.histogram("mxtpu_serve_request_seconds")
+    h.labels(segment="total").observe(0.02, exemplar="c" * 32)
+    text = telemetry.render_prom()
+    lines = [ln for ln in text.splitlines()
+             if 'trace_id="%s"' % ("c" * 32) in ln]
+    assert lines, text
+    assert " # {" in lines[0]
+
+
+def test_flight_events_carry_active_trace_id():
+    from mxnet_tpu.telemetry import flight
+    with tracing.start_trace("unit.op") as tr:
+        flight.record("step_begin", step=1)
+    evs = [e for e in flight.events() if e["kind"] == "step_begin"]
+    assert evs[-1]["trace_id"] == tr.trace_id
+    flight.record("unrelated")
+    evs = flight.events()
+    assert "trace_id" not in evs[-1]
+
+
+# --------------------------------------------- tool surfaces (by path)
+
+def _load_tool(name):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_top_parses_exemplars_and_resolves_p99():
+    h = telemetry.histogram("mxtpu_serve_request_seconds")
+    h.labels(segment="total").observe(0.004, exemplar="d" * 32)
+    h.labels(segment="total").observe(0.9, exemplar="e" * 32)
+    st = _load_tool("serve_top")
+    assert st.SCHEMA == "mxtpu-servetop/3"
+    metrics = st.parse_prom(telemetry.render_prom())
+    ex = metrics.get("__exemplars__", {}).get(
+        "mxtpu_serve_request_seconds_bucket")
+    assert ex, "exemplar suffixes did not survive parse_prom"
+    doc = st.summarize(metrics)
+    assert doc["schema"] == "mxtpu-servetop/3"
+    # the SLOWEST populated total bucket's exemplar backs the p99
+    assert doc["latency_ms"]["p99_exemplar"] == "e" * 32
+    assert "trace=%s" % ("e" * 32) in st.render(doc)
+
+
+def test_health_top_evidence_names_exemplar_trace():
+    ht = _load_tool("health_top")
+    line = ht._evidence({"rule": "serve_p99_latency_burn",
+                         "severity": "page",
+                         "exemplar_trace": "f" * 32})
+    assert "trace=%s" % ("f" * 32) in line
+
+
+# --------------------------------------- spans.py concurrency contract
+
+def test_shared_span_instance_concurrent_reentry():
+    """ISSUE 20 satellite: ONE shared span instance entered from a
+    prefetcher thread and a consumer thread simultaneously must keep
+    independent per-thread stacks and record BOTH intervals."""
+    telemetry.reset()
+    sp = telemetry.span("shared.op")
+    enter = threading.Barrier(2)
+    inside = threading.Barrier(2)
+    errors = []
+
+    def worker(sleep_s):
+        try:
+            enter.wait(timeout=5)
+            with sp:
+                inside.wait(timeout=5)   # both threads INSIDE at once
+                time.sleep(sleep_s)
+        except Exception as e:  # mxlint: allow-broad-except(collected and re-asserted below)
+            errors.append(e)
+
+    t1 = threading.Thread(target=worker, args=(0.01,))
+    t2 = threading.Thread(target=worker, args=(0.03,))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    assert not errors
+    # both intervals recorded, independently timed
+    totals = telemetry.step_span_totals()["shared.op"]
+    assert totals["count"] == 2
+    assert totals["total_s"] >= 0.04
+
+
+def test_shared_span_concurrent_reentry_under_traces():
+    """The trace upgrade keeps the same contract: each thread's span
+    lands in ITS OWN active trace, not the other thread's."""
+    results = {}
+    gate = threading.Barrier(2)
+
+    sp = telemetry.span("traced.op")
+
+    def worker(name):
+        with tracing.start_trace("root.%s" % name) as tr:
+            gate.wait(timeout=5)
+            with sp:
+                time.sleep(0.005)
+            results[name] = tr.trace_id
+
+    ts = [threading.Thread(target=worker, args=(n,))
+          for n in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for name, tid in results.items():
+        doc = tracing.get_trace(tid)
+        spans = [s["name"] for s in doc["spans"]]
+        assert spans == ["root.%s" % name, "traced.op"], spans

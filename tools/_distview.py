@@ -38,3 +38,10 @@ def load_slo():
     ``health_top.py`` and ``launch.py`` — same stdlib-only-by-path
     contract as distview."""
     return _load("mxtpu_slo", "slo.py")
+
+
+def load_tracing():
+    """Reader/merge half of ``telemetry/tracing.py`` for
+    ``trace_top.py`` and ``launch.py`` — same stdlib-only-by-path
+    contract as distview."""
+    return _load("mxtpu_tracing", "tracing.py")

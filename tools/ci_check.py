@@ -154,7 +154,7 @@ Nineteen stages, all of which must be clean:
     deadline-starved overload must SHED
     (``mxtpu_serve_shed_total`` > 0) while ok requests keep landing;
     ``tools/serve_top.py --json`` must emit a strict-parseable
-    ``mxtpu-servetop/2`` document naming the hot rung; and SIGKILLing
+    ``mxtpu-servetop/3`` document naming the hot rung; and SIGKILLing
     the replica mid-fleet must end with the watchdog's
     ``replica_restart`` in the supervisor timeline and ``/healthz``
     green again under a NEW pid — the fleet availability contract.
@@ -174,6 +174,22 @@ Nineteen stages, all of which must be clean:
     up.  (The stage-4 drift guard covers the ``mxtpu_alert_*`` /
     ``mxtpu_slo_burn_rate`` / ``mxtpu_health_status`` metrics AND the
     rule catalog vs its docs table automatically.)
+
+20. **tracing gate** — end-to-end distributed tracing
+    (``mxnet_tpu/telemetry/tracing.py``, docs/api/telemetry.md
+    tracing section): a flight dump recorded under an active trace
+    must carry the ``trace_id`` join key and ``tools/flight_read.py``
+    must REFUSE a malformed one; a serving replica with a seeded slow
+    dispatch (``serve.dispatch`` delay fault) must return
+    ``X-Trace-Id`` on every ``/predict`` reply, shed an explicit
+    ``deadline_ms=0`` with ``rid``+``trace_id`` in the 503 body,
+    export traces whose ``tools/trace_top.py --json`` critical path
+    names ``serve.dispatch`` dominant with the ``--trace`` waterfall
+    covering >= 95% of the root wall, and resolve ``serve_top``'s p99
+    exemplar to an exported trace; and a 2-process launch with a
+    seeded slow rank must leave ``trace.merged.jsonl`` whose
+    aggregate names ``step.compute`` on the slow rank — the
+    fleet-wide critical-path attribution contract.
 
 Usage: ``python tools/ci_check.py [--repo-root PATH]``; exit 1 on any
 finding.
@@ -209,7 +225,7 @@ def run(repo_root=_ROOT, out=None):
         spec.loader.exec_module(mxlint)
         paths = [os.path.join(repo_root, d) for d in LINT_DIRS]
         findings = mxlint.lint_paths(paths)
-        say("ci_check[1/19] mxlint: %d finding(s) over %s"
+        say("ci_check[1/20] mxlint: %d finding(s) over %s"
             % (len(findings), "/".join(LINT_DIRS)))
         for f in findings:
             failures.append("mxlint: %s" % f)
@@ -218,7 +234,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 2: registry self-check
         from mxnet_tpu.ops import registry
         problems = registry.selfcheck()
-        say("ci_check[2/19] registry selfcheck: %d problem(s)"
+        say("ci_check[2/20] registry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("registry: %s" % p)
@@ -232,14 +248,14 @@ def run(repo_root=_ROOT, out=None):
             _net, report = verify_model(name)
             status = "OK" if not len(report) else "%d finding(s)" \
                 % len(report)
-            say("ci_check[3/19] verify model %-22s %s" % (name, status))
+            say("ci_check[3/20] verify model %-22s %s" % (name, status))
             for d in report:
                 failures.append("model %s: %s" % (name, d))
                 say("  " + str(d))
 
         # stage 4: telemetry catalog vs docs drift guard
         problems = telemetry_drift(repo_root)
-        say("ci_check[4/19] telemetry selfcheck: %d problem(s)"
+        say("ci_check[4/20] telemetry selfcheck: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("telemetry: %s" % p)
@@ -247,7 +263,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 5: flight-recorder smoke (fault -> black box -> reader)
         problems = flight_smoke(repo_root)
-        say("ci_check[5/19] flight smoke: %d problem(s)" % len(problems))
+        say("ci_check[5/20] flight smoke: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("flight: %s" % p)
             say("  " + p)
@@ -255,7 +271,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 6: distview smoke (2-process aggregator -> run timeline
         # -> run_top summary)
         problems = distview_smoke(repo_root)
-        say("ci_check[6/19] distview smoke: %d problem(s)"
+        say("ci_check[6/20] distview smoke: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("distview: %s" % p)
@@ -263,14 +279,14 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 7: block-fusion gate (zoo plans + numerical parity)
         problems = fusion_check(say=say)
-        say("ci_check[7/19] fusion gate: %d problem(s)" % len(problems))
+        say("ci_check[7/20] fusion gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("fusion: %s" % p)
             say("  " + p)
 
         # stage 8: perf ground truth (costdb + perf_top + bench_diff)
         problems = costdb_check(repo_root)
-        say("ci_check[8/19] perf ground truth: %d problem(s)"
+        say("ci_check[8/20] perf ground truth: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("costdb: %s" % p)
@@ -278,7 +294,7 @@ def run(repo_root=_ROOT, out=None):
 
         # stage 9: autotuner (tune cache + cost model + MXG010)
         problems = autotune_check(repo_root)
-        say("ci_check[9/19] autotune: %d problem(s)" % len(problems))
+        say("ci_check[9/20] autotune: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("autotune: %s" % p)
             say("  " + p)
@@ -286,7 +302,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 10: elastic reshard gate (save on one mesh, bit-exact
         # reshard-load on others, offline --verify roundtrip)
         problems = reshard_check(repo_root)
-        say("ci_check[10/19] reshard gate: %d problem(s)"
+        say("ci_check[10/20] reshard gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("reshard: %s" % p)
@@ -295,7 +311,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 11: training-health numerics gate (seeded NaN ->
         # strict stop + provenance; ledger twin/divergence -> numdiff)
         problems = numerics_check(repo_root)
-        say("ci_check[11/19] numerics gate: %d problem(s)"
+        say("ci_check[11/20] numerics gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("numerics: %s" % p)
@@ -304,7 +320,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 12: plan-search gate (tiny-budget search + commit;
         # second run a pure cache hit; searched-vs-greedy parity)
         problems = plansearch_check(repo_root)
-        say("ci_check[12/19] plan search: %d problem(s)"
+        say("ci_check[12/20] plan search: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("plansearch: %s" % p)
@@ -313,7 +329,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 13: SPMD gate (seeded-defect discrimination per
         # MXG011-016 rule + clean sweep over zoo and composed configs)
         problems = spmd_check(repo_root)
-        say("ci_check[13/19] spmd gate: %d problem(s)" % len(problems))
+        say("ci_check[13/20] spmd gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("spmd: %s" % p)
             say("  " + p)
@@ -321,7 +337,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 14: io observability gate (seeded slow stage ->
         # io_top --json names it; flight + counter verdicts agree)
         problems = ioview_check(repo_root)
-        say("ci_check[14/19] io observability: %d problem(s)"
+        say("ci_check[14/20] io observability: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("ioview: %s" % p)
@@ -331,7 +347,7 @@ def run(repo_root=_ROOT, out=None):
         # collective wait strictly smaller at bit-identical params,
         # bucket flight events parseable)
         problems = overlap_check(repo_root)
-        say("ci_check[15/19] overlap gate: %d problem(s)"
+        say("ci_check[15/20] overlap gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("overlap: %s" % p)
@@ -341,7 +357,7 @@ def run(repo_root=_ROOT, out=None):
         # mid-epoch -> world-size-1 resume with no sample dropped or
         # doubled; seeded slow producer -> backpressure depth raise)
         problems = io_resume_check(repo_root)
-        say("ci_check[16/19] io resume gate: %d problem(s)"
+        say("ci_check[16/20] io resume gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("io_resume: %s" % p)
@@ -351,7 +367,7 @@ def run(repo_root=_ROOT, out=None):
         # vs aval-compiled XLA plans; seeded MXG017/019/020/021
         # fixtures; mem_top --json strict parse)
         problems = memlive_check(repo_root)
-        say("ci_check[17/19] memory gate: %d problem(s)"
+        say("ci_check[17/20] memory gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("memlive: %s" % p)
@@ -360,7 +376,7 @@ def run(repo_root=_ROOT, out=None):
         # stage 18: serving gate (fleet replica smoke: coalescing,
         # shedding, serve_top contract, kill -> watchdog restart)
         problems = serving_check(repo_root)
-        say("ci_check[18/19] serving gate: %d problem(s)"
+        say("ci_check[18/20] serving gate: %d problem(s)"
             % len(problems))
         for p in problems:
             failures.append("serving: %s" % p)
@@ -370,9 +386,19 @@ def run(repo_root=_ROOT, out=None):
         # deep-healthz 503 -> resolve; seeded skew -> fleet_skew alert
         # in the run timeline)
         problems = slo_check(repo_root)
-        say("ci_check[19/19] slo gate: %d problem(s)" % len(problems))
+        say("ci_check[19/20] slo gate: %d problem(s)" % len(problems))
         for p in problems:
             failures.append("slo: %s" % p)
+            say("  " + p)
+
+        # stage 20: tracing gate (flight trace_id cross-ref; seeded
+        # slow dispatch -> trace_top names serve.dispatch + exemplar
+        # resolves; 2-proc slow rank -> merged aggregate attribution)
+        problems = tracing_check(repo_root)
+        say("ci_check[20/20] tracing gate: %d problem(s)"
+            % len(problems))
+        for p in problems:
+            failures.append("tracing: %s" % p)
             say("  " + p)
     finally:
         sys.path.remove(repo_root)
@@ -656,7 +682,7 @@ def fusion_check(say=None):
         topo = net._topo()
         s = fusion.plan_block_fusion(topo, net._entries, layout="NHWC",
                                      record=False).summary()
-        say("ci_check[7/19] fusion plan %-22s %d block(s), %d relayout(s)"
+        say("ci_check[7/20] fusion plan %-22s %d block(s), %d relayout(s)"
             % (name, s["blocks"], s["relayouts_eliminated"]))
         if _has_fusable_pattern(topo) and s["blocks"] < 1:
             problems.append("model %s has fusable chains but the pass "
@@ -2054,7 +2080,7 @@ def serving_check(repo_root=_ROOT):
       the estimated rung wall cannot meet the deadline) while the ok
       counter keeps growing — load is refused, not queued to death;
     * ``tools/serve_top.py --json`` over the replica's ``/metrics``
-      must strict-parse as ``mxtpu-servetop/2`` and name a hot rung;
+      must strict-parse as ``mxtpu-servetop/3`` and name a hot rung;
     * SIGKILLing the replica's process group (exit rc -9, the rc-137
       container-kill shape) must produce the fleet watchdog's
       ``replica_restart`` supervisor event and a green ``/healthz``
@@ -2181,8 +2207,8 @@ def serving_check(repo_root=_ROOT):
             except ValueError as e:
                 problems.append("serve_top --json unparseable: %s" % e)
                 doc = {}
-            if doc.get("schema") != "mxtpu-servetop/2":
-                problems.append("serve_top schema %r != mxtpu-servetop/2"
+            if doc.get("schema") != "mxtpu-servetop/3":
+                problems.append("serve_top schema %r != mxtpu-servetop/3"
                                 % doc.get("schema"))
             if not doc.get("hot_rung"):
                 problems.append("serve_top named no hot rung")
@@ -2524,6 +2550,342 @@ def slo_check(repo_root=_ROOT):
                                 % health)
             if not summary.get("alerts"):
                 problems.append("run summary carries no alerts list")
+    except subprocess.TimeoutExpired:
+        problems.append("fleet-leg dry-run timed out")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def tracing_check(repo_root=_ROOT):
+    """Tracing gate (stage 20, docs/api/telemetry.md tracing section).
+
+    Three legs:
+
+    * **flight cross-reference** (in-process): a flight event recorded
+      under an active trace carries its ``trace_id``;
+      ``tools/flight_read.py`` strict-parses the dump and REFUSES a
+      corrupted (non-32-hex) id — the join key between the black box
+      and the ``mxtpu-trace/1`` export is load-bearing;
+    * **serving leg**: a 1-replica fleet with a seeded 250 ms
+      ``serve.dispatch`` delay fault and ``MXNET_TPU_TRACE_DIR`` set
+      must return ``X-Trace-Id`` on 200s, shed an explicit
+      ``deadline_ms=0`` as a 503 carrying ``rid`` + ``trace_id`` (the
+      falsy-deadline regression, end to end), export traces where
+      ``trace_top --json`` names ``serve.dispatch`` as the dominant
+      critical-path segment, the ``--trace <X-Trace-Id>`` waterfall
+      reconstructs queue -> coalesce -> pad -> dispatch(links) ->
+      slice with segment coverage >= 95% of the root wall, and
+      ``serve_top --json``'s p99 exemplar resolves to an exported
+      trace id;
+    * **fleet leg**: a 2-process launch with rank 1 seeded slow must
+      leave ``trace.merged.jsonl`` whose critical-path aggregate
+      names ``step.compute`` dominant AND mostly on rank 1 — the
+      straggler named by attribution, not eyeballing.
+
+    Returns problem strings (empty = clean)."""
+    import json
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import time
+    import urllib.error
+    import urllib.request
+
+    problems = []
+
+    def tool(name, *args, timeout=60):
+        return subprocess.run(
+            [sys.executable, os.path.join(repo_root, "tools", name)]
+            + list(args),
+            capture_output=True, text=True, timeout=timeout)
+
+    # ---- flight cross-reference leg (in-process)
+    fdir = tempfile.mkdtemp(prefix="mxtpu_trace_flight_")
+    prev_sample = os.environ.pop("MXNET_TPU_TRACE_SAMPLE", None)
+    try:
+        from mxnet_tpu.telemetry import flight, tracing
+        with tracing.start_trace("ci.traced") as tr:
+            flight.record("step_begin", step=1)
+        dump_path = flight.dump("ci_trace", directory=fdir)
+        if not dump_path:
+            problems.append("flight.dump(directory=...) wrote nothing")
+            return problems
+        res = tool("flight_read.py", dump_path, "--json")
+        if res.returncode != 0:
+            problems.append("flight_read rejected a well-formed traced "
+                            "dump (%d): %s"
+                            % (res.returncode, res.stderr[:200]))
+        else:
+            doc = json.loads(res.stdout)
+            if not any(e.get("trace_id") == tr.trace_id
+                       for e in doc["events"]):
+                problems.append("no flight event carries the active "
+                                "trace id %s" % tr.trace_id)
+        with open(dump_path) as f:
+            doc = json.load(f)
+        poisoned = False
+        for ev in doc["events"]:
+            if ev.get("trace_id"):
+                ev["trace_id"] = "NOT-32-HEX"
+                poisoned = True
+        if not poisoned:
+            problems.append("traced dump has no trace_id event to "
+                            "corrupt")
+        bad = os.path.join(fdir, "flight-bad.json")
+        with open(bad, "w") as f:
+            json.dump(doc, f)
+        res = tool("flight_read.py", bad)
+        if res.returncode == 0:
+            problems.append("flight_read ACCEPTED a malformed "
+                            "trace_id (the cross-reference contract "
+                            "is unenforced)")
+    finally:
+        if prev_sample is not None:
+            os.environ["MXNET_TPU_TRACE_SAMPLE"] = prev_sample
+        shutil.rmtree(fdir, ignore_errors=True)
+    if problems:
+        return problems
+
+    # ---- serving leg: seeded slow dispatch, end-to-end trace story
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_tracing_gate_")
+    tdir = os.path.join(tmpdir, "traces")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    launcher = os.path.join(repo_root, "tools", "launch.py")
+    env = _scrubbed_launch_env({
+        "MXNET_TPU_TRACE_DIR": tdir,
+        "MXNET_TPU_FAULTS": "serve.dispatch:p=1,kind=delay,delay=0.25",
+    })
+    sup = None
+
+    def post(doc, timeout=30):
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/predict" % port,
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+
+    try:
+        sup = subprocess.Popen(
+            [sys.executable, launcher, "--fleet", "-n", "1",
+             "--restart-budget", "1",
+             "%s -m mxnet_tpu.serving --model mlp --data-shape 16 "
+             "--port %d --ladder 1,4 --window-ms 20 --queue-depth 8 "
+             "--deadline-ms 5000" % (sys.executable, port)],
+            env=env, cwd=repo_root,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.time() + 180
+        up = False
+        while time.time() < deadline:
+            if sup.poll() is not None:
+                problems.append("fleet supervisor exited early "
+                                "(code %s)" % sup.returncode)
+                return problems
+            try:
+                with urllib.request.urlopen(
+                        "http://127.0.0.1:%d/healthz" % port,
+                        timeout=3) as r:
+                    if r.status == 200:
+                        up = True
+                        break
+            except OSError:
+                time.sleep(0.5)
+        if not up:
+            problems.append("replica /healthz never answered 200")
+            return problems
+
+        # a few traced requests through the 250 ms-delayed dispatch
+        tid = None
+        for i in range(4):
+            st, headers, body = post(
+                {"data": [[0.5] * 16], "deadline_ms": 5000})
+            if st != 200:
+                problems.append("predict %d answered %d" % (i, st))
+                return problems
+            tid = headers.get("X-Trace-Id")
+            if not tid or len(tid) != 32:
+                problems.append("200 reply carries no well-formed "
+                                "X-Trace-Id (got %r)" % tid)
+                return problems
+            if not headers.get("traceparent", "").startswith(
+                    "00-%s-" % tid):
+                problems.append("traceparent response header does not "
+                                "match X-Trace-Id")
+
+        # the falsy-deadline regression, end to end: explicit 0 sheds
+        # with rid + trace_id in the 503 body
+        try:
+            post({"data": [[0.5] * 16], "deadline_ms": 0})
+            problems.append("explicit deadline_ms=0 was SERVED (the "
+                            "falsy-deadline bug is back)")
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                problems.append("deadline_ms=0 answered %d, expected "
+                                "503" % e.code)
+            else:
+                body = json.loads(e.read())
+                if body.get("shed") != "deadline":
+                    problems.append("deadline_ms=0 shed reason %r != "
+                                    "'deadline'" % body.get("shed"))
+                if not isinstance(body.get("rid"), int):
+                    problems.append("503 shed body carries no rid: %r"
+                                    % body)
+                shed_tid = body.get("trace_id")
+                if not shed_tid or len(shed_tid) != 32:
+                    problems.append("503 shed body carries no "
+                                    "trace_id: %r" % body)
+                if e.headers.get("X-Trace-Id") != shed_tid:
+                    problems.append("503 X-Trace-Id header disagrees "
+                                    "with the body trace_id")
+
+        # exports land as the replica keeps traces; give the last
+        # request's finalization a beat
+        trace_file = os.path.join(tdir, "trace.rank0.jsonl")
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                with open(trace_file) as f:
+                    if tid in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.25)
+        else:
+            problems.append("replica never exported trace %s to "
+                            "trace.rank0.jsonl under "
+                            "MXNET_TPU_TRACE_DIR" % tid)
+            return problems
+
+        # critical path: the seeded slow dispatch must be NAMED
+        top = tool("trace_top.py", tdir, "--json")
+        if top.returncode != 0:
+            problems.append("trace_top --json exited %d: %s"
+                            % (top.returncode, top.stderr[:200]))
+            return problems
+        doc = json.loads(top.stdout)
+        if doc.get("schema") != "mxtpu-tracetop/1":
+            problems.append("trace_top schema %r != mxtpu-tracetop/1"
+                            % doc.get("schema"))
+        agg = doc.get("critical_path") or {}
+        if agg.get("dominant") != "serve.dispatch":
+            problems.append("seeded 250 ms dispatch delay: dominant "
+                            "segment %r != 'serve.dispatch' "
+                            "(segments: %r)"
+                            % (agg.get("dominant"),
+                               agg.get("segments_ms")))
+        if not any(r.get("status") == "shed" for r in doc.get("rows", ())):
+            problems.append("the shed request's trace was not kept/"
+                            "exported (no shed row in the ranking)")
+
+        # waterfall: the last 200's X-Trace-Id reconstructs the full
+        # segment chain with >= 95% coverage and fan-in links
+        top = tool("trace_top.py", tdir, "--trace", tid, "--json")
+        if top.returncode != 0:
+            problems.append("trace_top --trace %s exited %d: %s"
+                            % (tid, top.returncode, top.stderr[:200]))
+            return problems
+        wf = json.loads(top.stdout)
+        names = {r["name"] for r in wf.get("spans", ())}
+        missing = {"serve.request", "serve.queue", "serve.coalesce",
+                   "serve.pad", "serve.dispatch", "serve.slice"} - names
+        if missing:
+            problems.append("waterfall lacks segment span(s): %s"
+                            % sorted(missing))
+        if wf.get("coverage", 0.0) < 0.95:
+            problems.append("segment coverage %.3f < 0.95 of the root "
+                            "wall (segments %.2fms of %.2fms)"
+                            % (wf.get("coverage", 0.0),
+                               wf.get("segments_ms", 0.0),
+                               wf.get("total_ms", 0.0)))
+        disp = [r for r in wf.get("spans", ())
+                if r["name"] == "serve.dispatch"]
+        if not (disp and disp[0].get("links")):
+            problems.append("the dispatch span carries no fan-in "
+                            "links")
+
+        # p99 exemplar: serve_top must name an actual exported trace
+        top = tool("serve_top.py", "--url",
+                   "http://127.0.0.1:%d/metrics" % port, "--json")
+        if top.returncode != 0:
+            problems.append("serve_top --json exited %d: %s"
+                            % (top.returncode, top.stderr[:200]))
+            return problems
+        doc = json.loads(top.stdout)
+        ex = (doc.get("latency_ms") or {}).get("p99_exemplar")
+        if not ex or len(ex) != 32:
+            problems.append("serve_top resolved no p99 exemplar trace "
+                            "(latency_ms: %r)" % doc.get("latency_ms"))
+        else:
+            with open(trace_file) as f:
+                if ex not in f.read():
+                    problems.append("p99 exemplar %s is not in the "
+                                    "exported trace file" % ex)
+    finally:
+        if sup is not None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(20)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if problems:
+        return problems
+
+    # ---- fleet leg: 2-proc launch, rank 1 seeded slow; the merged
+    # aggregate must name step.compute on rank 1
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_tracing_fleet_")
+    tdir = os.path.join(tmpdir, "traces")
+    base = os.path.join(tmpdir, "run.jsonl")
+    env = _scrubbed_launch_env({
+        "MXNET_TPU_TELEMETRY_JSONL": base,
+        "MXNET_TPU_TRACE_DIR": tdir,
+        "DISTVIEW_STEPS": "3",
+        "DISTVIEW_SLOW_RANK": "1",
+        "DISTVIEW_SLOW_S": "0.2",
+        "DISTVIEW_BASE_S": "0.01",
+    })
+    try:
+        res = subprocess.run(
+            [sys.executable, launcher, "-n", "2",
+             "--launcher", "local",
+             sys.executable,
+             os.path.join(repo_root, "tests",
+                          "dist_distview_worker.py")],
+            capture_output=True, text=True, timeout=240,
+            cwd=repo_root, env=env)
+        if res.returncode != 0:
+            problems.append("fleet-leg dry-run failed (%d): %s"
+                            % (res.returncode,
+                               (res.stdout + res.stderr)[-800:]))
+            return problems
+        merged = os.path.join(tdir, "trace.merged.jsonl")
+        if not os.path.exists(merged):
+            problems.append("launch.py left no trace.merged.jsonl "
+                            "(per-rank merge did not run)")
+            return problems
+        top = tool("trace_top.py", tdir, "--aggregate", "--json")
+        if top.returncode != 0:
+            problems.append("trace_top --aggregate exited %d: %s"
+                            % (top.returncode, top.stderr[:200]))
+            return problems
+        agg = json.loads(top.stdout)
+        if agg.get("dominant") != "step.compute":
+            problems.append("seeded slow rank: fleet dominant %r != "
+                            "'step.compute' (segments: %r)"
+                            % (agg.get("dominant"),
+                               agg.get("segments_ms")))
+        if agg.get("dominant_rank") != 1:
+            problems.append("dominant segment attributed to rank %r, "
+                            "expected the seeded-slow rank 1 "
+                            "(split: %r)"
+                            % (agg.get("dominant_rank"),
+                               agg.get("dominant_rank_split_ms")))
     except subprocess.TimeoutExpired:
         problems.append("fleet-leg dry-run timed out")
     finally:

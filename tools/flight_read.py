@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -45,10 +46,15 @@ SCHEMA = "mxtpu-flight/1"
 REQUIRED = ("schema", "reason", "ts", "pid", "events", "counters",
             "gauges", "memory_plans")
 
+#: events recorded under an active trace carry its 128-bit id
+#: (mxnet_tpu/telemetry/tracing.py) — the join key into mxtpu-trace/1
+_TRACE_ID = re.compile(r"^[0-9a-f]{32}$")
+
 
 def load(path):
     """Parse + validate one dump.  Raises ValueError naming the problem
-    (malformed JSON, wrong schema, missing keys, non-list events)."""
+    (malformed JSON, wrong schema, missing keys, non-list events, or an
+    event ``trace_id`` that is not 32 lowercase hex chars)."""
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -65,6 +71,14 @@ def load(path):
                          % (path, missing))
     if not isinstance(doc["events"], list):
         raise ValueError("flight dump %r: events is not a list" % path)
+    for ev in doc["events"]:
+        tid = ev.get("trace_id") if isinstance(ev, dict) else None
+        if tid is not None and not _TRACE_ID.match(str(tid)):
+            raise ValueError(
+                "flight dump %r: event seq=%s carries malformed "
+                "trace_id %r (want 32 lowercase hex chars — the "
+                "tracing cross-reference contract)"
+                % (path, ev.get("seq"), tid))
     return doc
 
 

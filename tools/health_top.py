@@ -59,7 +59,8 @@ def _normalize_flight(doc):
         out.append({"ts": ev.get("ts"), "rule": ev.get("rule"),
                     "to": ev.get("to"), "severity": ev.get("severity"),
                     "value": ev.get("value"),
-                    "summary": ev.get("summary")})
+                    "summary": ev.get("summary"),
+                    "exemplar_trace": ev.get("exemplar_trace")})
     return out, doc.get("ts"), doc.get("rank")
 
 
@@ -94,7 +95,8 @@ def replay(transitions, schema, now=None, rank=None):
     for t in transitions:
         r = state.setdefault(t["rule"], {"rule": t["rule"]})
         r["severity"] = t.get("severity") or r.get("severity", "warn")
-        for k in ("value", "summary", "step", "bound"):
+        for k in ("value", "summary", "step", "bound",
+                  "exemplar_trace"):
             if t.get(k) is not None:
                 r[k] = t[k]
         if t["to"] == "firing":
@@ -122,7 +124,8 @@ def replay(transitions, schema, now=None, rank=None):
              "state": "firing"}
         if r.get("since") is not None:
             d["since_s"] = round(max(0.0, now - r["since"]), 3)
-        for k in ("value", "summary", "step", "bound"):
+        for k in ("value", "summary", "step", "bound",
+                  "exemplar_trace"):
             if r.get(k) is not None:
                 d[k] = r[k]
         return d
@@ -172,6 +175,10 @@ def _evidence(entry):
         bits.append("bound=%s" % entry["bound"])
     if entry.get("step") is not None:
         bits.append("step=%s" % entry["step"])
+    if entry.get("exemplar_trace"):
+        # a latency rule's exemplar: an ACTUAL slow trace behind the
+        # burning quantile — feed it to trace_top --trace
+        bits.append("trace=%s" % entry["exemplar_trace"])
     if entry.get("summary"):
         bits.append("- %s" % entry["summary"])
     return "  ".join(bits)

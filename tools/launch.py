@@ -78,6 +78,30 @@ def _load_distview():
         return None
 
 
+def _merge_traces():
+    """Merge per-rank ``mxtpu-trace/1`` files (``MXNET_TPU_TRACE_DIR``)
+    into ``trace.merged.jsonl`` at job end, so a fleet-wide request or
+    step is ONE trace record for ``tools/trace_top.py``.  Optional
+    observability — never raises."""
+    tdir = os.environ.get("MXNET_TPU_TRACE_DIR")
+    if not tdir or not os.path.isdir(tdir):
+        return None
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "telemetry",
+                        "tracing.py")
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "mxtpu_tracing", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.merge_trace_dir(tdir)
+    except Exception as e:  # mxlint: allow-broad-except(the trace merge is optional observability at teardown; a broken module or unreadable trace file must not turn a finished job into a failed one)
+        sys.stderr.write("launch.py: trace merge unavailable (%s)\n"
+                         % e)
+        return None
+
+
 def _supervisor_jsonl():
     """The supervisor's own event stream (the base
     MXNET_TPU_TELEMETRY_JSONL path; workers write ``<base>.rank<N>``)."""
@@ -553,6 +577,10 @@ def launch_local(opts, command):
         _sup_event({"event": "job_end", "pid": os.getpid()}, agg)
         if agg is not None:
             agg.close()
+        merged = _merge_traces()
+        if merged:
+            sys.stderr.write("launch.py: merged fleet traces -> %s\n"
+                             % merged)
 
 
 def launch_ssh(opts, command):

@@ -14,7 +14,10 @@ the ``mxtpu_serve_*`` family (docs/api/serving.md):
   mean occupancy (real rows / rung — low occupancy on a big rung means
   the batching window closes too early);
 * request latency p50/p99 interpolated from the ``total`` segment
-  histogram, plus the queue/pad/dispatch split means;
+  histogram, plus the queue/pad/dispatch split means, and the
+  ``p99_exemplar`` trace id remembered by the slowest populated bucket
+  (OpenMetrics exemplar suffix) — feed it to ``tools/trace_top.py
+  --trace`` to see WHERE that slow request's time went;
 * current batcher queue depth;
 * the SLO engine's health verdict (``mxtpu_health_status``) with the
   firing rules by name (``mxtpu_alert_state`` == 2) and the firing
@@ -22,7 +25,7 @@ the ``mxtpu_serve_*`` family (docs/api/serving.md):
   ``tools/health_top.py``.
 
 ``--json`` emits one machine-readable document (schema
-``mxtpu-servetop/2``) for CI assertions.  Stdlib only — never imports
+``mxtpu-servetop/3``) for CI assertions.  Stdlib only — never imports
 the framework.  Exit codes: 0 ok, 2 unreadable input.
 """
 from __future__ import annotations
@@ -34,7 +37,7 @@ import re
 import sys
 import urllib.request
 
-SCHEMA = "mxtpu-servetop/2"
+SCHEMA = "mxtpu-servetop/3"
 
 #: mxtpu_health_status gauge value -> verdict string (telemetry.slo)
 _HEALTH = {0: "healthy", 1: "degraded", 2: "critical"}
@@ -44,12 +47,21 @@ _LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
 def parse_prom(text):
-    """Exposition text -> {name: [(labels_dict, value), ...]}."""
+    """Exposition text -> {name: [(labels_dict, value), ...]}.
+
+    OpenMetrics exemplar suffixes (``... # {trace_id="..."} v ts``) are
+    split off the sample line and collected under the reserved
+    ``"__exemplars__"`` key as ``{name: [(labels_dict, exemplar_labels,
+    value, ts)]}`` — no real metric can collide with that name."""
     out = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        ex = None
+        if " # " in line:
+            line, ex = line.split(" # ", 1)
+            line = line.rstrip()
         m = _LINE.match(line)
         if not m:
             continue
@@ -60,6 +72,18 @@ def parse_prom(text):
             continue
         kv = dict(_LABEL.findall(labels or ""))
         out.setdefault(name, []).append((kv, val))
+        if ex:
+            exm = re.match(r'^\{([^}]*)\}\s+(\S+)(?:\s+(\S+))?$',
+                           ex.strip())
+            if exm:
+                ekv = dict(_LABEL.findall(exm.group(1)))
+                try:
+                    ev = float(exm.group(2))
+                    ets = float(exm.group(3)) if exm.group(3) else 0.0
+                except ValueError:
+                    continue
+                out.setdefault("__exemplars__", {}).setdefault(
+                    name, []).append((kv, ekv, ev, ets))
     return out
 
 
@@ -94,7 +118,7 @@ def _quantile(buckets, q):
 
 
 def summarize(metrics):
-    """The serve_top document (schema mxtpu-servetop/1) from parsed
+    """The serve_top document (schema mxtpu-servetop/3) from parsed
     exposition samples."""
     outcomes = _sum_by(metrics.get("mxtpu_serve_requests_total", []),
                        "outcome")
@@ -129,6 +153,19 @@ def summarize(metrics):
     p50 = _quantile(total_buckets, 0.50)
     p99 = _quantile(total_buckets, 0.99)
 
+    # the exemplar on the SLOWEST populated total bucket: an actual
+    # trace id behind the p99, not just the quantile estimate
+    p99_exemplar = None
+    best = None
+    for kv, ekv, ev, ets in metrics.get("__exemplars__", {}).get(
+            "mxtpu_serve_request_seconds_bucket", []):
+        if kv.get("segment") != "total" or "trace_id" not in ekv:
+            continue
+        le = float(kv.get("le", "inf").replace("+Inf", "inf"))
+        if best is None or (le, ets) > best:
+            best = (le, ets)
+            p99_exemplar = ekv["trace_id"]
+
     depth = metrics.get("mxtpu_serve_queue_depth", [])
 
     # the SLO verdict: absent gauges (engine disabled / never ticked)
@@ -157,6 +194,7 @@ def summarize(metrics):
         "latency_ms": {
             "p50": round(p50 * 1e3, 3) if p50 is not None else None,
             "p99": round(p99 * 1e3, 3) if p99 is not None else None,
+            "p99_exemplar": p99_exemplar,
             "segment_mean": segments,
         },
         "queue_depth": int(depth[0][1]) if depth else None,
@@ -189,8 +227,10 @@ def render(doc):
                             else "n/a", hot))
     lat = doc["latency_ms"]
     if lat["p50"] is not None:
-        lines.append("latency:  p50=%.2fms p99=%.2fms"
-                     % (lat["p50"], lat["p99"]))
+        lines.append("latency:  p50=%.2fms p99=%.2fms%s"
+                     % (lat["p50"], lat["p99"],
+                        "  trace=%s" % lat["p99_exemplar"]
+                        if lat.get("p99_exemplar") else ""))
     if lat["segment_mean"]:
         lines.append("segments: %s (mean ms)"
                      % " ".join("%s=%.2f" % kv
@@ -223,7 +263,7 @@ def main(argv=None):
                         help="read a saved exposition snapshot instead "
                              "of fetching --url")
     parser.add_argument("--json", action="store_true",
-                        help="emit one mxtpu-servetop/2 JSON document")
+                        help="emit one mxtpu-servetop/3 JSON document")
     args = parser.parse_args(argv)
 
     if args.file:

@@ -1,0 +1,317 @@
+#!/usr/bin/env python
+"""trace_top — where did THIS request's (or step's) time go?
+
+The reader half of ``mxnet_tpu/telemetry/tracing.py``
+(docs/api/telemetry.md, tracing section).  Input is an
+``mxtpu-trace/1`` JSONL file, or a DIRECTORY of per-rank trace files
+(``MXNET_TPU_TRACE_DIR``) merged by trace id so a fleet-wide trace is
+one record.  Three views:
+
+* **ranking** (default): the kept traces sorted slowest-first,
+  error/shed traces flagged, each line naming its dominant segment —
+  the span name holding the most EXCLUSIVE wall time (own duration
+  minus direct children), so instrumentation depth never
+  double-counts;
+* **waterfall** (``--trace <id>``): one trace reconstructed as an
+  indented span tree in start-time order, with per-span wall, the
+  share of the root each span's exclusive time holds, span links
+  (batch fan-in: the serving dispatch span links every member
+  request's root — one dispatch, many parents), and a ``coverage``
+  line stating how much of the root's wall the leaf segments explain;
+* **critical-path aggregate** (``--aggregate``, also part of the
+  default summary): exclusive seconds summed per span name across
+  every trace — "p99 time lives in X" — naming the dominant segment
+  fleet-wide and the rank whose traces hold the most of it.
+
+``--json`` emits one machine-readable ``mxtpu-tracetop/1`` document
+for CI.  Stdlib only: tracing.py is loaded by file path, never
+through the framework.  Exit codes: 0 ok, 1 ``--trace`` id not found,
+2 unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+from _distview import load_tracing as _load_tracing  # noqa: E402
+
+SCHEMA = "mxtpu-tracetop/1"
+
+
+def _span_key(s):
+    return s.get("ts") or 0.0
+
+
+def build_tree(doc):
+    """(roots, children) for one trace doc: spans indexed by parent,
+    each level start-time ordered.  Spans whose parent is not in the
+    doc (a remote parent from an inbound traceparent, or a sampled-out
+    rank) are treated as roots so nothing disappears."""
+    spans = doc.get("spans") or []
+    by_id = {s.get("span_id"): s for s in spans}
+    children = {}
+    roots = []
+    for s in spans:
+        p = s.get("parent_id")
+        if p is not None and p in by_id:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    for v in children.values():
+        v.sort(key=_span_key)
+    roots.sort(key=_span_key)
+    return roots, children
+
+
+def waterfall(doc):
+    """The ``--trace`` document: the span tree flattened to rows
+    (depth, name, wall, exclusive share, links), plus the segment
+    coverage — leaf exclusive seconds vs the root's wall."""
+    tracing = _load_tracing()
+    roots, children = build_tree(doc)
+    total = float(doc.get("dur_s") or 0.0)
+    excl = {}
+    for s in doc.get("spans") or []:
+        kids = children.get(s.get("span_id"), ())
+        excl[s.get("span_id")] = max(
+            0.0, float(s.get("dur_s") or 0.0)
+            - sum(float(k.get("dur_s") or 0.0) for k in kids))
+    rows = []
+    t0 = min((float(s.get("ts") or 0.0) for s in doc.get("spans") or []),
+             default=0.0)
+
+    def walk(s, depth):
+        rows.append({
+            "depth": depth,
+            "name": s.get("name"),
+            "span_id": s.get("span_id"),
+            "start_ms": round((float(s.get("ts") or 0.0) - t0) * 1e3, 3),
+            "wall_ms": round(float(s.get("dur_s") or 0.0) * 1e3, 3),
+            "exclusive_ms": round(excl.get(s.get("span_id"), 0.0) * 1e3,
+                                  3),
+            "share": round(excl.get(s.get("span_id"), 0.0) / total, 4)
+            if total > 0 else 0.0,
+            "status": s.get("status"),
+            "attrs": s.get("attrs") or {},
+            "links": s.get("links") or [],
+        })
+        for k in children.get(s.get("span_id"), ()):
+            walk(k, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    # coverage: the named segments (every non-root exclusive interval)
+    # vs the root's wall — the acceptance contract is >= 95%
+    root_ids = {r.get("span_id") for r in roots}
+    seg_s = sum(v for sid, v in excl.items() if sid not in root_ids)
+    name, dom = tracing.dominant_segment(doc)
+    return {
+        "trace_id": doc.get("trace_id"),
+        "root": doc.get("root"),
+        "status": doc.get("status"),
+        "rank": doc.get("rank"),
+        "ranks": doc.get("ranks", [doc.get("rank")]),
+        "ts": doc.get("ts"),
+        "total_ms": round(total * 1e3, 3),
+        "segments_ms": round(seg_s * 1e3, 3),
+        "coverage": round(seg_s / total, 4) if total > 0 else 0.0,
+        "dominant": name,
+        "dominant_ms": round(dom * 1e3, 3),
+        "attrs": doc.get("attrs") or {},
+        "spans": rows,
+    }
+
+
+def aggregate(docs):
+    """Critical-path exclusive seconds per span name across every
+    trace, plus the per-rank split of the dominant segment: "p99 time
+    lives in X (and it lives on rank N)"."""
+    tracing = _load_tracing()
+    by_name = {}
+    by_name_rank = {}
+    for doc in docs:
+        cp = tracing.critical_path(doc)
+        ranks = doc.get("ranks") or [doc.get("rank", 0)]
+        tag = ranks[0] if len(ranks) == 1 else doc.get("rank", 0)
+        for name, s in cp.items():
+            by_name[name] = by_name.get(name, 0.0) + s
+            key = (name, tag)
+            by_name_rank[key] = by_name_rank.get(key, 0.0) + s
+    if not by_name:
+        return {"segments_ms": {}, "dominant": None,
+                "dominant_ms": 0.0, "dominant_rank": None}
+    dom = max(by_name, key=by_name.get)
+    rank_split = {r: s for (n, r), s in by_name_rank.items() if n == dom}
+    dom_rank = max(rank_split, key=rank_split.get) if rank_split else None
+    return {
+        "segments_ms": {n: round(s * 1e3, 3)
+                        for n, s in sorted(by_name.items(),
+                                           key=lambda kv: -kv[1])},
+        "dominant": dom,
+        "dominant_ms": round(by_name[dom] * 1e3, 3),
+        "dominant_rank": dom_rank,
+        "dominant_rank_split_ms": {
+            str(r): round(s * 1e3, 3)
+            for r, s in sorted(rank_split.items(),
+                               key=lambda kv: -kv[1])},
+    }
+
+
+def rank_traces(docs, limit=None):
+    """Slowest-first rows for the default view (error/shed sort above
+    ok ties by duration)."""
+    tracing = _load_tracing()
+    rows = []
+    for doc in docs:
+        name, dom = tracing.dominant_segment(doc)
+        rows.append({
+            "trace_id": doc.get("trace_id"),
+            "root": doc.get("root"),
+            "status": doc.get("status", "ok"),
+            "rank": doc.get("rank"),
+            "ranks": doc.get("ranks", [doc.get("rank")]),
+            "total_ms": round(float(doc.get("dur_s") or 0.0) * 1e3, 3),
+            "spans": len(doc.get("spans") or []),
+            "dominant": name,
+            "dominant_ms": round(dom * 1e3, 3),
+            "keep": doc.get("keep"),
+        })
+    rows.sort(key=lambda r: (-(r["status"] != "ok"), -r["total_ms"]))
+    return rows if limit is None else rows[:limit]
+
+
+def render_ranking(rows, agg, n_total):
+    lines = ["%d trace(s)%s" % (n_total,
+                                ", %d shown" % len(rows)
+                                if len(rows) < n_total else "")]
+    if rows:
+        lines.append("%-32s %-13s %-6s %9s  %-20s %s"
+                     % ("trace", "root", "status", "total", "dominant",
+                        "rank(s)"))
+        for r in rows:
+            lines.append(
+                "%-32s %-13s %-6s %8.2fms %-20s %s"
+                % (r["trace_id"], r["root"], r["status"], r["total_ms"],
+                   "%s (%.2fms)" % (r["dominant"], r["dominant_ms"])
+                   if r["dominant"] else "-",
+                   ",".join(str(x) for x in r["ranks"])))
+    if agg and agg.get("dominant"):
+        lines.append("")
+        lines.append("critical path (exclusive ms across all traces):")
+        for name, ms in list(agg["segments_ms"].items())[:10]:
+            lines.append("  %-24s %10.2fms%s"
+                         % (name, ms,
+                            "  <- dominant" if name == agg["dominant"]
+                            else ""))
+        if agg.get("dominant_rank") is not None:
+            lines.append("time lives in: %s  (mostly rank %s)"
+                         % (agg["dominant"], agg["dominant_rank"]))
+        else:
+            lines.append("time lives in: %s" % agg["dominant"])
+    return "\n".join(lines)
+
+
+def render_waterfall(wf):
+    lines = ["trace %s  root=%s  status=%s  rank(s)=%s  total=%.2fms"
+             % (wf["trace_id"], wf["root"], wf["status"],
+                ",".join(str(r) for r in wf["ranks"]), wf["total_ms"])]
+    if wf["attrs"]:
+        lines.append("attrs: %s"
+                     % " ".join("%s=%s" % kv
+                                for kv in sorted(wf["attrs"].items())))
+    for row in wf["spans"]:
+        link = ""
+        if row["links"]:
+            link = "  links=%d member(s)" % len(row["links"])
+        status = " [%s]" % row["status"] if row.get("status") else ""
+        attrs = row["attrs"]
+        detail = ""
+        if attrs:
+            keys = sorted(attrs)[:4]
+            detail = "  " + " ".join("%s=%s" % (k, attrs[k])
+                                     for k in keys)
+        lines.append(
+            "  %s%-*s +%8.2fms  wall %8.2fms  excl %8.2fms (%4.1f%%)"
+            "%s%s%s"
+            % ("  " * row["depth"], 28 - 2 * row["depth"], row["name"],
+               row["start_ms"], row["wall_ms"], row["exclusive_ms"],
+               row["share"] * 100, status, link, detail))
+    lines.append("coverage: segments explain %.1f%% of the root wall "
+                 "(%.2f of %.2fms); dominant: %s (%.2fms)"
+                 % (wf["coverage"] * 100, wf["segments_ms"],
+                    wf["total_ms"], wf["dominant"], wf["dominant_ms"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="trace_top",
+        description="rank, reconstruct, and attribute mxtpu-trace/1 "
+                    "traces (docs/api/telemetry.md)")
+    ap.add_argument("path",
+                    help="an mxtpu-trace/1 JSONL file, or a directory "
+                         "of per-rank trace files (merged by trace id)")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="waterfall one trace (id or unique prefix)")
+    ap.add_argument("--aggregate", action="store_true",
+                    help="only the critical-path aggregate")
+    ap.add_argument("--limit", type=int, default=20, metavar="N",
+                    help="ranking rows to show (default 20)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one mxtpu-tracetop/1 JSON document")
+    args = ap.parse_args(argv)
+
+    tracing = _load_tracing()
+    try:
+        docs = tracing.read_traces(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        sys.stderr.write("trace_top: cannot read %s: %s\n"
+                         % (args.path, e))
+        return 2
+
+    if args.trace:
+        hits = [d for d in docs if d.get("trace_id") == args.trace]
+        if not hits:
+            hits = [d for d in docs
+                    if str(d.get("trace_id", "")).startswith(args.trace)]
+        if len(hits) != 1:
+            sys.stderr.write(
+                "trace_top: trace %r %s in %s (%d traces)\n"
+                % (args.trace,
+                   "not found" if not hits else
+                   "matches %d traces" % len(hits),
+                   args.path, len(docs)))
+            return 1
+        wf = waterfall(hits[0])
+        if args.json:
+            print(json.dumps(dict(wf, schema=SCHEMA, view="waterfall"),
+                             sort_keys=True))
+        else:
+            print(render_waterfall(wf))
+        return 0
+
+    agg = aggregate(docs)
+    if args.aggregate:
+        if args.json:
+            print(json.dumps(dict(agg, schema=SCHEMA, view="aggregate",
+                                  traces=len(docs)), sort_keys=True))
+        else:
+            print(render_ranking([], agg, len(docs)))
+        return 0
+
+    rows = rank_traces(docs, limit=args.limit)
+    if args.json:
+        print(json.dumps({
+            "schema": SCHEMA, "view": "ranking", "traces": len(docs),
+            "rows": rows, "critical_path": agg}, sort_keys=True))
+    else:
+        print(render_ranking(rows, agg, len(docs)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
